@@ -1,0 +1,13 @@
+"""cloud_tpu: a TPU-native cloud training framework.
+
+Single import point (reference parity:
+src/python/tensorflow_cloud/__init__.py:16-27):
+
+    import cloud_tpu as ctc
+    ctc.run(entry_point="train.py", chief_config=ctc.COMMON_MACHINE_CONFIGS["TPU_V5E_8"])
+"""
+
+from cloud_tpu.core.machine_config import AcceleratorType
+from cloud_tpu.core.machine_config import COMMON_MACHINE_CONFIGS
+from cloud_tpu.core.machine_config import MachineConfig
+from cloud_tpu.version import __version__

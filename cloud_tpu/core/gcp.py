@@ -1,0 +1,267 @@
+"""Google Cloud platform knowledge tables, TPU-first.
+
+Redesign of the reference's hard-coded GCP tables
+(reference: src/python/tensorflow_cloud/core/gcp.py). Differences:
+
+- TPU is the primary target: accelerator-type mapping covers v2-v5p and
+  emits Cloud TPU API slice strings (``v5litepod-8`` etc.) instead of the
+  reference's two CAIP-era enum values (reference gcp.py:88-89).
+- The ~170-tuple GPU whitelist (reference gcp.py:123-406) is expressed as
+  the generative rule it encodes: a machine-type family table plus a
+  per-(gpu, count) max-CPU-cores limit.
+- TPU runtime versions replace the TF-2.1-only gate
+  (reference gcp.py:119-120).
+"""
+
+import os
+import re
+
+
+def get_project_name():
+    """Returns the current GCP project name.
+
+    Resolution order: explicit env (``GOOGLE_CLOUD_PROJECT`` /
+    ``GCP_PROJECT``), then application-default credentials — mirroring
+    reference gcp.py:25-32 (which uses ``google.auth.default()`` only) but
+    usable on machines without the google-auth package installed.
+    """
+    for var in ("GOOGLE_CLOUD_PROJECT", "GCP_PROJECT", "PROJECT_ID"):
+        project = os.environ.get(var)
+        if project:
+            return project
+    try:
+        import google.auth  # pylint: disable=g-import-not-at-top
+        _, project = google.auth.default()
+    except Exception as e:  # ImportError or DefaultCredentialsError
+        raise RuntimeError(
+            "Could not determine the GCP project id: application default "
+            "credentials are unavailable and none of GOOGLE_CLOUD_PROJECT / "
+            "GCP_PROJECT / PROJECT_ID are set.") from e
+    if not project:
+        raise RuntimeError(
+            "Could not determine the GCP project id from application "
+            "default credentials. Set GOOGLE_CLOUD_PROJECT.")
+    return project
+
+
+def get_region():
+    """Returns the default compute region for job submission.
+
+    Env-overridable (``CLOUD_TPU_REGION``); defaults to ``us-central1``
+    like reference gcp.py:73-75.
+    """
+    return os.environ.get("CLOUD_TPU_REGION", "us-central1")
+
+
+def get_zone():
+    """Returns the default zone for TPU-VM provisioning."""
+    return os.environ.get("CLOUD_TPU_ZONE", get_region() + "-a")
+
+
+# Cloud TPU API accelerator-type prefixes per generation.
+_TPU_SLICE_PREFIX = {
+    "TPU_V2": "v2",
+    "TPU_V3": "v3",
+    "TPU_V4": "v4",
+    "TPU_V5E": "v5litepod",
+    "TPU_V5P": "v5p",
+}
+
+_GPU_API_NAMES = {
+    "K80": "NVIDIA_TESLA_K80",
+    "P100": "NVIDIA_TESLA_P100",
+    "V100": "NVIDIA_TESLA_V100",
+    "P4": "NVIDIA_TESLA_P4",
+    "T4": "NVIDIA_TESLA_T4",
+}
+
+
+def get_accelerator_type(accl_type):
+    """Returns the platform API accelerator-type string.
+
+    Reference parity: gcp.py:78-91, extended with the v4/v5e/v5p
+    generations. TPU values here are generation tags; slice strings come
+    from `get_tpu_slice_type`.
+    """
+    accl_type_map = dict(
+        {"CPU": "ACCELERATOR_TYPE_UNSPECIFIED"},
+        **_GPU_API_NAMES,
+        **{k: k for k in _TPU_SLICE_PREFIX},
+    )
+    return accl_type_map[accl_type]
+
+
+def get_tpu_slice_type(accelerator_type, accelerator_count):
+    """Returns the Cloud TPU API slice string, e.g. ``v5litepod-8``.
+
+    The reference never needed this because CAIP modelled TPUs as a machine
+    type ``cloud_tpu`` plus an accelerator config (reference
+    deploy.py:137-154); the TPU-native path provisions slices directly.
+    """
+    value = getattr(accelerator_type, "value", accelerator_type)
+    if value not in _TPU_SLICE_PREFIX:
+        raise ValueError("Not a TPU accelerator type: %r" % (value,))
+    return "%s-%d" % (_TPU_SLICE_PREFIX[value], accelerator_count)
+
+
+# Valid slice sizes per generation, in Cloud TPU accelerator-type naming
+# units (TensorCores for v2/v3/v4/v5p, chips for v5e — i.e. the N in
+# "v4-N" / "v5litepod-N"). The TPU analogue of the reference's
+# (cpu, memory, accelerator, count) whitelist (reference gcp.py:123-406).
+TPU_VALID_SLICE_SIZES = {
+    "TPU_V2": (8, 32, 128, 256, 512),
+    "TPU_V3": (8, 32, 128, 256, 512, 1024),
+    "TPU_V4": (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+    "TPU_V5E": (1, 4, 8, 16, 32, 64, 128, 256),
+    "TPU_V5P": (8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 12288),
+}
+
+
+# Machine-type families: (cpu_cores, memory_gb) -> machine type name
+# (reference gcp.py:97-117).
+_MACHINE_TYPE_MAP = {
+    (4, 15): "n1-standard-4",
+    (8, 30): "n1-standard-8",
+    (16, 60): "n1-standard-16",
+    (32, 120): "n1-standard-32",
+    (64, 240): "n1-standard-64",
+    (96, 360): "n1-standard-96",
+    (2, 13): "n1-highmem-2",
+    (4, 26): "n1-highmem-4",
+    (8, 52): "n1-highmem-8",
+    (16, 104): "n1-highmem-16",
+    (32, 208): "n1-highmem-32",
+    (64, 416): "n1-highmem-64",
+    (96, 624): "n1-highmem-96",
+    (16, 14.4): "n1-highcpu-16",
+    (32, 28.8): "n1-highcpu-32",
+    (64, 57.6): "n1-highcpu-64",
+    (96, 86.4): "n1-highcpu-96",
+}
+
+
+def get_machine_type(cpu_cores, memory, accelerator_type):
+    """Returns the platform machine type.
+
+    TPU configs map to the TPU-VM host type for their generation (the
+    reference collapses all TPUs to CAIP's ``cloud_tpu``, gcp.py:93-96 —
+    kept as the returned value for v2/v3 legacy configs).
+    """
+    value = getattr(accelerator_type, "value", accelerator_type)
+    if value in ("TPU_V2", "TPU_V3"):
+        return "cloud_tpu"
+    if value in _TPU_SLICE_PREFIX:
+        # TPU-VM: the host is part of the slice; no separate machine type.
+        return "tpu-vm"
+    return _MACHINE_TYPE_MAP[(cpu_cores, memory)]
+
+
+def get_tpu_runtime_versions():
+    """Supported TPU-VM runtime (software) versions, newest first.
+
+    Replaces the reference's TF-version gate (gcp.py:119-120 → ["2.1"]).
+    """
+    return ["tpu-ubuntu2204-base", "v2-alpha-tpuv5-lite", "tpu-vm-v4-base"]
+
+
+def get_cloud_tpu_supported_tf_versions():
+    """Reference-parity shim (gcp.py:119-120) for the legacy CAIP path."""
+    return ["2.1"]
+
+
+# Max host CPU cores allowed for each (gpu_type, gpu_count) — the rule
+# underlying the reference's exhaustive whitelist (gcp.py:148-406).
+_GPU_MAX_CPU_CORES = {
+    ("K80", 1): 8, ("K80", 2): 16, ("K80", 4): 32, ("K80", 8): 32,
+    ("P100", 1): 16, ("P100", 2): 32, ("P100", 4): 32,
+    ("P4", 1): 16, ("P4", 2): 32, ("P4", 4): 96,
+    ("T4", 1): 16, ("T4", 2): 32, ("T4", 4): 96,
+    ("V100", 1): 8, ("V100", 2): 16, ("V100", 4): 32, ("V100", 8): 96,
+}
+
+# Machine families GPUs can attach to (highcpu excluded, matching the
+# reference whitelist which never pairs GPUs with n1-highcpu).
+_GPU_MACHINE_FAMILIES = ("n1-standard", "n1-highmem")
+
+
+def validate_machine_configuration(cpu_cores, memory, accelerator_type,
+                                   accelerator_count):
+    """Errors out if the given machine configuration is not valid on GCP.
+
+    Reference parity: gcp.py's whitelist check, generalised to TPU slices
+    of every generation.
+    """
+    value = getattr(accelerator_type, "value", accelerator_type)
+
+    if value in _TPU_SLICE_PREFIX:
+        if cpu_cores is not None or memory is not None:
+            raise ValueError(
+                "Invalid machine configuration: TPU configs take the host "
+                "shape from the slice; pass cpu_cores=None, memory=None. "
+                "Received cpu_cores={}, memory={}.".format(cpu_cores, memory))
+        valid = TPU_VALID_SLICE_SIZES[value]
+        if accelerator_count not in valid:
+            raise ValueError(
+                "Invalid machine configuration: accelerator_count={} is not "
+                "a valid {} slice size. Valid sizes: {}.".format(
+                    accelerator_count, value, list(valid)))
+        return
+
+    if (cpu_cores, memory) not in _MACHINE_TYPE_MAP:
+        raise ValueError(
+            "Invalid machine configuration: (cpu_cores={}, memory={}) does "
+            "not match a GCP machine type. Valid combinations: {}.".format(
+                cpu_cores, memory, sorted(
+                    _MACHINE_TYPE_MAP, key=lambda k: (str(k[0]), str(k[1])))))
+
+    if value == "CPU":
+        if accelerator_count != 0:
+            raise ValueError(
+                "Invalid machine configuration: accelerator_count must be 0 "
+                "for CPU configs. Received {}.".format(accelerator_count))
+        return
+
+    machine_type = _MACHINE_TYPE_MAP[(cpu_cores, memory)]
+    family = machine_type.rsplit("-", 1)[0]
+    max_cores = _GPU_MAX_CPU_CORES.get((value, accelerator_count))
+    if max_cores is None or family not in _GPU_MACHINE_FAMILIES:
+        raise ValueError(
+            "Invalid machine configuration: {} x{} on {} is not supported "
+            "on GCP.".format(value, accelerator_count, machine_type))
+    if cpu_cores > max_cores:
+        raise ValueError(
+            "Invalid machine configuration: {} x{} supports at most {} CPU "
+            "cores; received {} ({}).".format(
+                value, accelerator_count, max_cores, cpu_cores, machine_type))
+
+
+def validate_job_labels(job_labels):
+    """Validates job labels conform to GCP resource-label guidelines.
+
+    Same rules as reference gcp.py:409-481: at most 64 labels; keys and
+    values at most 63 chars, starting with a lowercase letter, containing
+    only lowercase letters, digits, underscores and dashes.
+    """
+    if not job_labels:
+        return
+
+    if len(job_labels) > 64:
+        raise ValueError(
+            "Invalid job labels: too many labels, expecting at most 64. "
+            "Received {}.".format(len(job_labels)))
+
+    for k, v in job_labels.items():
+        for kind, s in (("key", k), ("value", v)):
+            if not s or not s[0].islower():
+                raise ValueError(
+                    "Invalid job labels: label {} must start with a "
+                    "lowercase letter. Received {!r}.".format(kind, s))
+            if len(s) > 63:
+                raise ValueError(
+                    "Invalid job labels: label {} is too long, expecting at "
+                    "most 63 characters. Received {!r}.".format(kind, s))
+            if not re.match(r"^[a-z0-9_-]+$", s):
+                raise ValueError(
+                    "Invalid job labels: label {} can only contain lowercase "
+                    "letters, digits, underscores and dashes. "
+                    "Received {!r}.".format(kind, s))

@@ -1,0 +1,237 @@
+"""Machine configuration annotations used by the `run` API.
+
+TPU-first redesign of the reference's machine catalog
+(reference: src/python/tensorflow_cloud/core/machine_config.py:25-185).
+Where the reference treats TPUs as a 2-entry afterthought (TPU_V2/TPU_V3,
+one 8-core slice), this catalog makes Cloud TPU generations (v2-v5p) the
+primary axis, models slice topology explicitly (chips per host, valid slice
+sizes), and keeps the reference's GPU presets as the secondary path.
+"""
+
+import enum
+
+from cloud_tpu.core import gcp
+
+
+class AcceleratorType(enum.Enum):
+    """Types of accelerators.
+
+    TPU generations are first-class (vs reference machine_config.py:34-35
+    which stops at TPU_V3); GPU types are retained for the secondary path.
+    """
+
+    NO_ACCELERATOR = "CPU"
+    # --- TPU generations (primary target) ---
+    TPU_V2 = "TPU_V2"
+    TPU_V3 = "TPU_V3"
+    TPU_V4 = "TPU_V4"
+    TPU_V5E = "TPU_V5E"
+    TPU_V5P = "TPU_V5P"
+    # --- GPU types (secondary path, reference parity) ---
+    NVIDIA_TESLA_K80 = "K80"
+    NVIDIA_TESLA_P100 = "P100"
+    NVIDIA_TESLA_V100 = "V100"
+    NVIDIA_TESLA_P4 = "P4"
+    NVIDIA_TESLA_T4 = "T4"
+
+    @classmethod
+    def all(cls):
+        return tuple(cls)
+
+    @classmethod
+    def tpu_types(cls):
+        return (cls.TPU_V2, cls.TPU_V3, cls.TPU_V4, cls.TPU_V5E, cls.TPU_V5P)
+
+    @classmethod
+    def gpu_types(cls):
+        return (
+            cls.NVIDIA_TESLA_K80,
+            cls.NVIDIA_TESLA_P100,
+            cls.NVIDIA_TESLA_V100,
+            cls.NVIDIA_TESLA_P4,
+            cls.NVIDIA_TESLA_T4,
+        )
+
+    @classmethod
+    def validate(cls, key):
+        if key not in cls.all():
+            raise ValueError("Invalid accelerator key provided: %s." % key)
+
+
+# Physical slice topology per TPU generation. `accelerator_count` follows
+# Cloud TPU accelerator-type naming units (the N in "v4-N"/"v5litepod-N"):
+# TensorCores for v2/v3/v4/v5p, chips for v5e. Every generation packs 8
+# naming units per host (4 chips x 2 cores, or 8 single-core chips).
+# `cores_per_device` converts naming units to JAX devices: v2/v3 expose one
+# device per core, v4/v5p run megacore (one device per 2-core chip), v5e is
+# one device per chip. The reference never models topology because it only
+# ever submits one 8-core slice (reference validate.py:160-166).
+TPU_UNITS_PER_HOST = {
+    AcceleratorType.TPU_V2: 8,
+    AcceleratorType.TPU_V3: 8,
+    AcceleratorType.TPU_V4: 8,
+    AcceleratorType.TPU_V5E: 8,
+    AcceleratorType.TPU_V5P: 8,
+}
+
+TPU_UNITS_PER_DEVICE = {
+    AcceleratorType.TPU_V2: 1,   # device per core
+    AcceleratorType.TPU_V3: 1,   # device per core
+    AcceleratorType.TPU_V4: 2,   # megacore: device per chip
+    AcceleratorType.TPU_V5E: 1,  # device per (single-core) chip
+    AcceleratorType.TPU_V5P: 2,  # megacore: device per chip
+}
+
+
+class MachineConfig(object):
+    """Represents the configuration or type of machine to be used.
+
+    Reference parity: same four constructor fields as
+    reference machine_config.py:58-90, but `accelerator_type='auto'`
+    resolves TPU-first (v5e) instead of to a GPU (reference
+    machine_config.py:82-83 resolves to P100).
+    """
+
+    def __init__(self,
+                 cpu_cores="auto",
+                 memory="auto",
+                 accelerator_type="auto",
+                 accelerator_count=8):
+        """Constructor.
+
+        Args:
+          cpu_cores: Number of virtual CPU cores on the host, or `None` for
+            TPU configs ("whatever the TPU-VM host has"). Defaults to
+            'auto': `None` for TPU accelerators, 8 otherwise.
+          memory: Amount of memory in GB, or `None` for TPU configs.
+            Defaults to 'auto': `None` for TPU accelerators, 30 otherwise.
+          accelerator_type: An `AcceleratorType` ('TPU_V5E', ..., 'K80', or
+            'CPU' for no accelerator). Defaults to 'auto', which maps to the
+            current-generation TPU (TPU_V5E).
+          accelerator_count: Accelerator count in Cloud TPU naming units for
+            TPUs (the N in "v5litepod-N" — may span hosts), or the GPU
+            count otherwise. Defaults to 8 (one v5e host).
+        """
+        if accelerator_type == "auto":
+            accelerator_type = AcceleratorType.TPU_V5E
+        is_tpu = accelerator_type in AcceleratorType.tpu_types()
+        if cpu_cores == "auto":
+            cpu_cores = None if is_tpu else 8
+        if memory == "auto":
+            memory = None if is_tpu else 30
+
+        self.cpu_cores = cpu_cores
+        self.memory = memory
+        self.accelerator_type = accelerator_type
+        self.accelerator_count = accelerator_count
+
+        self.validate()
+
+    def validate(self):
+        """Checks that the machine configuration created is valid for GCP."""
+        AcceleratorType.validate(self.accelerator_type)
+        gcp.validate_machine_configuration(self.cpu_cores,
+                                           self.memory,
+                                           self.accelerator_type,
+                                           self.accelerator_count)
+
+    @property
+    def is_tpu(self):
+        return self.accelerator_type in AcceleratorType.tpu_types()
+
+    @property
+    def num_hosts(self):
+        """Number of TPU-VM hosts backing this config (1 for non-TPU)."""
+        if not self.is_tpu:
+            return 1
+        units_per_host = TPU_UNITS_PER_HOST[self.accelerator_type]
+        return max(1, -(-self.accelerator_count // units_per_host))
+
+    @property
+    def num_devices(self):
+        """Number of JAX devices this config exposes (len(jax.devices()))."""
+        if not self.is_tpu:
+            return max(1, self.accelerator_count)
+        return max(
+            1,
+            self.accelerator_count
+            // TPU_UNITS_PER_DEVICE[self.accelerator_type])
+
+    def __repr__(self):
+        accel = self.accelerator_type
+        name = accel.value if isinstance(accel, AcceleratorType) else accel
+        return ("MachineConfig(cpu_cores={}, memory={}, "
+                "accelerator_type={!r}, accelerator_count={})").format(
+                    self.cpu_cores, self.memory, name, self.accelerator_count)
+
+
+def _tpu(accel_type, count):
+    return MachineConfig(
+        cpu_cores=None,
+        memory=None,
+        accelerator_type=accel_type,
+        accelerator_count=count,
+    )
+
+
+def _gpu(accel_type, count, cpu_cores, memory):
+    return MachineConfig(
+        cpu_cores=cpu_cores,
+        memory=memory,
+        accelerator_type=accel_type,
+        accelerator_count=count,
+    )
+
+
+# Dictionary with common machine configurations. TPU slice presets are the
+# primary entries (vs the single "TPU" entry at reference
+# machine_config.py:170-175); GPU presets retained for the secondary path
+# (reference machine_config.py:97-169).
+COMMON_MACHINE_CONFIGS = {
+    "CPU": MachineConfig(
+        cpu_cores=4,
+        memory=15,
+        accelerator_type=AcceleratorType.NO_ACCELERATOR,
+        accelerator_count=0,
+    ),
+    # --- TPU slice presets ---
+    "TPU_V2_8": _tpu(AcceleratorType.TPU_V2, 8),
+    "TPU_V3_8": _tpu(AcceleratorType.TPU_V3, 8),
+    "TPU_V4_8": _tpu(AcceleratorType.TPU_V4, 8),
+    "TPU_V4_32": _tpu(AcceleratorType.TPU_V4, 32),
+    "TPU_V5E_1": _tpu(AcceleratorType.TPU_V5E, 1),
+    "TPU_V5E_4": _tpu(AcceleratorType.TPU_V5E, 4),
+    "TPU_V5E_8": _tpu(AcceleratorType.TPU_V5E, 8),
+    "TPU_V5E_16": _tpu(AcceleratorType.TPU_V5E, 16),
+    "TPU_V5E_32": _tpu(AcceleratorType.TPU_V5E, 32),
+    "TPU_V5E_64": _tpu(AcceleratorType.TPU_V5E, 64),
+    "TPU_V5E_128": _tpu(AcceleratorType.TPU_V5E, 128),
+    "TPU_V5E_256": _tpu(AcceleratorType.TPU_V5E, 256),
+    "TPU_V5P_8": _tpu(AcceleratorType.TPU_V5P, 8),
+    "TPU_V5P_32": _tpu(AcceleratorType.TPU_V5P, 32),
+    # Legacy alias matching the reference's single TPU preset
+    # (reference machine_config.py:170-175: TPU_V3 x 8).
+    "TPU": _tpu(AcceleratorType.TPU_V3, 8),
+    # --- GPU presets (secondary path) ---
+    "K80_1X": _gpu(AcceleratorType.NVIDIA_TESLA_K80, 1, 8, 30),
+    "K80_4X": _gpu(AcceleratorType.NVIDIA_TESLA_K80, 4, 16, 60),
+    "K80_8X": _gpu(AcceleratorType.NVIDIA_TESLA_K80, 8, 32, 120),
+    "P100_1X": _gpu(AcceleratorType.NVIDIA_TESLA_P100, 1, 8, 30),
+    "P100_4X": _gpu(AcceleratorType.NVIDIA_TESLA_P100, 4, 16, 60),
+    "P4_1X": _gpu(AcceleratorType.NVIDIA_TESLA_P4, 1, 8, 30),
+    "P4_4X": _gpu(AcceleratorType.NVIDIA_TESLA_P4, 4, 16, 60),
+    "V100_1X": _gpu(AcceleratorType.NVIDIA_TESLA_V100, 1, 8, 30),
+    "V100_4X": _gpu(AcceleratorType.NVIDIA_TESLA_V100, 4, 16, 60),
+    "T4_1X": _gpu(AcceleratorType.NVIDIA_TESLA_T4, 1, 8, 30),
+    "T4_4X": _gpu(AcceleratorType.NVIDIA_TESLA_T4, 4, 16, 60),
+}
+
+
+def is_tpu_config(config):
+    """True if `config` requests any TPU generation.
+
+    Reference parity: machine_config.py:179-185, extended to v4/v5e/v5p.
+    """
+    if config:
+        return config.accelerator_type in AcceleratorType.tpu_types()
+    return False

@@ -1,0 +1,207 @@
+"""Pre-flight validation for every `run()` argument.
+
+Reference parity: src/python/tensorflow_cloud/core/validate.py:23-218,
+with the TPU restrictions inverted for the TPU-native path:
+
+- A TPU chief IS allowed (reference validate.py:153-158 forbids it):
+  on TPU-VMs the chief process runs on the slice's host 0.
+- Multi-host TPU slices are allowed — `worker_count` counts additional
+  TPU-VM host groups; the reference forces worker_count==1
+  (validate.py:160-166) because CAIP modelled a TPU as one 8-core node.
+- The TF<=2.1 gate (validate.py:167-176) is replaced by a TPU runtime
+  version check on the docker base image.
+"""
+
+import os
+
+from cloud_tpu.core import gcp
+from cloud_tpu.core import machine_config
+
+
+def validate(
+    entry_point,
+    requirements_txt,
+    distribution_strategy,
+    chief_config,
+    worker_config,
+    worker_count,
+    region,
+    entry_point_args,
+    stream_logs,
+    docker_image_bucket_name,
+    called_from_notebook,
+    job_labels=None,
+    docker_base_image=None,
+):
+    """Validates the inputs to `run()`.
+
+    Args:
+        entry_point: Optional string. File path to the python file or
+            notebook that contains the training code.
+        requirements_txt: Optional string. File path to requirements.txt.
+        distribution_strategy: 'auto' or None. 'auto' means the framework
+            builds the JAX device mesh + data-parallel step wrapper from
+            the cluster shape; None runs user code unwrapped.
+        chief_config: `MachineConfig` for the chief (host 0 of the slice
+            for TPU jobs).
+        worker_config: `MachineConfig` for the workers.
+        worker_count: Optional integer, number of workers (not counting
+            the chief). For TPU configs, additional slice host-groups.
+        region: String. Cloud region in which to submit the job.
+        entry_point_args: Optional list of strings passed as command line
+            arguments to the entry point program.
+        stream_logs: Boolean; stream remote logs back when True.
+        docker_image_bucket_name: Optional string, GCS bucket for Cloud
+            Build containerization.
+        called_from_notebook: Boolean, True when invoked from a notebook.
+        job_labels: Dict of str: str labels to organize jobs.
+        docker_base_image: Optional base docker image name.
+
+    Raises:
+        ValueError: if any of the inputs is invalid.
+    """
+    _validate_files(entry_point, requirements_txt)
+    _validate_distribution_strategy(distribution_strategy)
+    _validate_cluster_config(
+        chief_config, worker_count, worker_config, docker_base_image)
+    gcp.validate_job_labels(job_labels or {})
+    _validate_other_args(
+        region,
+        entry_point_args,
+        stream_logs,
+        docker_image_bucket_name,
+        called_from_notebook,
+    )
+
+
+def _validate_files(entry_point, requirements_txt):
+    """Validates all the file path params (reference validate.py:87-114)."""
+    cwd = os.getcwd()
+    if entry_point is not None and (
+            not os.path.isfile(os.path.join(cwd, entry_point))):
+        raise ValueError(
+            "Invalid `entry_point`. "
+            "Expected a relative path in the current directory tree. "
+            "Received: {}".format(entry_point))
+
+    if requirements_txt is not None and (
+            not os.path.isfile(os.path.join(cwd, requirements_txt))):
+        raise ValueError(
+            "Invalid `requirements_txt`. "
+            "Expected a relative path in the current directory tree. "
+            "Received: {}".format(requirements_txt))
+
+    if entry_point is not None and (
+            not entry_point.endswith((".py", ".ipynb"))):
+        raise ValueError(
+            "Invalid `entry_point`. "
+            "Expected a python file or an iPython notebook. "
+            "Received: {}".format(entry_point))
+
+
+def _validate_distribution_strategy(distribution_strategy):
+    """Reference validate.py:117-124."""
+    if distribution_strategy not in ["auto", None]:
+        raise ValueError(
+            "Invalid `distribution_strategy` input. "
+            'Expected "auto" or None. '
+            "Received {}.".format(distribution_strategy))
+
+
+def _validate_cluster_config(chief_config, worker_count, worker_config,
+                             docker_base_image):
+    """Validates cluster shape; TPU rules are TPU-native (see module doc)."""
+    if not isinstance(chief_config, machine_config.MachineConfig):
+        raise ValueError(
+            "Invalid `chief_config` input. "
+            'Expected "auto" or `MachineConfig` instance. '
+            "Received {}.".format(chief_config))
+
+    if not isinstance(worker_count, int) or worker_count < 0:
+        raise ValueError(
+            "Invalid `worker_count` input. "
+            "Expected a non-negative integer value. "
+            "Received {}.".format(worker_count))
+
+    if (worker_count > 0 and
+            not isinstance(worker_config, machine_config.MachineConfig)):
+        raise ValueError(
+            "Invalid `worker_config` input. "
+            'Expected "auto" or `MachineConfig` instance. '
+            "Received {}.".format(worker_config))
+
+    if machine_config.is_tpu_config(chief_config) and worker_count > 0:
+        if (not machine_config.is_tpu_config(worker_config) or
+                worker_config.accelerator_type !=
+                chief_config.accelerator_type):
+            raise ValueError(
+                "Invalid cluster configuration. "
+                "A TPU chief requires workers of the same TPU generation "
+                "(the slice is homogeneous). "
+                "Received chief {} with worker {}.".format(
+                    chief_config, worker_config))
+
+    if machine_config.is_tpu_config(chief_config) or \
+            machine_config.is_tpu_config(worker_config):
+        _validate_tpu_base_image(docker_base_image)
+
+    if (worker_count > 0 and machine_config.is_tpu_config(worker_config)
+            and not machine_config.is_tpu_config(chief_config)):
+        # Legacy CAIP-style topology: CPU chief + one TPU worker node.
+        # Multi-host scale-out in that topology goes through slice size,
+        # not worker_count (reference validate.py:160-166 kept as-is).
+        if worker_count != 1:
+            raise ValueError(
+                "Invalid `worker_count` input. "
+                "With a non-TPU chief, expected worker_count=1 for a TPU "
+                "`worker_config` (scale via the slice size instead). "
+                "Received {}.".format(worker_count))
+
+
+def _validate_tpu_base_image(docker_base_image):
+    """Pre-flight check on custom base images for TPU jobs.
+
+    Replaces the reference's TF<=2.1 gate (reference validate.py:167-176):
+    when `docker_base_image` is None the containerizer picks a matching
+    TPU-VM base image itself, so there is nothing to check; a custom image
+    that is visibly built for GPUs is rejected before any cloud spend.
+    """
+    if docker_base_image is None:
+        return
+    name = docker_base_image.lower()
+    if "-gpu" in name or "cuda" in name or "nvidia" in name:
+        raise ValueError(
+            "Invalid `docker_base_image` for a TPU job: {!r} looks like a "
+            "GPU/CUDA image. Use a TPU-VM base image (see "
+            "gcp.get_tpu_runtime_versions()) or leave docker_base_image "
+            "unset to get one automatically.".format(docker_base_image))
+
+
+def _validate_other_args(region, args, stream_logs, docker_image_bucket_name,
+                         called_from_notebook):
+    """Reference validate.py:184-218."""
+    if not isinstance(region, str):
+        raise ValueError(
+            "Invalid `region` input. "
+            "Expected None or a string value. "
+            "Received {}.".format(str(region)))
+
+    if args is not None and not isinstance(args, list):
+        raise ValueError(
+            "Invalid `entry_point_args` input. "
+            "Expected None or a list. "
+            "Received {}.".format(str(args)))
+
+    if not isinstance(stream_logs, bool):
+        raise ValueError(
+            "Invalid `stream_logs` input. "
+            "Expected a boolean. "
+            "Received {}.".format(str(stream_logs)))
+
+    if called_from_notebook and docker_image_bucket_name is None:
+        raise ValueError(
+            "Invalid `docker_image_bucket_name` input. "
+            "When the `run` API is used within a python notebook, "
+            "`docker_image_bucket_name` must be specified; it is used for "
+            "Google Cloud Storage/Build docker containerization. "
+            "Received {}.".format(str(docker_image_bucket_name)))

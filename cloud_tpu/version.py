@@ -1,0 +1,9 @@
+"""Version of the cloud-tpu framework.
+
+Mirrors the reference's single-constant version module
+(reference: src/python/tensorflow_cloud/version.py:16), consumed by
+packaging and by the client telemetry user-agent header
+(cloud_tpu/utils/google_api_client.py).
+"""
+
+__version__ = "0.1.0.dev"

@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Tests exercise multi-chip sharding logic without TPU hardware by running
+JAX on 8 virtual CPU devices — the TPU-native analogue of the reference's
+fake-cluster trick (reference cloud_fit/tests/unit/remote_test.py:80-127,
+which fabricates TF_CONFIG with bogus worker addresses). Must run before
+jax initializes its backends, hence the env mutation at import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

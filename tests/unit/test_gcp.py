@@ -1,0 +1,136 @@
+"""Unit tests for GCP platform tables (reference gcp_test.py:24-186)."""
+
+import pytest
+
+from cloud_tpu.core import gcp
+from cloud_tpu.core.machine_config import AcceleratorType
+
+
+class TestProjectRegion:
+
+    def test_project_from_env(self, monkeypatch):
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "my-project")
+        assert gcp.get_project_name() == "my-project"
+
+    def test_project_missing(self, monkeypatch):
+        for var in ("GOOGLE_CLOUD_PROJECT", "GCP_PROJECT", "PROJECT_ID"):
+            monkeypatch.delenv(var, raising=False)
+        with pytest.raises(RuntimeError, match="project"):
+            gcp.get_project_name()
+
+    def test_default_region(self, monkeypatch):
+        monkeypatch.delenv("CLOUD_TPU_REGION", raising=False)
+        assert gcp.get_region() == "us-central1"
+
+    def test_region_override(self, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_REGION", "us-west4")
+        assert gcp.get_region() == "us-west4"
+        assert gcp.get_zone() == "us-west4-a"
+
+
+class TestAcceleratorMapping:
+
+    def test_cpu_unspecified(self):
+        assert gcp.get_accelerator_type("CPU") == "ACCELERATOR_TYPE_UNSPECIFIED"
+
+    def test_gpu_names(self):
+        assert gcp.get_accelerator_type("V100") == "NVIDIA_TESLA_V100"
+        assert gcp.get_accelerator_type("T4") == "NVIDIA_TESLA_T4"
+
+    def test_tpu_slice_strings(self):
+        assert gcp.get_tpu_slice_type(AcceleratorType.TPU_V5E, 8) == \
+            "v5litepod-8"
+        assert gcp.get_tpu_slice_type(AcceleratorType.TPU_V4, 32) == "v4-32"
+        assert gcp.get_tpu_slice_type(AcceleratorType.TPU_V5P, 128) == \
+            "v5p-128"
+        assert gcp.get_tpu_slice_type("TPU_V2", 8) == "v2-8"
+
+    def test_tpu_slice_rejects_gpu(self):
+        with pytest.raises(ValueError, match="Not a TPU"):
+            gcp.get_tpu_slice_type("V100", 8)
+
+
+class TestMachineTypes:
+
+    def test_legacy_cloud_tpu(self):
+        # v2/v3 keep the CAIP-era machine type (reference gcp.py:93-96).
+        assert gcp.get_machine_type(None, None, AcceleratorType.TPU_V2) == \
+            "cloud_tpu"
+        assert gcp.get_machine_type(None, None, AcceleratorType.TPU_V3) == \
+            "cloud_tpu"
+
+    def test_modern_tpu_vm(self):
+        assert gcp.get_machine_type(None, None, AcceleratorType.TPU_V5E) == \
+            "tpu-vm"
+
+    def test_n1_families(self):
+        assert gcp.get_machine_type(
+            8, 30, AcceleratorType.NVIDIA_TESLA_T4) == "n1-standard-8"
+        assert gcp.get_machine_type(
+            4, 26, AcceleratorType.NO_ACCELERATOR) == "n1-highmem-4"
+        assert gcp.get_machine_type(
+            16, 14.4, AcceleratorType.NO_ACCELERATOR) == "n1-highcpu-16"
+
+    def test_tpu_runtime_versions(self):
+        versions = gcp.get_tpu_runtime_versions()
+        assert "tpu-ubuntu2204-base" in versions
+        # Legacy shim still answers like the reference (gcp.py:119-120).
+        assert gcp.get_cloud_tpu_supported_tf_versions() == ["2.1"]
+
+
+class TestValidateMachineConfiguration:
+
+    def test_gpu_count_not_supported(self):
+        with pytest.raises(ValueError, match="not supported"):
+            gcp.validate_machine_configuration(8, 30, "P100", 8)
+
+    def test_gpu_highcpu_not_supported(self):
+        with pytest.raises(ValueError, match="not supported"):
+            gcp.validate_machine_configuration(16, 14.4, "T4", 1)
+
+    def test_unknown_machine_shape(self):
+        with pytest.raises(ValueError, match="does not match a GCP machine"):
+            gcp.validate_machine_configuration(6, 30, "T4", 1)
+
+    def test_valid_boundaries(self):
+        gcp.validate_machine_configuration(32, 208, "K80", 8)
+        gcp.validate_machine_configuration(96, 624, "V100", 8)
+        gcp.validate_machine_configuration(96, 360, "T4", 4)
+        gcp.validate_machine_configuration(None, None, "TPU_V5E", 256)
+
+
+class TestJobLabels:
+
+    def test_empty_ok(self):
+        gcp.validate_job_labels({})
+        gcp.validate_job_labels(None)
+
+    def test_valid_labels(self):
+        gcp.validate_job_labels({"team": "research", "run-id": "exp_01"})
+
+    def test_too_many_labels(self):
+        labels = {"k%d" % i: "v" for i in range(65)}
+        with pytest.raises(ValueError, match="too many labels"):
+            gcp.validate_job_labels(labels)
+
+    def test_key_must_start_lowercase(self):
+        with pytest.raises(ValueError, match="lowercase"):
+            gcp.validate_job_labels({"Team": "research"})
+        with pytest.raises(ValueError, match="lowercase"):
+            gcp.validate_job_labels({"9team": "research"})
+
+    def test_value_must_start_lowercase(self):
+        with pytest.raises(ValueError, match="lowercase"):
+            gcp.validate_job_labels({"team": "Research"})
+
+    def test_length_limits(self):
+        with pytest.raises(ValueError, match="too long"):
+            gcp.validate_job_labels({"k" * 64: "v"})
+        with pytest.raises(ValueError, match="too long"):
+            gcp.validate_job_labels({"k": "v" * 64})
+
+    def test_charset(self):
+        with pytest.raises(ValueError, match="can only contain"):
+            gcp.validate_job_labels({"my key": "v"})
+        with pytest.raises(ValueError, match="can only contain"):
+            gcp.validate_job_labels({"key": "v.1"})

@@ -1,0 +1,144 @@
+"""Unit tests for the TPU-first machine catalog.
+
+Modeled on the reference's pure-table tests
+(reference core/tests/unit/gcp_test.py:24-186).
+"""
+
+import pytest
+
+from cloud_tpu.core import machine_config
+from cloud_tpu.core.machine_config import AcceleratorType, MachineConfig
+
+
+class TestAcceleratorType:
+
+    def test_tpu_generations_are_first_class(self):
+        for gen in ("TPU_V2", "TPU_V3", "TPU_V4", "TPU_V5E", "TPU_V5P"):
+            assert AcceleratorType(gen) in AcceleratorType.tpu_types()
+
+    def test_validate_rejects_raw_strings(self):
+        with pytest.raises(ValueError, match="Invalid accelerator key"):
+            AcceleratorType.validate("V100")
+
+    def test_all_covers_cpu_tpu_gpu(self):
+        all_types = AcceleratorType.all()
+        assert AcceleratorType.NO_ACCELERATOR in all_types
+        assert set(AcceleratorType.tpu_types()) <= set(all_types)
+        assert set(AcceleratorType.gpu_types()) <= set(all_types)
+
+
+class TestMachineConfig:
+
+    def test_auto_resolves_tpu_first(self):
+        config = MachineConfig(cpu_cores=None, memory=None,
+                               accelerator_count=8)
+        assert config.accelerator_type == AcceleratorType.TPU_V5E
+
+    def test_all_default_constructor_is_valid(self):
+        # Defaults must be self-consistent: auto -> TPU_V5E with no host
+        # shape and one v5e host worth of chips.
+        config = MachineConfig()
+        assert config.accelerator_type == AcceleratorType.TPU_V5E
+        assert config.cpu_cores is None and config.memory is None
+        assert config.accelerator_count == 8
+
+    def test_auto_host_shape_for_gpu(self):
+        config = MachineConfig(
+            accelerator_type=AcceleratorType.NVIDIA_TESLA_T4,
+            accelerator_count=1)
+        assert (config.cpu_cores, config.memory) == (8, 30)
+
+    def test_tpu_config_rejects_host_shape(self):
+        with pytest.raises(ValueError, match="cpu_cores=None"):
+            MachineConfig(cpu_cores=8, memory=30,
+                          accelerator_type=AcceleratorType.TPU_V5E,
+                          accelerator_count=8)
+
+    def test_invalid_slice_size_rejected(self):
+        with pytest.raises(ValueError, match="not a valid TPU_V5E slice"):
+            MachineConfig(cpu_cores=None, memory=None,
+                          accelerator_type=AcceleratorType.TPU_V5E,
+                          accelerator_count=7)
+
+    def test_valid_v5p_slice(self):
+        config = MachineConfig(cpu_cores=None, memory=None,
+                               accelerator_type=AcceleratorType.TPU_V5P,
+                               accelerator_count=128)
+        assert config.is_tpu
+
+    def test_num_hosts_v5e(self):
+        config = machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_32"]
+        assert config.num_hosts == 4  # 8 chips per v5e host
+
+    def test_num_hosts_v4(self):
+        # v4-32 = 32 TensorCores = 16 chips = 4 hosts.
+        config = machine_config.COMMON_MACHINE_CONFIGS["TPU_V4_32"]
+        assert config.num_hosts == 4
+
+    def test_num_hosts_legacy_v3_8_is_single_host(self):
+        # v3-8 (the reference's one TPU shape) is physically one host.
+        config = machine_config.COMMON_MACHINE_CONFIGS["TPU"]
+        assert config.num_hosts == 1
+
+    def test_num_hosts_single_chip(self):
+        config = machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_1"]
+        assert config.num_hosts == 1
+
+    def test_num_devices(self):
+        # v3-8: 8 cores = 8 JAX devices; v4-32: megacore, 16 devices;
+        # v5e-8: 8 devices; T4 x4: 4.
+        assert machine_config.COMMON_MACHINE_CONFIGS["TPU"].num_devices == 8
+        assert (machine_config.COMMON_MACHINE_CONFIGS["TPU_V4_32"]
+                .num_devices == 16)
+        assert (machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_8"]
+                .num_devices == 8)
+        assert (machine_config.COMMON_MACHINE_CONFIGS["T4_4X"]
+                .num_devices == 4)
+
+    def test_gpu_config_valid(self):
+        config = MachineConfig(cpu_cores=16, memory=60,
+                               accelerator_type=AcceleratorType.NVIDIA_TESLA_T4,
+                               accelerator_count=4)
+        assert not config.is_tpu
+        assert config.num_hosts == 1
+
+    def test_gpu_too_many_cores_rejected(self):
+        # V100 x1 caps at 8 cores (reference gcp.py whitelist rule).
+        with pytest.raises(ValueError, match="at most 8 CPU cores"):
+            MachineConfig(cpu_cores=16, memory=60,
+                          accelerator_type=AcceleratorType.NVIDIA_TESLA_V100,
+                          accelerator_count=1)
+
+    def test_cpu_config_requires_zero_accelerators(self):
+        with pytest.raises(ValueError, match="accelerator_count must be 0"):
+            MachineConfig(cpu_cores=4, memory=15,
+                          accelerator_type=AcceleratorType.NO_ACCELERATOR,
+                          accelerator_count=1)
+
+
+class TestCommonMachineConfigs:
+
+    def test_legacy_tpu_alias(self):
+        # Matches the reference's single TPU preset
+        # (reference machine_config.py:170-175).
+        config = machine_config.COMMON_MACHINE_CONFIGS["TPU"]
+        assert config.accelerator_type == AcceleratorType.TPU_V3
+        assert config.accelerator_count == 8
+
+    def test_v5e_presets_cover_pod_sizes(self):
+        for n in (1, 4, 8, 16, 32, 64, 128, 256):
+            key = "TPU_V5E_%d" % n
+            assert key in machine_config.COMMON_MACHINE_CONFIGS
+            assert (machine_config.COMMON_MACHINE_CONFIGS[key]
+                    .accelerator_count == n)
+
+    def test_all_presets_valid(self):
+        for name, config in machine_config.COMMON_MACHINE_CONFIGS.items():
+            config.validate()  # must not raise
+
+    def test_is_tpu_config(self):
+        assert machine_config.is_tpu_config(
+            machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_8"])
+        assert not machine_config.is_tpu_config(
+            machine_config.COMMON_MACHINE_CONFIGS["CPU"])
+        assert not machine_config.is_tpu_config(None)

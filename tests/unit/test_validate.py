@@ -1,0 +1,149 @@
+"""Unit tests for run()-argument validation.
+
+Mirrors the reference's rejection-branch coverage
+(reference core/tests/unit/validate_test.py) with the TPU rules inverted
+for the TPU-native path.
+"""
+
+import os
+
+import pytest
+
+from cloud_tpu.core import machine_config
+from cloud_tpu.core import validate
+
+CONFIGS = machine_config.COMMON_MACHINE_CONFIGS
+
+
+def _validate(**overrides):
+    kwargs = dict(
+        entry_point=None,
+        requirements_txt=None,
+        distribution_strategy="auto",
+        chief_config=CONFIGS["TPU_V5E_8"],
+        worker_config=CONFIGS["CPU"],
+        worker_count=0,
+        region="us-central1",
+        entry_point_args=None,
+        stream_logs=False,
+        docker_image_bucket_name=None,
+        called_from_notebook=False,
+    )
+    kwargs.update(overrides)
+    return validate.validate(**kwargs)
+
+
+class TestFiles:
+
+    def test_missing_entry_point(self):
+        with pytest.raises(ValueError, match="Invalid `entry_point`"):
+            _validate(entry_point="does_not_exist.py")
+
+    def test_bad_extension(self, tmp_path, monkeypatch):
+        f = tmp_path / "train.sh"
+        f.write_text("echo hi")
+        monkeypatch.chdir(tmp_path)
+        with pytest.raises(ValueError, match="python file or an iPython"):
+            _validate(entry_point="train.sh")
+
+    def test_valid_entry_point(self, tmp_path, monkeypatch):
+        f = tmp_path / "train.py"
+        f.write_text("print('hi')")
+        monkeypatch.chdir(tmp_path)
+        _validate(entry_point="train.py")
+
+    def test_missing_requirements(self):
+        with pytest.raises(ValueError, match="Invalid `requirements_txt`"):
+            _validate(requirements_txt="no_such_requirements.txt")
+
+
+class TestDistributionStrategy:
+
+    def test_auto_and_none_ok(self):
+        _validate(distribution_strategy="auto")
+        _validate(distribution_strategy=None)
+
+    def test_other_rejected(self):
+        with pytest.raises(ValueError, match="distribution_strategy"):
+            _validate(distribution_strategy="mirrored")
+
+
+class TestClusterConfig:
+
+    def test_tpu_chief_allowed(self):
+        # Inversion of reference validate.py:153-158.
+        _validate(chief_config=CONFIGS["TPU_V5E_8"], worker_count=0)
+
+    def test_multihost_tpu_allowed(self):
+        # Inversion of reference validate.py:160-166.
+        _validate(chief_config=CONFIGS["TPU_V5E_8"],
+                  worker_config=CONFIGS["TPU_V5E_8"],
+                  worker_count=3)
+
+    def test_tpu_chief_with_gpu_workers_rejected(self):
+        with pytest.raises(ValueError, match="homogeneous"):
+            _validate(chief_config=CONFIGS["TPU_V5E_8"],
+                      worker_config=CONFIGS["T4_1X"],
+                      worker_count=2)
+
+    def test_mixed_tpu_generations_rejected(self):
+        with pytest.raises(ValueError, match="homogeneous"):
+            _validate(chief_config=CONFIGS["TPU_V5E_8"],
+                      worker_config=CONFIGS["TPU_V2_8"],
+                      worker_count=2)
+
+    def test_gpu_base_image_rejected_for_tpu_job(self):
+        # Replaces the reference's TF<=2.1 gate (validate.py:167-176).
+        with pytest.raises(ValueError, match="GPU/CUDA image"):
+            _validate(chief_config=CONFIGS["TPU_V5E_8"],
+                      docker_base_image="tensorflow/tensorflow:2.9.0-gpu")
+        _validate(chief_config=CONFIGS["TPU_V5E_8"],
+                  docker_base_image="ubuntu:22.04")
+
+    def test_legacy_cpu_chief_tpu_worker_needs_one_worker(self):
+        # Reference validate.py:160-166 behavior kept for the legacy
+        # CAIP-style topology.
+        _validate(chief_config=CONFIGS["CPU"],
+                  worker_config=CONFIGS["TPU"],
+                  worker_count=1)
+        with pytest.raises(ValueError, match="worker_count=1"):
+            _validate(chief_config=CONFIGS["CPU"],
+                      worker_config=CONFIGS["TPU"],
+                      worker_count=2)
+
+    def test_chief_config_must_be_machine_config(self):
+        with pytest.raises(ValueError, match="chief_config"):
+            _validate(chief_config="auto")
+
+    def test_negative_worker_count(self):
+        with pytest.raises(ValueError, match="worker_count"):
+            _validate(worker_count=-1)
+
+    def test_worker_config_required_when_workers(self):
+        with pytest.raises(ValueError, match="worker_config"):
+            _validate(worker_count=2, worker_config=None)
+
+
+class TestOtherArgs:
+
+    def test_region_must_be_string(self):
+        with pytest.raises(ValueError, match="region"):
+            _validate(region=None)
+
+    def test_args_must_be_list(self):
+        with pytest.raises(ValueError, match="entry_point_args"):
+            _validate(entry_point_args="--epochs 5")
+
+    def test_stream_logs_must_be_bool(self):
+        with pytest.raises(ValueError, match="stream_logs"):
+            _validate(stream_logs="yes")
+
+    def test_notebook_requires_bucket(self):
+        with pytest.raises(ValueError, match="docker_image_bucket_name"):
+            _validate(called_from_notebook=True)
+        _validate(called_from_notebook=True,
+                  docker_image_bucket_name="my-bucket")
+
+    def test_bad_job_labels(self):
+        with pytest.raises(ValueError, match="lowercase"):
+            _validate(job_labels={"Key": "value"})
