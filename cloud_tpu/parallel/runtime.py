@@ -1,0 +1,195 @@
+"""Ambient distribution runtime for TPU-native execution.
+
+This is the TPU-native replacement for the reference's ambient strategy
+mechanism (`tf.distribute.experimental_set_strategy(strategy)`, reference
+core/preprocess.py:148-149) and its TPU bootstrap dance (the 40x10s
+`TPU_CONFIG`-polling `TPUClusterResolver`, reference
+core/preprocess.py:215-262). On TPU-VMs the chips are local devices, so
+bootstrap collapses to a bounded wait on `jax.devices()`; multi-host pods
+bootstrap through `jax.distributed.initialize` driven by an env-var
+contract (the analogue of the reference's `TF_CONFIG`/`TPU_CONFIG`
+injection, reference core/deploy.py:159-161).
+
+The initialized context — a `jax.sharding.Mesh` plus the strategy name —
+is ambient: `cloud_tpu.training.Trainer` and the `run()`-generated runner
+scripts pick it up via `global_mesh()` without user code changes.
+
+Env contract (set by the deployer on every remote process):
+    CLOUD_TPU_COORDINATOR_ADDRESS  host:port of process 0
+    CLOUD_TPU_NUM_PROCESSES        total process count
+    CLOUD_TPU_PROCESS_ID           this process's index
+    CLOUD_TPU_RUNNING_REMOTELY     guard consumed by `run.remote()`
+"""
+
+import logging
+import os
+import time
+
+logger = logging.getLogger("cloud_tpu")
+
+# Known strategy names, selected by the strategy compiler
+# (cloud_tpu/core/preprocess.py) from the cluster shape.
+STRATEGIES = ("one_device", "mirrored", "multi_worker", "tpu_slice",
+              "tpu_pod")
+
+_context = None
+
+
+class DistributionContext:
+    """The ambient distribution state: strategy name + device mesh."""
+
+    def __init__(self, strategy, mesh):
+        self.strategy = strategy
+        self.mesh = mesh
+
+    @property
+    def num_devices(self):
+        return self.mesh.devices.size
+
+    def __repr__(self):
+        return "DistributionContext(strategy={!r}, mesh_shape={})".format(
+            self.strategy, dict(self.mesh.shape))
+
+
+def _wait_for_devices(min_devices=1, retries=40, retry_interval_secs=10.0):
+    """Bounded wait for accelerator availability.
+
+    Parity with the reference's TPU-provisioning wait
+    (core/preprocess.py:238-261: 40 retries x 10s), collapsed to a local
+    device query because TPU-VM chips are local.
+    """
+    import jax
+
+    last_err = None
+    for attempt in range(retries):
+        try:
+            devices = jax.devices()
+            if len(devices) >= min_devices:
+                return devices
+        except RuntimeError as e:  # backend not ready yet
+            last_err = e
+        if attempt < retries - 1:
+            time.sleep(retry_interval_secs)
+    raise RuntimeError(
+        "Accelerator devices did not become available after {} attempts "
+        "({}s apart). Last error: {}".format(
+            retries, retry_interval_secs, last_err))
+
+
+def initialize(strategy="tpu_slice",
+               axis_names=("dp",),
+               mesh_shape=None,
+               coordinator_address=None,
+               num_processes=None,
+               process_id=None,
+               devices=None,
+               retries=40,
+               retry_interval_secs=10.0):
+    """Initializes the ambient distribution context.
+
+    Args:
+        strategy: One of `STRATEGIES`. Multi-process strategies
+            ("multi_worker", "tpu_pod") run `jax.distributed.initialize`
+            first, using the env contract when args are not given.
+        axis_names: Mesh axis names. Default is a pure data-parallel 1D
+            mesh ("dp",); pass e.g. ("dp", "tp") with `mesh_shape` for
+            hybrid layouts.
+        mesh_shape: Optional tuple of ints matching `axis_names`. Default:
+            all devices on the first axis.
+        coordinator_address / num_processes / process_id: Multi-process
+            bootstrap parameters; default to the CLOUD_TPU_* env contract.
+        devices: Explicit device list (tests); default `jax.devices()`
+            after a bounded availability wait.
+        retries / retry_interval_secs: Device-wait bounds (reference
+            parity: 40 x 10s).
+
+    Returns:
+        The installed `DistributionContext`.
+    """
+    global _context
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            "Unknown strategy {!r}. Expected one of {}.".format(
+                strategy, STRATEGIES))
+
+    if strategy in ("multi_worker", "tpu_pod"):
+        _maybe_init_distributed(coordinator_address, num_processes,
+                                process_id)
+
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    if devices is None:
+        if strategy == "one_device":
+            devices = _wait_for_devices(1, retries, retry_interval_secs)[:1]
+        else:
+            devices = _wait_for_devices(1, retries, retry_interval_secs)
+
+    device_array = np.asarray(devices)
+    if mesh_shape is not None:
+        if len(mesh_shape) != len(axis_names):
+            raise ValueError(
+                "mesh_shape {} does not match axis_names {}.".format(
+                    mesh_shape, axis_names))
+        device_array = device_array.reshape(mesh_shape)
+    else:
+        device_array = device_array.reshape(
+            (device_array.size,) + (1,) * (len(axis_names) - 1))
+
+    mesh = Mesh(device_array, axis_names)
+    _context = DistributionContext(strategy, mesh)
+    logger.info("cloud_tpu runtime initialized: %r", _context)
+    return _context
+
+
+def _maybe_init_distributed(coordinator_address, num_processes, process_id):
+    """Runs `jax.distributed.initialize` from args or the env contract."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "CLOUD_TPU_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = _env_int("CLOUD_TPU_NUM_PROCESSES")
+    if process_id is None:
+        process_id = _env_int("CLOUD_TPU_PROCESS_ID")
+
+    if coordinator_address is None and num_processes in (None, 1):
+        # Single-process "pod": legitimate in tests and on a single
+        # TPU-VM; nothing to bootstrap.
+        logger.info("No multi-process env contract found; running "
+                    "single-process.")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def _env_int(name):
+    value = os.environ.get(name)
+    return int(value) if value is not None else None
+
+
+def is_initialized():
+    return _context is not None
+
+
+def context():
+    if _context is None:
+        raise RuntimeError(
+            "cloud_tpu runtime is not initialized. Call "
+            "cloud_tpu.parallel.runtime.initialize() first (the run() "
+            "generated runner does this automatically).")
+    return _context
+
+
+def global_mesh():
+    """The ambient mesh, or None when uninitialized (single-device ok)."""
+    return _context.mesh if _context is not None else None
+
+
+def reset():
+    """Clears the ambient context (test isolation)."""
+    global _context
+    _context = None

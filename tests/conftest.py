@@ -10,10 +10,16 @@ jax initializes its backends, hence the env mutation at import time.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The host environment pins JAX_PLATFORMS to the TPU tunnel via a site
+# hook; an explicit config update is the only override that sticks.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,81 @@
+"""Tests for the ambient distribution runtime on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from cloud_tpu.parallel import runtime
+
+
+@pytest.fixture(autouse=True)
+def _reset_runtime():
+    runtime.reset()
+    yield
+    runtime.reset()
+
+
+class TestInitialize:
+
+    def test_default_dp_mesh_covers_all_devices(self):
+        ctx = runtime.initialize(strategy="tpu_slice")
+        assert ctx.num_devices == 8
+        assert tuple(ctx.mesh.axis_names) == ("dp",)
+        assert dict(ctx.mesh.shape) == {"dp": 8}
+
+    def test_one_device(self):
+        ctx = runtime.initialize(strategy="one_device")
+        assert ctx.num_devices == 1
+
+    def test_hybrid_mesh_shape(self):
+        ctx = runtime.initialize(strategy="tpu_slice",
+                                 axis_names=("dp", "tp"),
+                                 mesh_shape=(2, 4))
+        assert dict(ctx.mesh.shape) == {"dp": 2, "tp": 4}
+
+    def test_mesh_shape_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="does not match"):
+            runtime.initialize(strategy="tpu_slice",
+                               axis_names=("dp",),
+                               mesh_shape=(2, 4))
+
+    def test_unknown_strategy_rejected(self):
+        with pytest.raises(ValueError, match="Unknown strategy"):
+            runtime.initialize(strategy="parameter_server")
+
+    def test_tpu_pod_single_process_fallback(self, monkeypatch):
+        # Without the env contract, a pod strategy degrades to
+        # single-process (legit on one TPU-VM and in tests).
+        for var in ("CLOUD_TPU_COORDINATOR_ADDRESS",
+                    "CLOUD_TPU_NUM_PROCESSES", "CLOUD_TPU_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+        ctx = runtime.initialize(strategy="tpu_pod")
+        assert ctx.num_devices == 8
+
+    def test_context_raises_before_initialize(self):
+        with pytest.raises(RuntimeError, match="not initialized"):
+            runtime.context()
+        assert runtime.global_mesh() is None
+
+    def test_ambient_access_after_initialize(self):
+        runtime.initialize(strategy="mirrored")
+        assert runtime.is_initialized()
+        assert runtime.global_mesh() is not None
+        assert runtime.context().strategy == "mirrored"
+
+
+class TestMeshIsUsable:
+
+    def test_psum_over_dp_axis(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ctx = runtime.initialize(strategy="tpu_slice")
+        mesh = ctx.mesh
+        x = jnp.arange(16.0).reshape(8, 2)
+        sharded = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+
+        @jax.jit
+        def total(v):
+            return jnp.sum(v)
+
+        np.testing.assert_allclose(total(sharded), x.sum())
