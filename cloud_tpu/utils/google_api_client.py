@@ -1,0 +1,76 @@
+"""Google API client utilities: telemetry header + job-status polling.
+
+Reference parity: utils/google_api_client.py:27-78.
+"""
+
+import logging
+import time
+
+from cloud_tpu import version
+
+try:
+    from googleapiclient import discovery
+    from googleapiclient.http import HttpRequest
+except ImportError:
+    discovery = None
+    HttpRequest = object
+
+logger = logging.getLogger("cloud_tpu")
+
+_USER_AGENT = "cloud-tpu/{}".format(version.__version__)
+
+# Terminal CAIP job states (reference google_api_client.py:56-66).
+_SUCCEEDED = "SUCCEEDED"
+_FAILED = "FAILED"
+_CANCELLED = "CANCELLED"
+
+
+class CloudTpuHttpRequest(HttpRequest):
+    """HttpRequest that tags every API call with the framework user-agent.
+
+    Reference parity: `TFCloudHttpRequest`
+    (utils/google_api_client.py:27-42) — the usage-telemetry channel.
+    """
+
+    def __init__(self, *args, **kwargs):
+        headers = kwargs.setdefault("headers", {})
+        headers["user-agent"] = _USER_AGENT
+        super().__init__(*args, **kwargs)
+
+
+def get_api_training_job_state(job_id, project_id, api_client=None):
+    """Returns the current state string of a platform training job."""
+    if api_client is None:
+        if discovery is None:
+            raise RuntimeError(
+                "google-api-python-client is required to query job status.")
+        api_client = discovery.build(
+            "ml", "v1", cache_discovery=False,
+            requestBuilder=CloudTpuHttpRequest)
+    name = "projects/{}/jobs/{}".format(project_id, job_id)
+    request = api_client.projects().jobs().get(name=name)
+    response = request.execute()
+    return response.get("state")
+
+
+def wait_for_api_training_job_success(job_id, project_id, api_client=None,
+                                      poll_interval_secs=30):
+    """Blocks until the training job reaches a terminal state.
+
+    Reference parity: utils/google_api_client.py:45-78 (30s poll loop
+    until SUCCEEDED/FAILED).
+
+    Returns:
+        True on SUCCEEDED, False on FAILED/CANCELLED.
+    """
+    while True:
+        state = get_api_training_job_state(job_id, project_id, api_client)
+        if state == _SUCCEEDED:
+            logger.info("Job %s succeeded.", job_id)
+            return True
+        if state in (_FAILED, _CANCELLED):
+            logger.error("Job %s finished with state %s.", job_id, state)
+            return False
+        logger.info("Job %s state: %s; polling again in %ss.",
+                    job_id, state, poll_interval_secs)
+        time.sleep(poll_interval_secs)
