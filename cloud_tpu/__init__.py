@@ -10,4 +10,6 @@ src/python/tensorflow_cloud/__init__.py:16-27):
 from cloud_tpu.core.machine_config import AcceleratorType
 from cloud_tpu.core.machine_config import COMMON_MACHINE_CONFIGS
 from cloud_tpu.core.machine_config import MachineConfig
+from cloud_tpu.core.run import remote
+from cloud_tpu.core.run import run
 from cloud_tpu.version import __version__

@@ -126,9 +126,11 @@ class ContainerBuilder(object):
                 machine_config.is_tpu_config(self.worker_config))
 
     def _uses_accelerator(self):
-        return (self.chief_config.accelerator_type !=
-                machine_config.AcceleratorType.NO_ACCELERATOR or
-                self._is_tpu_job())
+        configs = (self.chief_config, self.worker_config)
+        return self._is_tpu_job() or any(
+            c is not None and c.accelerator_type !=
+            machine_config.AcceleratorType.NO_ACCELERATOR
+            for c in configs)
 
     def _default_base_image(self):
         """Python-slim base matched to the local interpreter version.
@@ -219,7 +221,9 @@ class ContainerBuilder(object):
             r = requests.get(
                 "https://hub.docker.com/v2/repositories/{}/tags/{}".format(
                     repo_name, tag_name), timeout=10)
-            return r.ok
+            # Only a definitive 404 means the tag is missing; rate limits
+            # (429) or hub outages must not silently downgrade the image.
+            return r.status_code != 404
         except Exception:  # no egress: assume the default tag is fine
             return True
 
@@ -242,6 +246,11 @@ class ContainerBuilder(object):
         location_map = {}
         if self.entry_point is None and sys.argv[0].endswith(".py"):
             self.entry_point = sys.argv[0]
+        if self.entry_point is None and not self.called_from_notebook:
+            raise ValueError(
+                "Could not determine the entry point: `entry_point` was not "
+                "given and the current process ({!r}) is not a python "
+                "script. Pass `entry_point` explicitly.".format(sys.argv[0]))
 
         if not self.called_from_notebook:
             entry_point_dir, _ = os.path.split(self.entry_point)
@@ -371,7 +380,10 @@ class CloudContainerBuilder(ContainerBuilder):
                          id=create_response["metadata"]["build"]["id"])
                     .execute())
                 status = get_response["status"]
-                if status not in ("WORKING", "QUEUED"):
+                # PENDING/STATUS_UNKNOWN are pre-queue states (e.g. at the
+                # project's Cloud Build concurrency limit) — keep polling.
+                if status not in ("WORKING", "QUEUED", "PENDING",
+                                  "STATUS_UNKNOWN"):
                     break
                 attempts += 1
                 time.sleep(delay_between_status_checks)

@@ -1,0 +1,188 @@
+"""The `run()` orchestrator: validate -> preprocess -> containerize -> deploy.
+
+Reference parity: core/run.py:31-265, TPU-first:
+
+- 'auto' machine configs resolve to a v5e-8 TPU slice (the reference
+  resolves to one T4 GPU, reference run.py:154-157).
+- `run()` returns the submitted job id (the reference returns nothing).
+- `sys.exit(0)` fires only in the self-launch case (`entry_point=None`
+  from a plain script), where continuing would train locally; launcher
+  scripts that pass an explicit `entry_point` keep running (the
+  reference exits unconditionally outside notebooks, run.py:245-248).
+"""
+
+import os
+import sys
+
+from cloud_tpu.core import containerize
+from cloud_tpu.core import deploy
+from cloud_tpu.core import gcp
+from cloud_tpu.core import machine_config
+from cloud_tpu.core import preprocess
+from cloud_tpu.core import validate
+
+
+def remote():
+    """True when running in a cloud environment launched by this framework
+    (reference run.py:31-33; the TF_KERAS_* alias is honoured too)."""
+    return bool(os.environ.get("CLOUD_TPU_RUNNING_REMOTELY") or
+                os.environ.get("TF_KERAS_RUNNING_REMOTELY"))
+
+
+def run(
+    entry_point=None,
+    requirements_txt=None,
+    distribution_strategy="auto",
+    docker_base_image=None,
+    chief_config="auto",
+    worker_config="auto",
+    worker_count=0,
+    entry_point_args=None,
+    stream_logs=False,
+    docker_image_bucket_name=None,
+    job_labels=None,
+    **kwargs
+):
+    """Runs your training code on Cloud TPUs (or GPUs) in GCP.
+
+    Args:
+        entry_point: Optional path (in the working tree) to the python
+            file or notebook with the training code. When None, the
+            calling script (or notebook) itself is the entry point.
+        requirements_txt: Optional path to additional pip requirements.
+        distribution_strategy: 'auto' (default) wraps the entry point in
+            a runner that initializes the ambient JAX mesh runtime from
+            the cluster shape; None runs user code unwrapped.
+        docker_base_image: Optional custom docker base image.
+        chief_config: `MachineConfig` or 'auto' (a v5e-8 TPU slice).
+        worker_config: `MachineConfig` or 'auto' (a v5e-8 TPU slice).
+        worker_count: Number of additional workers. Defaults to 0.
+        entry_point_args: Optional list of command line args for the
+            entry point program.
+        stream_logs: Stream remote job logs back when True.
+        docker_image_bucket_name: When set, containerize via GCS + Cloud
+            Build instead of the local docker daemon.
+        job_labels: Optional dict of up-to-64 str: str job labels.
+        **kwargs: Swallowed-then-rejected for forward compatibility with
+            newer clients in older cloud environments (reference
+            run.py:137-145).
+
+    Returns:
+        The submitted job id (None when running remotely).
+    """
+    # If code is triggered in a cloud environment, do nothing
+    # (reference run.py:133-135).
+    if remote():
+        return None
+
+    if kwargs:
+        raise TypeError("Unknown keyword arguments: %s" % (kwargs.keys(),))
+
+    # Defaults (TPU-first; reference run.py:154-165).
+    if chief_config == "auto":
+        chief_config = machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_8"]
+    if not isinstance(worker_count, int):
+        worker_count = int(worker_count)
+    if worker_config == "auto":
+        # No phantom worker config when there are no workers: downstream
+        # stages (validate, containerize) key TPU/GPU behavior off it.
+        worker_config = (
+            machine_config.COMMON_MACHINE_CONFIGS["TPU_V5E_8"]
+            if worker_count > 0 else None)
+    region = gcp.get_region()
+    destination_dir = "/app/"
+    project_id = gcp.get_project_name()
+    docker_registry = "gcr.io/{}".format(project_id)
+    called_from_notebook = _called_from_notebook()
+
+    validate.validate(
+        entry_point,
+        requirements_txt,
+        distribution_strategy,
+        chief_config,
+        worker_config,
+        worker_count,
+        region,
+        entry_point_args,
+        stream_logs,
+        docker_image_bucket_name,
+        called_from_notebook,
+        job_labels=job_labels or {},
+        docker_base_image=docker_base_image,
+    )
+
+    # Make the entry point cloud- and distribution-ready (reference
+    # run.py:184-200; the None-entry_point crash when strategy is None is
+    # guarded here).
+    preprocessed_entry_point = None
+    if (distribution_strategy == "auto" or entry_point is None
+            or entry_point.endswith(".ipynb")):
+        preprocessed_entry_point = preprocess.get_preprocessed_entry_point(
+            entry_point,
+            chief_config,
+            worker_config,
+            worker_count,
+            distribution_strategy,
+            called_from_notebook=called_from_notebook,
+        )
+
+    cb_args = (
+        entry_point,
+        preprocessed_entry_point,
+        chief_config,
+        worker_config,
+        docker_registry,
+        project_id,
+    )
+    cb_kwargs = {
+        "requirements_txt": requirements_txt,
+        "destination_dir": destination_dir,
+        "docker_base_image": docker_base_image,
+        "docker_image_bucket_name": docker_image_bucket_name,
+        "called_from_notebook": called_from_notebook,
+    }
+    if docker_image_bucket_name is None:
+        container_builder = containerize.LocalContainerBuilder(
+            *cb_args, **cb_kwargs)
+    else:
+        container_builder = containerize.CloudContainerBuilder(
+            *cb_args, **cb_kwargs)
+    docker_img_uri = container_builder.get_docker_image()
+
+    # Delete the temporary artifacts (reference run.py:227-231).
+    if preprocessed_entry_point is not None:
+        os.remove(preprocessed_entry_point)
+    for f in container_builder.get_generated_files():
+        if f is not None and os.path.exists(f):
+            os.remove(f)
+
+    job_id = deploy.deploy_job(
+        region,
+        docker_img_uri,
+        chief_config,
+        worker_count,
+        worker_config,
+        entry_point_args,
+        stream_logs,
+        job_labels=job_labels,
+    )
+
+    # In the self-launch case the rest of this script is the training
+    # code: exit so it does not also train locally (reference
+    # run.py:245-248).
+    if entry_point is None and not called_from_notebook:
+        sys.exit(0)
+    return job_id
+
+
+def _called_from_notebook():
+    """Detects a notebook environment (reference run.py:251-265)."""
+    try:
+        import IPython  # pylint: disable=g-import-not-at-top
+    except ImportError:
+        return False
+    try:
+        shell = IPython.get_ipython().__class__.__name__
+        return "Shell" in shell
+    except NameError:
+        return False

@@ -141,8 +141,9 @@ def _validate_cluster_config(chief_config, worker_count, worker_config,
                 "Received chief {} with worker {}.".format(
                     chief_config, worker_config))
 
-    if machine_config.is_tpu_config(chief_config) or \
-            machine_config.is_tpu_config(worker_config):
+    if machine_config.is_tpu_config(chief_config) or (
+            worker_count > 0 and
+            machine_config.is_tpu_config(worker_config)):
         _validate_tpu_base_image(docker_base_image)
 
     if (worker_count > 0 and machine_config.is_tpu_config(worker_config)

@@ -29,6 +29,9 @@ def _builder(tmp_path, monkeypatch, cls=containerize.ContainerBuilder,
     if entry_point:
         (tmp_path / entry_point).write_text("pass\n")
     monkeypatch.chdir(tmp_path)
+    # Keep the dockerhub probe offline and deterministic.
+    monkeypatch.setattr(cls, "_base_image_exists",
+                        lambda self, image: True)
     pre = None
     if preprocessed:
         pre = str(tmp_path / "preprocessed_train.py")
@@ -118,6 +121,34 @@ class TestDockerfile:
         with pytest.warns(UserWarning, match="falling back"):
             lines = _dockerfile_lines(b)
         assert lines[0] == "FROM python:3.12-slim"
+
+    def test_probe_missing_only_on_404(self, tmp_path, monkeypatch):
+        b = containerize.ContainerBuilder(
+            entry_point=None, preprocessed_entry_point=None,
+            chief_config=CONFIGS["CPU"], worker_config=None,
+            docker_registry="gcr.io/p", project_id="p")
+        fake_requests = mock.MagicMock()
+        fake_requests.get.return_value = mock.MagicMock(status_code=404)
+        monkeypatch.setattr(containerize, "requests", fake_requests)
+        assert not b._base_image_exists("python:3.999")
+        # Rate limits / outages must not downgrade the image.
+        fake_requests.get.return_value = mock.MagicMock(status_code=429)
+        assert b._base_image_exists("python:3.12")
+        fake_requests.get.side_effect = OSError("no egress")
+        assert b._base_image_exists("python:3.12")
+
+    def test_cpu_chief_gpu_workers_get_cuda(self, tmp_path, monkeypatch):
+        b = _builder(tmp_path, monkeypatch, chief="CPU", worker="T4_4X")
+        lines = _dockerfile_lines(b)
+        assert any("jax[cuda12]" in l for l in lines)
+
+    def test_entry_point_unresolvable_raises(self, tmp_path, monkeypatch):
+        b = _builder(tmp_path, monkeypatch, entry_point=None,
+                     preprocessed=True)
+        monkeypatch.setattr(containerize.sys, "argv", [""])
+        b._create_docker_file()
+        with pytest.raises(ValueError, match="entry point"):
+            b._get_file_path_map()
 
 
 class TestTarball:

@@ -1,0 +1,131 @@
+"""Request-payload golden tests for the deployer.
+
+Mirrors reference core/tests/unit/deploy_test.py:49-295 (CAIP job request
+dict equality for chief+workers / no workers / TPU variants and error
+paths), extended with the TPU-VM encoding for modern slices.
+"""
+
+from unittest import mock
+
+import pytest
+
+from cloud_tpu.core import deploy
+from cloud_tpu.core import machine_config
+
+CONFIGS = machine_config.COMMON_MACHINE_CONFIGS
+
+
+def _request(chief="TPU_V5E_8", worker=None, worker_count=0, args=None,
+             labels=None):
+    return deploy._create_request_dict(
+        "job_1", "us-central1", "gcr.io/p/img:tag", CONFIGS[chief],
+        worker_count, CONFIGS[worker] if worker else None, args,
+        labels or {})
+
+
+class TestRequestDict:
+
+    def test_tpu_v5e_chief_only(self):
+        assert _request() == {
+            "jobId": "job_1",
+            "trainingInput": {
+                "region": "us-central1",
+                "scaleTier": "custom",
+                "masterType": "tpu-vm",
+                "masterConfig": {
+                    "imageUri": "gcr.io/p/img:tag",
+                    "acceleratorConfig": {
+                        "count": "8",
+                        "type": "v5litepod-8",
+                    },
+                    "tpuRuntimeVersion": "tpu-ubuntu2204-base",
+                },
+                "workerCount": "0",
+                "use_chief_in_tf_config": True,
+            },
+        }
+
+    def test_multihost_slice_gets_env_contract(self):
+        # v5e-32 spans 4 hosts -> 4 processes even with no extra workers.
+        request = _request(chief="TPU_V5E_32")
+        master = request["trainingInput"]["masterConfig"]
+        assert master["env"] == [
+            {"name": "CLOUD_TPU_NUM_PROCESSES", "value": "4"}]
+
+    def test_chief_plus_tpu_workers(self):
+        request = _request(chief="TPU_V5E_8", worker="TPU_V5E_8",
+                           worker_count=3)
+        ti = request["trainingInput"]
+        assert ti["workerCount"] == "3"
+        assert ti["workerType"] == "tpu-vm"
+        assert ti["workerConfig"]["acceleratorConfig"] == {
+            "count": "8", "type": "v5litepod-8"}
+        # 1 chief host + 3 workers x 1 host each.
+        assert ti["masterConfig"]["env"] == [
+            {"name": "CLOUD_TPU_NUM_PROCESSES", "value": "4"}]
+        assert ti["workerConfig"]["env"] == [
+            {"name": "CLOUD_TPU_NUM_PROCESSES", "value": "4"}]
+
+    def test_legacy_tpu_v3_worker(self):
+        # CAIP-era encoding kept for v2/v3 (reference deploy_test TPU case).
+        request = _request(chief="CPU", worker="TPU", worker_count=1)
+        ti = request["trainingInput"]
+        assert ti["masterType"] == "n1-standard-4"
+        assert ti["masterConfig"]["acceleratorConfig"] == {
+            "count": "0", "type": "ACCELERATOR_TYPE_UNSPECIFIED"}
+        assert ti["workerType"] == "cloud_tpu"
+        assert ti["workerConfig"] == {
+            "imageUri": "gcr.io/p/img:tag",
+            "acceleratorConfig": {"count": "8", "type": "TPU_V3"},
+            "tpuTfVersion": "2.1",
+            # CPU chief host + one v3-8 worker host.
+            "env": [{"name": "CLOUD_TPU_NUM_PROCESSES", "value": "2"}],
+        }
+
+    def test_gpu_cluster(self):
+        request = _request(chief="T4_4X", worker="T4_4X", worker_count=2)
+        ti = request["trainingInput"]
+        assert ti["masterType"] == "n1-standard-16"
+        assert ti["masterConfig"]["acceleratorConfig"] == {
+            "count": "4", "type": "NVIDIA_TESLA_T4"}
+
+    def test_args_and_labels(self):
+        request = _request(args=["--epochs", "5"],
+                           labels={"team": "research"})
+        assert request["trainingInput"]["args"] == ["--epochs", "5"]
+        assert request["labels"] == {"team": "research"}
+
+    def test_single_host_no_env_contract(self):
+        ti = _request()["trainingInput"]
+        assert "env" not in ti["masterConfig"]
+
+
+class TestDeployJob:
+
+    def _api_client(self):
+        client = mock.MagicMock()
+        return client, client.projects.return_value.jobs.return_value
+
+    def test_submit(self, monkeypatch, capsys):
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "my-project")
+        client, jobs = self._api_client()
+        job_id = deploy.deploy_job(
+            "us-central1", "gcr.io/p/img:tag", CONFIGS["TPU_V5E_8"], 0,
+            None, None, False, api_client=client)
+        assert job_id.startswith("cloud_tpu_train_")
+        assert jobs.create.call_args.kwargs["parent"] == \
+            "projects/my-project"
+        body = jobs.create.call_args.kwargs["body"]
+        assert body["jobId"] == job_id
+        out = capsys.readouterr().out
+        assert "Job submitted successfully" in out
+        assert job_id in out
+
+    def test_submit_error_propagates(self, monkeypatch):
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "my-project")
+        client, jobs = self._api_client()
+        jobs.create.return_value.execute.side_effect = RuntimeError("boom")
+        with pytest.raises(RuntimeError, match="boom"):
+            deploy.deploy_job(
+                "us-central1", "gcr.io/p/img:tag", CONFIGS["TPU_V5E_8"], 0,
+                None, None, False, api_client=client)

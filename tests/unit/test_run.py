@@ -1,0 +1,138 @@
+"""End-to-end orchestration tests for `run()` with mocked cloud boundaries.
+
+The TPU-native analogue of reference
+core/tests/integration/run_on_script_test.py, runnable offline: the
+container builder and the deploy API are mocked; everything in between
+(validation, strategy compilation, artifact generation) runs for real.
+"""
+
+import os
+from unittest import mock
+
+import pytest
+
+import cloud_tpu
+from cloud_tpu.core import machine_config
+from cloud_tpu.core import run as run_module
+
+CONFIGS = machine_config.COMMON_MACHINE_CONFIGS
+
+
+@pytest.fixture
+def project_env(monkeypatch):
+    monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "my-project")
+    monkeypatch.delenv("CLOUD_TPU_RUNNING_REMOTELY", raising=False)
+    monkeypatch.delenv("TF_KERAS_RUNNING_REMOTELY", raising=False)
+
+
+@pytest.fixture
+def entry(tmp_path, monkeypatch):
+    (tmp_path / "train.py").write_text("print('training')\n")
+    monkeypatch.chdir(tmp_path)
+    return "train.py"
+
+
+def _mock_builder(monkeypatch):
+    builder = mock.MagicMock()
+    builder.get_docker_image.return_value = "gcr.io/my-project/img:tag"
+    builder.get_generated_files.return_value = []
+    local_cls = mock.MagicMock(return_value=builder)
+    cloud_cls = mock.MagicMock(return_value=builder)
+    monkeypatch.setattr(run_module.containerize, "LocalContainerBuilder",
+                        local_cls)
+    monkeypatch.setattr(run_module.containerize, "CloudContainerBuilder",
+                        cloud_cls)
+    return builder, local_cls, cloud_cls
+
+
+def _mock_deploy(monkeypatch):
+    deploy_job = mock.MagicMock(return_value="job_123")
+    monkeypatch.setattr(run_module.deploy, "deploy_job", deploy_job)
+    return deploy_job
+
+
+class TestRun:
+
+    def test_remote_guard(self, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_RUNNING_REMOTELY", "1")
+        assert run_module.remote()
+        assert run_module.run() is None
+
+    def test_reference_era_guard_honoured(self, monkeypatch):
+        monkeypatch.delenv("CLOUD_TPU_RUNNING_REMOTELY", raising=False)
+        monkeypatch.setenv("TF_KERAS_RUNNING_REMOTELY", "1")
+        assert run_module.remote()
+
+    def test_unknown_kwargs_rejected(self, project_env):
+        with pytest.raises(TypeError, match="Unknown keyword"):
+            run_module.run(some_future_param=1)
+
+    def test_end_to_end_local_build(self, project_env, entry, monkeypatch):
+        builder, local_cls, cloud_cls = _mock_builder(monkeypatch)
+        deploy_job = _mock_deploy(monkeypatch)
+
+        job_id = run_module.run(entry_point=entry)
+
+        assert job_id == "job_123"
+        local_cls.assert_called_once()
+        cloud_cls.assert_not_called()
+        # auto resolves TPU-first.
+        args, kwargs = local_cls.call_args
+        chief_config = args[2]
+        assert chief_config.accelerator_type == \
+            machine_config.AcceleratorType.TPU_V5E
+        # The preprocessed runner was generated and passed to the builder,
+        # then cleaned up after the build.
+        preprocessed = args[1]
+        assert preprocessed is not None
+        assert not os.path.exists(preprocessed)
+        deploy_args = deploy_job.call_args.args
+        assert deploy_args[1] == "gcr.io/my-project/img:tag"
+
+    def test_cloud_build_when_bucket_given(self, project_env, entry,
+                                           monkeypatch):
+        _, local_cls, cloud_cls = _mock_builder(monkeypatch)
+        _mock_deploy(monkeypatch)
+        run_module.run(entry_point=entry,
+                       docker_image_bucket_name="my-bucket")
+        cloud_cls.assert_called_once()
+        local_cls.assert_not_called()
+
+    def test_launcher_script_not_exited(self, project_env, entry,
+                                        monkeypatch):
+        # With explicit entry_point the caller keeps running (deviation
+        # from the reference's unconditional sys.exit, run.py:245-248).
+        _mock_builder(monkeypatch)
+        _mock_deploy(monkeypatch)
+        job_id = run_module.run(entry_point=entry)  # must not SystemExit
+        assert job_id == "job_123"
+
+    def test_validation_failures_surface(self, project_env, entry,
+                                         monkeypatch):
+        _mock_builder(monkeypatch)
+        _mock_deploy(monkeypatch)
+        with pytest.raises(ValueError, match="stream_logs"):
+            run_module.run(entry_point=entry, stream_logs="yes")
+
+    def test_strategy_none_with_entry_point_skips_preprocess(
+            self, project_env, entry, monkeypatch):
+        _, local_cls, _ = _mock_builder(monkeypatch)
+        _mock_deploy(monkeypatch)
+        run_module.run(entry_point=entry, distribution_strategy=None)
+        assert local_cls.call_args.args[1] is None  # no preprocessed file
+
+    def test_gpu_chief_default_workers_stays_gpu_job(self, project_env,
+                                                     entry, monkeypatch):
+        # worker_config='auto' must not fabricate a TPU worker when
+        # worker_count==0 (it would mis-classify the job as TPU).
+        _, local_cls, _ = _mock_builder(monkeypatch)
+        _mock_deploy(monkeypatch)
+        run_module.run(
+            entry_point=entry,
+            chief_config=CONFIGS["T4_1X"],
+            docker_base_image="nvidia/cuda:12.2.0-runtime-ubuntu22.04")
+        assert local_cls.call_args.args[3] is None  # worker_config
+
+    def test_public_api_exports(self):
+        assert cloud_tpu.run is run_module.run
+        assert cloud_tpu.remote is run_module.remote
