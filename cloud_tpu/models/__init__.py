@@ -1,0 +1,5 @@
+from cloud_tpu.models.mnist import MLP, ConvNet
+from cloud_tpu.models.resnet import (ResNet, ResNet18, ResNet50, ResNet101,
+                                     ResNet152)
+from cloud_tpu.models.transformer import (TransformerLM,
+                                          tensor_parallel_rules)

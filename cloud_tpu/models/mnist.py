@@ -1,0 +1,52 @@
+"""MNIST models: the framework's hello-world family.
+
+Parity target: the reference README's `mnist_example.py` (a Keras
+Sequential dense net trained via `tfc.run()`, reference README.md "High
+level overview" and core/tests/testdata/mnist_example_using_fit.py).
+Implemented in flax for the MXU: dense layers in bfloat16 compute with
+float32 params.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Keras-README-equivalent dense net: Flatten -> 512 relu -> 10."""
+
+    hidden: int = 512
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.compute_dtype)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class ConvNet(nn.Module):
+    """Small conv net for MNIST-scale images."""
+
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:  # add channel dim
+            x = x[..., None]
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,133 @@
+"""Decoder-only Transformer LM with tensor/sequence-parallel layouts.
+
+The long-context/model-parallel flagship (absent from the reference, which
+stops at data parallelism — SURVEY §2.3; built here because a pjit mesh
+makes TP/SP natural extension points). Design is MXU/ICI-first:
+
+- All matmuls batched and bfloat16; params float32.
+- Megatron-style tensor parallelism expressed as sharding *rules* over
+  the ambient mesh (qkv/mlp-in kernels split on "tp" columns, proj/mlp-out
+  on "tp" rows), so XLA inserts exactly the two all-reduces per block.
+- Causal attention with static shapes; `cloud_tpu.ops` provides the
+  flash/pallas path and `cloud_tpu.parallel.ring_attention` the
+  sequence-parallel path for long context.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, dtype=self.compute_dtype, name=name)
+
+        # [B, S, H, D] per-head projections.
+        q = dense((self.num_heads, head_dim), "query")(x)
+        k = dense((self.num_heads, head_dim), "key")(x)
+        v = dense((self.num_heads, head_dim), "value")(x)
+
+        q = q / np.sqrt(head_dim).astype(self.compute_dtype)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        seq = x.shape[1]
+        causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+        if mask is not None:
+            causal = causal & mask[:, None, None, :]
+        logits = jnp.where(causal, logits, jnp.finfo(jnp.float32).min)
+        weights = nn.softmax(logits.astype(jnp.float32), axis=-1)
+        weights = weights.astype(self.compute_dtype)
+
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        return nn.DenseGeneral(d_model, axis=(-2, -1),
+                               dtype=self.compute_dtype, name="out")(out)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    d_ff: int
+    dropout_rate: float = 0.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        y = nn.LayerNorm(dtype=self.compute_dtype, name="ln_attn")(x)
+        y = CausalSelfAttention(self.num_heads, self.compute_dtype,
+                                name="attention")(y, mask)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        x = x + y
+
+        y = nn.LayerNorm(dtype=self.compute_dtype, name="ln_mlp")(x)
+        y = nn.Dense(self.d_ff, dtype=self.compute_dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1], dtype=self.compute_dtype,
+                     name="mlp_out")(y)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """GPT-style decoder-only language model."""
+
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 512
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dropout_rate: float = 0.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, mask=None, deterministic=True):
+        seq = tokens.shape[1]
+        if seq > self.max_seq_len:
+            raise ValueError(
+                "Sequence length {} exceeds max_seq_len {}.".format(
+                    seq, self.max_seq_len))
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     dtype=self.compute_dtype, name="embed")(tokens)
+        pos = nn.Embed(self.max_seq_len, self.d_model,
+                       dtype=self.compute_dtype,
+                       name="pos_embed")(jnp.arange(seq)[None, :])
+        x = x + pos
+        for i in range(self.num_layers):
+            x = TransformerBlock(self.num_heads, self.d_ff,
+                                 self.dropout_rate, self.compute_dtype,
+                                 name="block_%d" % i)(
+                                     x, mask, deterministic)
+        x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_final")(x)
+        # Tied-free output head; vocab dim sharded on tp by the rules.
+        logits = nn.Dense(self.vocab_size, use_bias=False,
+                          dtype=self.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def tensor_parallel_rules(tp_axis: str = "tp"):
+    """Megatron-style sharding rules for Trainer(param_sharding_rules=...).
+
+    Column-parallel qkv/mlp-in, row-parallel out-proj/mlp-out: exactly one
+    all-reduce after attention and one after the MLP per block, riding ICI.
+    """
+    return [
+        # Attention projections: split heads across tp.
+        (r"attention/(query|key|value)/kernel", P(None, tp_axis, None)),
+        (r"attention/out/kernel", P(tp_axis, None, None)),
+        # MLP: column-parallel in, row-parallel out.
+        (r"mlp_in/kernel", P(None, tp_axis)),
+        (r"mlp_out/kernel", P(tp_axis, None)),
+        # Embeddings / head: vocab-sharded.
+        (r"(^|/)embed/embedding", P(tp_axis, None)),
+        (r"lm_head/kernel", P(None, tp_axis)),
+    ]

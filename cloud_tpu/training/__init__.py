@@ -1,0 +1,5 @@
+from cloud_tpu.training.callbacks import (Callback, EarlyStopping,
+                                          LambdaCallback, MetricsLogger,
+                                          ModelCheckpoint, read_metrics_log)
+from cloud_tpu.training.data import ArrayDataset
+from cloud_tpu.training.trainer import Trainer, TrainState

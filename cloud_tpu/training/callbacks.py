@@ -1,0 +1,177 @@
+"""Training callbacks: checkpointing, early stopping, metric streaming.
+
+The reference relies on Keras callbacks, injecting per-trial TensorBoard +
+ModelCheckpoint instances (reference tuner/tuner.py:576-605) and reading
+metrics back by parsing TensorBoard event files from GCS (reference
+tuner/tuner.py:532-560 — fragile, keyed on the `epoch_` tag prefix). The
+TPU-native design keeps the per-trial directory layout but streams metrics
+over an explicit JSONL channel (SURVEY §7.4 item 6), which
+`DistributingCloudTuner` reads back without event-file parsing.
+"""
+
+import json
+import os
+
+import jax
+
+
+class Callback:
+    """Base callback (Keras-parity hook names)."""
+
+    def set_trainer(self, trainer):
+        self.trainer = trainer
+
+    def on_train_begin(self):
+        pass
+
+    def on_epoch_begin(self, epoch):
+        pass
+
+    def on_epoch_end(self, epoch, logs):
+        pass
+
+    def on_train_end(self, history):
+        pass
+
+
+class LambdaCallback(Callback):
+    """Ad-hoc hooks from callables (Keras parity)."""
+
+    def __init__(self, on_train_begin=None, on_epoch_begin=None,
+                 on_epoch_end=None, on_train_end=None):
+        self._on_train_begin = on_train_begin
+        self._on_epoch_begin = on_epoch_begin
+        self._on_epoch_end = on_epoch_end
+        self._on_train_end = on_train_end
+
+    def on_train_begin(self):
+        if self._on_train_begin:
+            self._on_train_begin()
+
+    def on_epoch_begin(self, epoch):
+        if self._on_epoch_begin:
+            self._on_epoch_begin(epoch)
+
+    def on_epoch_end(self, epoch, logs):
+        if self._on_epoch_end:
+            self._on_epoch_end(epoch, logs)
+
+    def on_train_end(self, history):
+        if self._on_train_end:
+            self._on_train_end(history)
+
+
+def _resolve_mode(mode, monitor):
+    if mode == "auto":
+        return "max" if ("acc" in monitor or monitor.endswith("auc")) \
+            else "min"
+    return mode
+
+
+def _improved(value, best, mode, min_delta=0.0):
+    """Shared monitored-metric comparison for EarlyStopping/ModelCheckpoint."""
+    if best is None:
+        return True
+    if mode == "min":
+        return value < best - min_delta
+    return value > best + min_delta
+
+
+class EarlyStopping(Callback):
+    """Stops training when a monitored metric stops improving."""
+
+    def __init__(self, monitor="val_loss", patience=0, min_delta=0.0,
+                 mode="auto"):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.mode = _resolve_mode(mode, monitor)
+        self.best = None
+        self.wait = 0
+
+    def _improved(self, value):
+        return _improved(value, self.best, self.mode, self.min_delta)
+
+    def on_train_begin(self):
+        self.best = None
+        self.wait = 0
+
+    def on_epoch_end(self, epoch, logs):
+        value = logs.get(self.monitor)
+        if value is None:
+            return
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.trainer.stop_training = True
+
+
+class ModelCheckpoint(Callback):
+    """Saves the train state each epoch (reference tuner/tuner.py:576-579:
+    per-trial Keras ModelCheckpoint with save_freq='epoch').
+
+    Non-chief processes write nothing (the checkpoint module handles the
+    multi-host write protocol; see reference remote.py:130-145's decoy-dir
+    workaround, which orbax-style single-writer semantics replace).
+    """
+
+    def __init__(self, filepath, monitor=None, mode="auto", min_delta=0.0,
+                 save_freq="epoch"):
+        from cloud_tpu.training import checkpoint as checkpoint_lib
+        self._checkpoint_lib = checkpoint_lib
+        self.filepath = filepath
+        self.monitor = monitor
+        self.mode = _resolve_mode(mode, monitor or "loss")
+        self.min_delta = abs(min_delta)
+        if save_freq != "epoch":
+            raise ValueError("Only save_freq='epoch' is supported.")
+        self.best = None
+
+    def on_epoch_end(self, epoch, logs):
+        if self.monitor is not None:
+            value = logs.get(self.monitor)
+            if value is None:
+                return
+            if not _improved(value, self.best, self.mode, self.min_delta):
+                return
+            self.best = value
+        self._checkpoint_lib.save(self.filepath, self.trainer.state,
+                                  step=int(self.trainer.state.step))
+
+
+class MetricsLogger(Callback):
+    """Streams per-epoch logs to a JSONL file — the metric return channel
+    read back by DistributingCloudTuner (replacing event-file parsing,
+    reference tuner/tuner.py:532-560)."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def on_train_begin(self):
+        if jax.process_index() != 0:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # Truncate any previous run's stream.
+        open(self.path, "w").close()
+
+    def on_epoch_end(self, epoch, logs):
+        if jax.process_index() != 0:
+            return
+        record = {"epoch": epoch}
+        record.update({k: float(v) for k, v in logs.items()})
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+def read_metrics_log(path):
+    """Parses a MetricsLogger JSONL stream into a list of epoch records."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records

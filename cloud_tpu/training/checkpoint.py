@@ -1,0 +1,59 @@
+"""Checkpoint/restore of train state via orbax.
+
+The reference delegates checkpointing to Keras SavedModel + callbacks on
+GCS, with a decoy-directory workaround so non-chief MWMS workers don't
+corrupt the real save (reference cloud_fit/remote.py:130-145). Orbax's
+single-writer protocol replaces that workaround; the per-step directory
+layout (`<dir>/<step>`) keeps the tuner's per-trial checkpoint convention
+(reference tuner/tuner.py:601-605).
+"""
+
+import os
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def _checkpointer():
+    return ocp.StandardCheckpointer()
+
+
+def save(directory, state, step=0, force=True):
+    """Saves a pytree `state` under `<directory>/<step>`."""
+    directory = os.path.abspath(directory)
+    path = os.path.join(directory, str(step))
+    with _checkpointer() as checkpointer:
+        checkpointer.save(path, state, force=force)
+    return path
+
+
+def latest_step(directory):
+    """Largest step number checkpointed under `directory`, or None."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = [int(name) for name in os.listdir(directory)
+             if name.isdigit()]
+    return max(steps) if steps else None
+
+
+def restore(directory, target, step=None):
+    """Restores a pytree congruent with `target` from `<directory>/<step>`.
+
+    Args:
+        directory: Checkpoint root.
+        target: A pytree of arrays (or ShapeDtypeStructs) matching the
+            saved structure; its shardings are respected on restore.
+        step: Step to restore; default latest.
+    """
+    directory = os.path.abspath(directory)
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                "No checkpoints found under {}.".format(directory))
+    path = os.path.join(directory, str(step))
+    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                      target)
+    with _checkpointer() as checkpointer:
+        return checkpointer.restore(path, abstract)

@@ -1,0 +1,136 @@
+"""Input pipeline: batched, shuffled, host-sharded iteration.
+
+The reference delegates input pipelines to `tf.data` and per-worker
+auto-sharding inside `tf.distribute` (reference cloud_fit/client.py:151-189
+ships datasets as serialized tf.functions). The TPU-native pipeline is a
+small, dependency-free design: numpy-backed batching on the host, static
+shapes for XLA (tail batch dropped or padded), and per-process sharding
+for multi-host pods. Overlap of host batching with device compute comes
+from JAX async dispatch: the Trainer never blocks on device values inside
+the step loop, so batch i+1 is prepared while step i runs.
+"""
+
+import numpy as np
+
+import jax
+
+
+class ArrayDataset:
+    """In-memory dataset of (features, labels) arrays.
+
+    Args:
+        x: Array or pytree of arrays with a common leading dimension.
+        y: Optional array of labels (kept separate so loss/metric code can
+            treat batches as (x, y) tuples).
+        batch_size: Global batch size (across all processes/devices).
+        shuffle: Reshuffle each epoch.
+        seed: Shuffle seed (kept per-epoch deterministic so every process
+            draws the same permutation — required for multi-host sharding
+            to stay aligned).
+        drop_remainder: Drop the tail batch (True keeps shapes static for
+            XLA; False pads the tail by wrapping to the start).
+    """
+
+    def __init__(self, x, y=None, batch_size=32, shuffle=False, seed=0,
+                 drop_remainder=True):
+        self.x = x
+        self.y = y
+        leaves = jax.tree_util.tree_leaves(x)
+        if not leaves:
+            raise ValueError("Empty dataset.")
+        self.num_examples = leaves[0].shape[0]
+        if y is not None and y.shape[0] != self.num_examples:
+            raise ValueError(
+                "x has {} examples but y has {}.".format(
+                    self.num_examples, y.shape[0]))
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive.")
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self._epoch = 0
+
+    @property
+    def steps_per_epoch(self):
+        if self.drop_remainder:
+            return self.num_examples // self.batch_size
+        return -(-self.num_examples // self.batch_size)
+
+    def _epoch_order(self):
+        order = np.arange(self.num_examples)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(order)
+        return order
+
+    def __iter__(self):
+        """Yields global (x, y) numpy batches for one epoch."""
+        order = self._epoch_order()
+        self._epoch += 1
+        steps = self.steps_per_epoch
+        for step in range(steps):
+            idx = order[step * self.batch_size:(step + 1) * self.batch_size]
+            if len(idx) < self.batch_size:
+                # Pad the tail by tiling the epoch order (robust even when
+                # the whole dataset is smaller than one batch).
+                idx = np.concatenate(
+                    [idx, np.resize(order, self.batch_size - len(idx))])
+            xb = jax.tree_util.tree_map(lambda a: a[idx], self.x)
+            if self.y is None:
+                yield xb
+            else:
+                yield xb, self.y[idx]
+
+    def process_local_view(self, process_index=None, process_count=None):
+        """Returns this process's shard of each global batch.
+
+        Multi-host feeding: every process iterates the same global order
+        (same seed) and takes its contiguous slice of each batch; the
+        slices are reassembled into a global array by
+        `cloud_tpu.parallel.sharding.make_global_batch`.
+        """
+        process_index = (jax.process_index()
+                         if process_index is None else process_index)
+        process_count = (jax.process_count()
+                         if process_count is None else process_count)
+        if self.batch_size % process_count:
+            raise ValueError(
+                "batch_size={} is not divisible by process_count={}.".format(
+                    self.batch_size, process_count))
+        shard = self.batch_size // process_count
+        lo, hi = process_index * shard, (process_index + 1) * shard
+
+        def _slices():
+            for batch in self:
+                yield jax.tree_util.tree_map(lambda a: a[lo:hi], batch)
+        return _slices()
+
+
+def as_dataset(data, y=None, batch_size=32, **kwargs):
+    """Coerces user input to a re-iterable dataset of batches.
+
+    Accepts (in resolution order):
+    - an `ArrayDataset` (used as-is);
+    - raw arrays or an array pytree (dict, or list/tuple of arrays) —
+      wrapped in an `ArrayDataset`; always the case when `y` is given;
+    - a one-shot iterator/generator of batches — materialized into a list
+      once so multi-epoch training sees every batch every epoch;
+    - any other re-iterable of batches (used as-is, re-iterated per
+      epoch).
+    """
+    if isinstance(data, ArrayDataset):
+        return data
+    if y is not None or hasattr(data, "shape") or isinstance(data, dict):
+        return ArrayDataset(data, y, batch_size=batch_size, **kwargs)
+    if isinstance(data, (list, tuple)):
+        leaves = [e for e in data]
+        if leaves and all(hasattr(e, "shape") for e in leaves):
+            # Pytree-of-arrays (multi-input model), not a batch list.
+            return ArrayDataset(data, y, batch_size=batch_size, **kwargs)
+        return data
+    if hasattr(data, "__next__"):
+        return list(data)
+    if hasattr(data, "__iter__"):
+        return data
+    return ArrayDataset(data, y, batch_size=batch_size, **kwargs)

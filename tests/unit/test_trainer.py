@@ -1,0 +1,280 @@
+"""Trainer tests on the 8-device virtual CPU mesh.
+
+The TPU-native analogue of the reference's remote-fit unit tests (which
+run `model.fit` in-process under a fabricated cluster, reference
+cloud_fit/tests/unit/remote_test.py:80-127): real training steps, real
+sharding, no hardware.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+from cloud_tpu.models import MLP, ConvNet, TransformerLM, ResNet18
+from cloud_tpu.models import tensor_parallel_rules
+from cloud_tpu.parallel import runtime
+from cloud_tpu.training import (ArrayDataset, EarlyStopping, MetricsLogger,
+                                ModelCheckpoint, Trainer, read_metrics_log)
+from cloud_tpu.training import checkpoint as checkpoint_lib
+
+
+@pytest.fixture(autouse=True)
+def _reset_runtime():
+    runtime.reset()
+    yield
+    runtime.reset()
+
+
+def _toy_classification(n=256, d=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes))
+    y = np.argmax(x @ w, axis=-1).astype(np.int32)
+    return x, y
+
+
+class TestFit:
+
+    def test_loss_decreases_single_device(self):
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=32, num_classes=4),
+                          optimizer=optax.adam(1e-2))
+        history = trainer.fit(x, y, epochs=5, batch_size=64, verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
+        assert history["accuracy"][-1] > 0.5
+
+    def test_fit_on_dp_mesh(self):
+        runtime.initialize(strategy="tpu_slice")
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=32, num_classes=4),
+                          optimizer=optax.adam(1e-2))
+        history = trainer.fit(x, y, epochs=3, batch_size=64, verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
+        # Params live replicated on the mesh.
+        leaf = next(iter(
+            trainer.state.params["Dense_0"]["kernel"].addressable_shards))
+        assert leaf is not None
+
+    def test_evaluate_and_predict(self):
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=32, num_classes=4))
+        trainer.fit(x, y, epochs=1, batch_size=64, verbose=False)
+        logs = trainer.evaluate(x, y, batch_size=64, verbose=False)
+        assert set(logs) == {"loss", "accuracy"}
+        preds = trainer.predict(x[:100], batch_size=64)
+        assert preds.shape == (100, 4)
+
+    def test_validation_data(self):
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        history = trainer.fit(x, y, epochs=2, batch_size=64,
+                              validation_data=(x[:64], y[:64]),
+                              verbose=False)
+        assert "val_loss" in history
+        assert "val_accuracy" in history
+
+    def test_convnet_images(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 12, 12, 1)).astype(np.float32)
+        y = rng.integers(0, 10, size=64).astype(np.int32)
+        trainer = Trainer(ConvNet(num_classes=10))
+        history = trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        assert np.isfinite(history["loss"][0])
+
+
+class TestBatchNormModels:
+
+    def test_resnet_trains_with_batch_stats(self):
+        runtime.initialize(strategy="tpu_slice")
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 16, 16, 3)).astype(np.float32)
+        y = rng.integers(0, 5, size=16).astype(np.int32)
+        trainer = Trainer(ResNet18(num_classes=5, num_filters=8),
+                          optimizer=optax.sgd(1e-2),
+                          train_kwargs={"train": True},
+                          eval_kwargs={"train": False})
+        history = trainer.fit(x, y, epochs=1, batch_size=8, verbose=False)
+        assert np.isfinite(history["loss"][0])
+        assert "batch_stats" in trainer.state.extra_vars
+        # Running stats moved away from init.
+        stats = trainer.state.extra_vars["batch_stats"]
+        mean = np.asarray(stats["bn_init"]["mean"])
+        assert np.abs(mean).sum() > 0
+
+
+class TestTensorParallel:
+
+    def test_transformer_tp_sharding(self):
+        ctx = runtime.initialize(strategy="tpu_slice",
+                                 axis_names=("dp", "tp"),
+                                 mesh_shape=(2, 4))
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+        targets = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+
+        def lm_loss(logits, labels):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean(axis=-1)
+
+        model = TransformerLM(vocab_size=64, num_layers=2, num_heads=4,
+                              d_model=32, d_ff=64, max_seq_len=16)
+        trainer = Trainer(model, optimizer=optax.adam(1e-2), loss=lm_loss,
+                          metrics=(),
+                          param_sharding_rules=tensor_parallel_rules("tp"))
+        history = trainer.fit(tokens, targets, epochs=2, batch_size=8,
+                              shuffle=False, verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
+
+        # mlp_in kernel must actually be column-sharded over tp=4.
+        kernel = trainer.state.params["block_0"]["mlp_in"]["kernel"]
+        spec = kernel.sharding.spec
+        assert spec == (None, "tp") or tuple(spec) == (None, "tp")
+        shard = next(iter(kernel.addressable_shards))
+        assert shard.data.shape == (32, 64 // 4)
+
+
+class TestReviewRegressions:
+
+    def test_generator_dataset_trains_all_epochs(self):
+        x, y = _toy_classification(n=128)
+
+        def gen():
+            for i in range(4):
+                yield x[i * 32:(i + 1) * 32], y[i * 32:(i + 1) * 32]
+
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        history = trainer.fit(gen(), epochs=3, verbose=False)
+        assert len(history["loss"]) == 3
+        # Every epoch actually ran 4 steps (non-zero, finite loss).
+        assert all(np.isfinite(v) for v in history["loss"])
+
+    def test_small_validation_set_still_evaluated(self):
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        history = trainer.fit(x, y, epochs=1, batch_size=64,
+                              validation_data=(x[:10], y[:10]),
+                              verbose=False)
+        assert "val_loss" in history and np.isfinite(history["val_loss"][0])
+
+    def test_predict_smaller_than_batch(self):
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        trainer.fit(x, y, epochs=1, batch_size=64, verbose=False)
+        preds = trainer.predict(x[:5], batch_size=64)
+        assert preds.shape == (5, 4)
+
+    def test_dict_pytree_input(self):
+        rng = np.random.default_rng(0)
+        x = {"a": rng.normal(size=(64, 4)).astype(np.float32),
+             "b": rng.normal(size=(64, 4)).astype(np.float32)}
+        y = rng.integers(0, 3, size=64).astype(np.int32)
+
+        import flax.linen as nn
+
+        class TwoInput(nn.Module):
+            @nn.compact
+            def __call__(self, inputs):
+                h = jnp_concat([inputs["a"], inputs["b"]])
+                return nn.Dense(3)(h)
+
+        import jax.numpy as jnp
+
+        def jnp_concat(parts):
+            return jnp.concatenate(parts, axis=-1)
+
+        trainer = Trainer(TwoInput())
+        history = trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        assert np.isfinite(history["loss"][0])
+
+    def test_tp_optimizer_state_inherits_param_sharding(self):
+        runtime.initialize(strategy="tpu_slice", axis_names=("dp", "tp"),
+                           mesh_shape=(2, 4))
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+        model = TransformerLM(vocab_size=64, num_layers=1, num_heads=4,
+                              d_model=32, d_ff=64, max_seq_len=16)
+        trainer = Trainer(model, optimizer=optax.adam(1e-2),
+                          loss=lambda o, t: o.mean(axis=(-1, -2)),
+                          metrics=(),
+                          param_sharding_rules=tensor_parallel_rules("tp"))
+        trainer.build(tokens)
+        # Adam's first moment for the tp-sharded mlp_in kernel must be
+        # tp-sharded too (not replicated).
+        mu = trainer.state.opt_state[0].mu
+        kernel_mu = mu["block_0"]["mlp_in"]["kernel"]
+        shard = next(iter(kernel_mu.addressable_shards))
+        assert shard.data.shape == (32, 64 // 4)
+
+
+class TestCallbacks:
+
+    def test_early_stopping(self):
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          optimizer=optax.sgd(0.0))  # loss frozen
+        history = trainer.fit(
+            x, y, epochs=10, batch_size=64, verbose=False,
+            callbacks=[EarlyStopping(monitor="loss", patience=1)])
+        assert len(history["loss"]) < 10
+
+    def test_metrics_logger_jsonl(self, tmp_path):
+        x, y = _toy_classification()
+        path = str(tmp_path / "logs" / "metrics.jsonl")
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        trainer.fit(x, y, epochs=3, batch_size=64, verbose=False,
+                    callbacks=[MetricsLogger(path)])
+        records = read_metrics_log(path)
+        assert [r["epoch"] for r in records] == [0, 1, 2]
+        assert all("loss" in r and "accuracy" in r for r in records)
+
+    def test_model_checkpoint_and_restore(self, tmp_path):
+        x, y = _toy_classification()
+        ckpt_dir = str(tmp_path / "ckpt")
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        trainer.fit(x, y, epochs=2, batch_size=64, verbose=False,
+                    callbacks=[ModelCheckpoint(ckpt_dir)])
+        step = checkpoint_lib.latest_step(ckpt_dir)
+        assert step == 8  # 2 epochs x 4 steps
+
+        restored = checkpoint_lib.restore(ckpt_dir, trainer.state)
+        np.testing.assert_allclose(
+            np.asarray(restored.params["Dense_0"]["kernel"]),
+            np.asarray(trainer.state.params["Dense_0"]["kernel"]))
+
+
+class TestArrayDataset:
+
+    def test_batching_and_shuffle_determinism(self):
+        x = np.arange(100, dtype=np.float32)[:, None]
+        y = np.arange(100, dtype=np.int32)
+        ds1 = ArrayDataset(x, y, batch_size=32, shuffle=True, seed=7)
+        ds2 = ArrayDataset(x, y, batch_size=32, shuffle=True, seed=7)
+        b1 = next(iter(ds1))
+        b2 = next(iter(ds2))
+        np.testing.assert_array_equal(b1[0], b2[0])
+        assert ds1.steps_per_epoch == 3  # drop_remainder
+
+    def test_epochs_reshuffle(self):
+        x = np.arange(64, dtype=np.float32)[:, None]
+        ds = ArrayDataset(x, None, batch_size=64, shuffle=True, seed=0)
+        e1 = next(iter(ds))
+        e2 = next(iter(ds))
+        assert not np.array_equal(e1, e2)
+
+    def test_process_local_view(self):
+        x = np.arange(32, dtype=np.float32)[:, None]
+        y = np.arange(32, dtype=np.int32)
+        ds = ArrayDataset(x, y, batch_size=8)
+        shards = list(ds.process_local_view(process_index=1,
+                                            process_count=4))
+        assert len(shards) == 4
+        xb, yb = shards[0]
+        assert xb.shape == (2, 1)
+        np.testing.assert_array_equal(yb, [2, 3])
+
+    def test_pad_tail(self):
+        x = np.arange(10, dtype=np.float32)[:, None]
+        ds = ArrayDataset(x, None, batch_size=4, drop_remainder=False)
+        batches = list(ds)
+        assert len(batches) == 3
+        assert all(b.shape == (4, 1) for b in batches)
