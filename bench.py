@@ -1,0 +1,72 @@
+"""Benchmark harness: ResNet50 training throughput on one TPU chip.
+
+BASELINE.md target: Keras `model.fit` steps/sec via the launch API on
+v5e-8 matching 8xV100 wall-clock. The reference publishes no numbers
+(BASELINE.md "Published reference numbers: None"), so the recorded
+baseline is the 8xV100 side of the driver's target: ResNet50 mixed
+precision at ~2800 images/sec across 8 V100s = 350 images/sec per
+V100-equivalent. This harness measures our per-chip ResNet50 train-step
+throughput (bf16, NHWC, batch 256) through the framework's own jitted
+Trainer step; vs_baseline > 1.0 means one v5e chip beats one V100, i.e.
+v5e-8 beats 8xV100 wall-clock for config 2.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("BENCH_BATCH", 256))
+IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
+WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", 3))
+TIMED_STEPS = int(os.environ.get("BENCH_STEPS", 20))
+BASELINE_IMAGES_PER_SEC = 350.0  # one V100, fp16 ResNet50 (8xV100 / 8)
+
+
+def main():
+    import jax
+    import optax
+
+    from cloud_tpu.models import ResNet50
+    from cloud_tpu.training import Trainer
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(BATCH, IMAGE, IMAGE, 3)).astype(np.float32)
+    y = rng.integers(0, 1000, size=BATCH).astype(np.int32)
+
+    trainer = Trainer(
+        ResNet50(num_classes=1000),
+        optimizer=optax.sgd(0.1, momentum=0.9),
+        train_kwargs={"train": True},
+        eval_kwargs={"train": False},
+        metrics=())
+    trainer.build(x)
+    step_fn = trainer._make_train_step()
+
+    batch = trainer._feed((x, y))
+    state = trainer.state
+    for _ in range(WARMUP_STEPS):
+        state, logs = step_fn(state, batch)
+    jax.block_until_ready(logs["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, logs = step_fn(state, batch)
+    jax.block_until_ready(logs["loss"])
+    elapsed = time.perf_counter() - t0
+
+    images_per_sec = BATCH * TIMED_STEPS / elapsed
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
