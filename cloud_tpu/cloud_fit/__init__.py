@@ -1,0 +1,2 @@
+from cloud_tpu.cloud_fit.client import cloud_fit, serialize_assets
+from cloud_tpu.cloud_fit.remote import run as remote_run

@@ -1,0 +1,123 @@
+"""cloud_fit remote worker: re-hydrate and fit inside the training job.
+
+Reference parity: experimental/cloud_fit/remote.py:40-169 — the
+container's `__main__`, flag-driven (`--remote_dir`,
+`--distribution_strategy`, reference remote.py:40-52,166-169): recreate
+the distribution setup, load the serialized assets, `fit`, and save
+outputs with chief-only writes (the reference's decoy-dir MWMS
+workaround, remote.py:130-145, is replaced by orbax single-writer
+semantics plus explicit process-0 gating for the history file).
+"""
+
+import io
+import json
+import logging
+import pickle
+
+import numpy as np
+
+from cloud_tpu.cloud_fit import client as client_lib
+from cloud_tpu.cloud_fit import utils
+from cloud_tpu.parallel import runtime
+from cloud_tpu.utils import storage
+
+logger = logging.getLogger("cloud_tpu")
+
+OUTPUT_DIR = "output"
+HISTORY_FILE = "history.json"
+
+
+def build_trainer(spec, mesh=None):
+    """Reconstructs a Trainer from a serialized spec dict."""
+    from cloud_tpu.training import trainer as trainer_lib
+
+    def _resolve(ref):
+        if ref["kind"] == "name":
+            return ref["value"]
+        return client_lib.resolve_dotted(ref["value"])
+
+    return trainer_lib.Trainer(
+        model=spec["model"],
+        optimizer=_resolve(spec["optimizer"]),
+        loss=_resolve(spec["loss"]),
+        metrics=[_resolve(m) for m in spec["metrics"]],
+        mesh=mesh,
+        param_sharding_rules=spec.get("param_sharding_rules"),
+        train_kwargs=spec.get("train_kwargs"),
+        eval_kwargs=spec.get("eval_kwargs"),
+        rng_keys=spec.get("rng_keys", ()),
+        seed=spec.get("seed", 0),
+    )
+
+
+def run(remote_dir, distribution_strategy="tpu_slice"):
+    """Loads assets from `remote_dir`, trains, saves outputs.
+
+    Reference parity: `run()` (remote.py:55-146). Returns the history
+    dict.
+    """
+    if distribution_strategy not in utils.SUPPORTED_DISTRIBUTION_STRATEGIES:
+        raise ValueError(
+            "{} is not supported. Must be one of {}.".format(
+                distribution_strategy,
+                utils.SUPPORTED_DISTRIBUTION_STRATEGIES))
+
+    if not runtime.is_initialized():
+        runtime.initialize(strategy=distribution_strategy)
+
+    spec = pickle.loads(
+        storage.read_bytes(storage.join(remote_dir, client_lib.SPEC_FILE)))
+    fit_kwargs = pickle.loads(storage.read_bytes(
+        storage.join(remote_dir, client_lib.FIT_KWARGS_FILE)))
+    arrays = np.load(io.BytesIO(storage.read_bytes(
+        storage.join(remote_dir, client_lib.DATA_FILE))))
+
+    trainer = build_trainer(spec, mesh=runtime.global_mesh())
+
+    x = arrays["x"]
+    y = arrays["y"] if "y" in arrays.files else None
+    if "val_x" in arrays.files:
+        fit_kwargs.setdefault(
+            "validation_data", (arrays["val_x"], arrays["val_y"]))
+
+    history = trainer.fit(x, y, **fit_kwargs)
+
+    _save_outputs(remote_dir, trainer, history)
+    return history
+
+
+def _save_outputs(remote_dir, trainer, history):
+    """Final state + history under `<remote_dir>/output`
+    (reference remote.py:130-145: chief-only real write)."""
+    import jax
+
+    from cloud_tpu.training import checkpoint as checkpoint_lib
+
+    output_dir = storage.join(remote_dir, OUTPUT_DIR)
+    if not storage.is_gcs_path(output_dir):
+        # orbax owns the multi-process write protocol; the JSON history
+        # is chief-written only.
+        checkpoint_lib.save(output_dir, trainer.state,
+                            step=int(trainer.state.step))
+    if jax.process_index() == 0:
+        storage.write_bytes(
+            storage.join(remote_dir, OUTPUT_DIR, HISTORY_FILE),
+            json.dumps(history).encode("utf-8"))
+    logger.info("cloud_fit outputs saved under %s", output_dir)
+
+
+def main(argv=None):
+    """Flag-driven entry point (reference remote.py:40-52, 166-169)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="cloud_fit remote worker")
+    parser.add_argument("--remote_dir", required=True,
+                        help="Storage directory with serialized assets.")
+    parser.add_argument("--distribution_strategy", default="tpu_slice",
+                        choices=utils.SUPPORTED_DISTRIBUTION_STRATEGIES)
+    args = parser.parse_args(argv)
+    run(args.remote_dir, args.distribution_strategy)
+
+
+if __name__ == "__main__":
+    main()

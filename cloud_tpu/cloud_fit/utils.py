@@ -1,0 +1,18 @@
+"""cloud_fit shared constants.
+
+Reference parity: experimental/cloud_fit/utils.py:24-39 — the strategy
+registry the client validates against and the remote worker re-creates
+from. TPU-native: names map onto `cloud_tpu.parallel.runtime` strategies
+instead of `tf.distribute` classes; the TF1-detection shim is meaningless
+for JAX and intentionally absent.
+"""
+
+# Client-validated, worker-recreated strategy names
+# (reference utils.py:24-28 lists MirroredStrategy / MWMS only).
+SUPPORTED_DISTRIBUTION_STRATEGIES = (
+    "one_device",
+    "mirrored",
+    "multi_worker",
+    "tpu_slice",
+    "tpu_pod",
+)

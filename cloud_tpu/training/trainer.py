@@ -149,6 +149,12 @@ class Trainer:
             self._is_flax = False
         self.model = model
 
+        # Original constructor specs are kept for cross-process shipping
+        # (cloud_fit serializes names/callables, not optax closures).
+        self.optimizer_spec = optimizer
+        self.loss_spec = loss
+        self.metric_specs = tuple(metrics)
+
         if isinstance(optimizer, str):
             optimizer = OPTIMIZERS[optimizer]()
         self.optimizer = optimizer

@@ -1,0 +1,67 @@
+"""Storage abstraction: local filesystem + GCS (`gs://`) paths.
+
+The reference reads/writes GCS through TF's gfile and the
+google-cloud-storage SDK scattered across modules (reference
+cloud_fit/client.py:187-192, containerize.py:456-470). This module is the
+single seam: local paths always work (tests, on-VM scratch), `gs://`
+paths go through google-cloud-storage when installed.
+"""
+
+import os
+
+try:
+    from google.cloud import storage as gcs
+except ImportError:
+    gcs = None
+
+
+def is_gcs_path(path):
+    return str(path).startswith("gs://")
+
+
+def _split_gcs(path):
+    rest = str(path)[len("gs://"):]
+    bucket, _, blob = rest.partition("/")
+    return bucket, blob
+
+
+def _client():
+    if gcs is None:
+        raise RuntimeError(
+            "google-cloud-storage is required for gs:// paths.")
+    return gcs.Client()
+
+
+def write_bytes(path, data):
+    if is_gcs_path(path):
+        bucket_name, blob_name = _split_gcs(path)
+        _client().bucket(bucket_name).blob(blob_name).upload_from_string(
+            data)
+        return
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def read_bytes(path):
+    if is_gcs_path(path):
+        bucket_name, blob_name = _split_gcs(path)
+        return (_client().bucket(bucket_name).blob(blob_name)
+                .download_as_bytes())
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def exists(path):
+    if is_gcs_path(path):
+        bucket_name, blob_name = _split_gcs(path)
+        return _client().bucket(bucket_name).blob(blob_name).exists()
+    return os.path.exists(path)
+
+
+def join(base, *parts):
+    if is_gcs_path(base):
+        return "/".join([str(base).rstrip("/")] +
+                        [str(p).strip("/") for p in parts])
+    return os.path.join(base, *parts)

@@ -1,0 +1,183 @@
+"""cloud_fit tests: serialize -> re-hydrate -> fit round trips.
+
+Mirrors reference cloud_fit unit tests: asset round-trip through real
+files in tmp dirs (client_test.py:144-217), job-spec/submit verification
+with a mocked API (110-142), and the in-process remote-run
+"fake-cluster" test asserting outputs + callbacks fire
+(remote_test.py:80-127) — here on the 8-device CPU mesh.
+"""
+
+import json
+import pickle
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from cloud_tpu.cloud_fit import client, remote
+from cloud_tpu.models import MLP
+from cloud_tpu.parallel import runtime
+from cloud_tpu.training import LambdaCallback, Trainer
+from cloud_tpu.utils import storage
+
+
+@pytest.fixture(autouse=True)
+def _reset_runtime():
+    runtime.reset()
+    yield
+    runtime.reset()
+
+
+def _toy_data(n=128, d=8, classes=4):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes))
+    y = np.argmax(x @ w, axis=-1).astype(np.int32)
+    return x, y
+
+
+def _trainer():
+    return Trainer(MLP(hidden=16, num_classes=4), optimizer="adam",
+                   loss="sparse_categorical_crossentropy",
+                   metrics=("accuracy",))
+
+
+class EpochRecorder(LambdaCallback):
+    """Picklable callback (lambdas can't cross the wire — same constraint
+    as the reference's pickled Keras callbacks, client.py:73-75)."""
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = path
+
+    def on_epoch_end(self, epoch, logs):
+        with open(self.path, "a") as f:
+            f.write("%d\n" % epoch)
+
+
+class TestSerialization:
+
+    def test_assets_round_trip(self, tmp_path):
+        x, y = _toy_data()
+        remote_dir = str(tmp_path / "assets")
+        client.serialize_assets(remote_dir, _trainer(), x, y,
+                                epochs=2, batch_size=32)
+
+        spec = pickle.loads(
+            storage.read_bytes(storage.join(remote_dir, client.SPEC_FILE)))
+        assert spec["optimizer"] == {"kind": "name", "value": "adam"}
+        assert spec["loss"] == {"kind": "name",
+                                "value": "sparse_categorical_crossentropy"}
+        assert isinstance(spec["model"], MLP)
+
+        fit_kwargs = pickle.loads(storage.read_bytes(
+            storage.join(remote_dir, client.FIT_KWARGS_FILE)))
+        assert fit_kwargs == {"epochs": 2, "batch_size": 32}
+
+    def test_unpicklable_optimizer_rejected(self, tmp_path):
+        import optax
+
+        x, y = _toy_data()
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          optimizer=optax.adam(1e-3))
+        with pytest.raises(ValueError, match="cannot be shipped"):
+            client.serialize_assets(str(tmp_path), trainer, x, y)
+
+    def test_module_level_loss_ships_as_path(self, tmp_path):
+        from cloud_tpu.training import trainer as trainer_lib
+
+        x, y = _toy_data()
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          loss=trainer_lib._mse, metrics=())
+        client.serialize_assets(str(tmp_path), trainer, x, y)
+        spec = pickle.loads(storage.read_bytes(
+            storage.join(str(tmp_path), client.SPEC_FILE)))
+        assert spec["loss"]["kind"] == "path"
+        assert client.resolve_dotted(spec["loss"]["value"]) \
+            is trainer_lib._mse
+
+
+class TestCloudFitSubmit:
+
+    def test_submit_payload(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "my-project")
+        x, y = _toy_data()
+        api = mock.MagicMock()
+        job_id = client.cloud_fit(
+            _trainer(), str(tmp_path), image_uri="gcr.io/p/img:tag",
+            x=x, y=y, epochs=1, api_client=api)
+        assert job_id.startswith("cloud_fit_")
+        body = (api.projects.return_value.jobs.return_value
+                .create.call_args.kwargs["body"])
+        assert body["jobId"] == job_id
+        ti = body["trainingInput"]
+        assert ti["masterType"] == "tpu-vm"
+        assert ti["masterConfig"]["acceleratorConfig"]["type"] == \
+            "v5litepod-8"
+        assert ti["args"] == ["--remote_dir", str(tmp_path),
+                              "--distribution_strategy", "tpu_slice"]
+
+    def test_invalid_strategy_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="not supported"):
+            client.cloud_fit(_trainer(), str(tmp_path),
+                             distribution_strategy="parameter_server",
+                             x=np.zeros((4, 2), np.float32))
+
+
+class TestRemoteRun:
+
+    def test_end_to_end_fit_on_mesh(self, tmp_path):
+        """Fake-cluster analogue: serialize, then run the remote worker
+        in-process on the 8-device CPU mesh."""
+        x, y = _toy_data()
+        remote_dir = str(tmp_path / "job")
+        fired_log = str(tmp_path / "fired.txt")
+        client.serialize_assets(
+            remote_dir, _trainer(), x, y,
+            validation_data=(x[:32], y[:32]),
+            epochs=2, batch_size=32,
+            callbacks=[EpochRecorder(fired_log)])
+
+        history = remote.run(remote_dir, "tpu_slice")
+
+        assert len(history["loss"]) == 2
+        assert "val_loss" in history
+        # Pickled callbacks fire remotely.
+        assert open(fired_log).read().split() == ["0", "1"]
+        # Outputs: final state checkpoint + chief-written history.
+        from cloud_tpu.training import checkpoint as checkpoint_lib
+        out = storage.join(remote_dir, remote.OUTPUT_DIR)
+        assert checkpoint_lib.latest_step(out) == 8  # 2 epochs x 4 steps
+        saved_history = json.loads(storage.read_bytes(
+            storage.join(out, remote.HISTORY_FILE)))
+        assert saved_history["loss"] == history["loss"]
+
+    def test_main_flags(self, tmp_path):
+        x, y = _toy_data(n=32)
+        remote_dir = str(tmp_path / "job")
+        client.serialize_assets(remote_dir, _trainer(), x, y, epochs=1,
+                                batch_size=16)
+        remote.main(["--remote_dir", remote_dir,
+                     "--distribution_strategy", "one_device"])
+        assert storage.exists(
+            storage.join(remote_dir, remote.OUTPUT_DIR,
+                         remote.HISTORY_FILE))
+
+
+class TestStorage:
+
+    def test_local_paths(self, tmp_path):
+        path = str(tmp_path / "a" / "b.bin")
+        storage.write_bytes(path, b"hello")
+        assert storage.read_bytes(path) == b"hello"
+        assert storage.exists(path)
+        assert not storage.exists(str(tmp_path / "missing"))
+
+    def test_join(self):
+        assert storage.join("gs://bucket/dir", "x", "y") == \
+            "gs://bucket/dir/x/y"
+
+    def test_gcs_requires_sdk(self, monkeypatch):
+        monkeypatch.setattr(storage, "gcs", None)
+        with pytest.raises(RuntimeError, match="google-cloud-storage"):
+            storage.read_bytes("gs://bucket/blob")
