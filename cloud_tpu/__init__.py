@@ -13,3 +13,7 @@ from cloud_tpu.core.machine_config import MachineConfig
 from cloud_tpu.core.run import remote
 from cloud_tpu.core.run import run
 from cloud_tpu.version import __version__
+
+from cloud_tpu.tuner import (CloudOracle, CloudTuner,
+                             DistributingCloudTuner, HyperParameters,
+                             Objective)

@@ -10,7 +10,6 @@ over an explicit JSONL channel (SURVEY §7.4 item 6), which
 """
 
 import json
-import os
 
 import jax
 
@@ -63,8 +62,11 @@ class LambdaCallback(Callback):
 
 def _resolve_mode(mode, monitor):
     if mode == "auto":
-        return "max" if ("acc" in monitor or monitor.endswith("auc")) \
-            else "min"
+        # Single source of truth for the name->direction heuristic,
+        # shared with the tuner's Objective inference.
+        from cloud_tpu.tuner.hyperparameters import (
+            default_objective_direction)
+        return default_objective_direction(monitor)
     return mode
 
 
@@ -145,33 +147,43 @@ class ModelCheckpoint(Callback):
 class MetricsLogger(Callback):
     """Streams per-epoch logs to a JSONL file — the metric return channel
     read back by DistributingCloudTuner (replacing event-file parsing,
-    reference tuner/tuner.py:532-560)."""
+    reference tuner/tuner.py:532-560).
+
+    Local and `gs://` paths both work (GCS has no append, so the full
+    stream is rewritten each epoch through the storage seam)."""
 
     def __init__(self, path):
         self.path = path
+        self._records = []
 
     def on_train_begin(self):
+        from cloud_tpu.utils import storage
+
+        self._records = []
         if jax.process_index() != 0:
             return
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         # Truncate any previous run's stream.
-        open(self.path, "w").close()
+        storage.write_bytes(self.path, b"")
 
     def on_epoch_end(self, epoch, logs):
+        from cloud_tpu.utils import storage
+
         if jax.process_index() != 0:
             return
         record = {"epoch": epoch}
         record.update({k: float(v) for k, v in logs.items()})
-        with open(self.path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        self._records.append(record)
+        payload = "".join(json.dumps(r) + "\n" for r in self._records)
+        storage.write_bytes(self.path, payload.encode("utf-8"))
 
 
 def read_metrics_log(path):
     """Parses a MetricsLogger JSONL stream into a list of epoch records."""
+    from cloud_tpu.utils import storage
+
     records = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                records.append(json.loads(line))
+    for line in storage.read_bytes(path).decode("utf-8").splitlines():
+        line = line.strip()
+        if line:
+            records.append(json.loads(line))
     return records

@@ -1,0 +1,7 @@
+from cloud_tpu.tuner.hyperparameters import HyperParameters, Objective
+from cloud_tpu.tuner.optimizer_client import (OptimizerClient,
+                                              SuggestionInactiveError,
+                                              create_or_load_study)
+from cloud_tpu.tuner.tuner import (CloudOracle, CloudTuner,
+                                   DistributingCloudTuner, Trial,
+                                   TrialStatus)

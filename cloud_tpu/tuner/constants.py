@@ -1,0 +1,13 @@
+"""Tuner constants (reference tuner/constants.py:20-30)."""
+
+# Number of trials requested per suggest call
+# (reference constants.py:27).
+SUGGESTION_COUNT_PER_REQUEST = 1
+
+# Bounded retries for the race-safe study bootstrap
+# (reference constants.py:30).
+MAX_NUM_TRIES_FOR_STUDIES = 3
+
+# Regional service endpoint template (the reference bundles a discovery
+# document pinned to us-central1, constants.py:20-22).
+OPTIMIZER_API_ENDPOINT = "https://{region}-ml.googleapis.com"

@@ -1,0 +1,193 @@
+"""Native hyperparameter search-space API.
+
+The reference rides KerasTuner's `HyperParameters`/`Objective`
+(reference tuner/tuner.py imports kerastuner throughout; converters in
+tuner/utils.py:220-282 handle Choice/Int/Float/Boolean/Fixed). This
+framework is self-contained: the same five parameter kinds, defined
+declaratively and convertible to/from Vizier study configs
+(cloud_tpu/tuner/utils.py).
+
+Usage:
+    hp = HyperParameters()
+    hp.Int("units", 32, 512, step=32)
+    hp.Float("lr", 1e-4, 1e-1, sampling="log")
+    ...
+    build(hp)  # reads hp.get("units") / hp.values
+"""
+
+import random
+
+
+class HyperParameter:
+    """Base spec: name + default."""
+
+    kind = "base"
+
+    def __init__(self, name, default=None):
+        self.name = name
+        self.default = default
+
+    def random_sample(self, rng):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return "{}(name={!r}, default={!r})".format(
+            type(self).__name__, self.name, self.default)
+
+
+class Choice(HyperParameter):
+    kind = "choice"
+
+    def __init__(self, name, values, default=None):
+        if not values:
+            raise ValueError("Choice {!r} needs at least one value."
+                             .format(name))
+        super().__init__(name, default if default is not None else values[0])
+        self.values = list(values)
+
+    def random_sample(self, rng):
+        return rng.choice(self.values)
+
+
+class Int(HyperParameter):
+    kind = "int"
+
+    def __init__(self, name, min_value, max_value, step=None,
+                 sampling="linear", default=None):
+        super().__init__(name,
+                         default if default is not None else min_value)
+        self.min_value = int(min_value)
+        self.max_value = int(max_value)
+        self.step = step
+        self.sampling = sampling
+
+    def random_sample(self, rng):
+        if self.step:
+            choices = list(range(self.min_value, self.max_value + 1,
+                                 int(self.step)))
+            return rng.choice(choices)
+        return rng.randint(self.min_value, self.max_value)
+
+
+class Float(HyperParameter):
+    kind = "float"
+
+    def __init__(self, name, min_value, max_value, step=None,
+                 sampling="linear", default=None):
+        super().__init__(name,
+                         default if default is not None else min_value)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+        self.step = step
+        self.sampling = sampling
+
+    def random_sample(self, rng):
+        if self.step:
+            n = int((self.max_value - self.min_value) / self.step)
+            return self.min_value + self.step * rng.randint(0, n)
+        if self.sampling == "log":
+            import math
+            lo, hi = math.log(self.min_value), math.log(self.max_value)
+            return math.exp(rng.uniform(lo, hi))
+        return rng.uniform(self.min_value, self.max_value)
+
+
+class Boolean(HyperParameter):
+    kind = "boolean"
+
+    def __init__(self, name, default=False):
+        super().__init__(name, default)
+
+    def random_sample(self, rng):
+        return rng.random() < 0.5
+
+
+class Fixed(HyperParameter):
+    kind = "fixed"
+
+    def __init__(self, name, value):
+        super().__init__(name, value)
+        self.value = value
+
+    def random_sample(self, rng):
+        return self.value
+
+
+class HyperParameters:
+    """A search space plus current values."""
+
+    def __init__(self):
+        self.space = {}
+        self.values = {}
+
+    def _register(self, param):
+        if param.name not in self.space:
+            self.space[param.name] = param
+        if param.name not in self.values:
+            self.values[param.name] = param.default
+        return self.values[param.name]
+
+    def Choice(self, name, values, default=None):
+        return self._register(Choice(name, values, default))
+
+    def Int(self, name, min_value, max_value, step=None, sampling="linear",
+            default=None):
+        return self._register(Int(name, min_value, max_value, step,
+                                  sampling, default))
+
+    def Float(self, name, min_value, max_value, step=None,
+              sampling="linear", default=None):
+        return self._register(Float(name, min_value, max_value, step,
+                                    sampling, default))
+
+    def Boolean(self, name, default=False):
+        return self._register(Boolean(name, default))
+
+    def Fixed(self, name, value):
+        return self._register(Fixed(name, value))
+
+    def get(self, name):
+        if name not in self.values:
+            raise KeyError("Unknown hyperparameter {!r}.".format(name))
+        return self.values[name]
+
+    def copy(self):
+        hp = HyperParameters()
+        hp.space = dict(self.space)
+        hp.values = dict(self.values)
+        return hp
+
+    def random_sample(self, seed=None):
+        """A copy with every parameter randomly sampled."""
+        rng = random.Random(seed)
+        hp = self.copy()
+        for name, param in hp.space.items():
+            hp.values[name] = param.random_sample(rng)
+        return hp
+
+    def __repr__(self):
+        return "HyperParameters({})".format(self.values)
+
+
+class Objective:
+    """A metric name + optimization direction ('min' or 'max')."""
+
+    def __init__(self, name, direction="min"):
+        if direction not in ("min", "max"):
+            raise ValueError("direction must be 'min' or 'max', got {!r}."
+                             .format(direction))
+        self.name = name
+        self.direction = direction
+
+    def __eq__(self, other):
+        return (isinstance(other, Objective) and self.name == other.name
+                and self.direction == other.direction)
+
+    def __repr__(self):
+        return "Objective(name={!r}, direction={!r})".format(
+            self.name, self.direction)
+
+
+def default_objective_direction(name):
+    """Infers direction from a metric name ('accuracy' -> max)."""
+    return "max" if ("acc" in name or name.endswith("auc")) else "min"

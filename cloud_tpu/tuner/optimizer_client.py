@@ -1,0 +1,276 @@
+"""Vizier (CAIP Optimizer) REST client.
+
+Reference parity: tuner/optimizer_client.py:40-496 — trial suggestion
+with idempotency by client_id, intermediate measurements, early-stopping
+checks, completion, listing/deletion, long-running-operation polling with
+1.41^n bounded backoff, and the race-safe create-or-load study bootstrap
+(409 -> load) designed for many tuner processes sharing one study.
+
+The service client is injectable (tests use mocks; production builds a
+googleapiclient service against the regional endpoint).
+"""
+
+import datetime
+import http
+import logging
+import time
+
+try:
+    from googleapiclient import discovery
+    from googleapiclient import errors
+except ImportError:
+    discovery = None
+    errors = None
+
+from cloud_tpu.tuner import constants
+from cloud_tpu.utils import google_api_client
+
+logger = logging.getLogger("cloud_tpu")
+
+
+class SuggestionInactiveError(Exception):
+    """Indicates that a suggestion was requested from an inactive study
+    (reference optimizer_client.py:31)."""
+
+
+def _http_status(err):
+    resp = getattr(err, "resp", None)
+    return getattr(resp, "status", None)
+
+
+class OptimizerClient:
+    """Client for a single Vizier study."""
+
+    def __init__(self, service_client, project_id, region, study_id=None):
+        """Use `create_or_load_study()` unless the study already exists
+        (reference optimizer_client.py:40-65)."""
+        self.service_client = service_client
+        self.project_id = project_id
+        self.region = region
+        if not study_id:
+            raise ValueError(
+                "Use create_or_load_study() instead of constructing the "
+                "OptimizerClient class directly.")
+        self.study_id = study_id
+
+    # -- trials ---------------------------------------------------------
+
+    def get_suggestions(self, client_id):
+        """Suggests trials; idempotent per client_id (reference
+        optimizer_client.py:68-134). Returns {} when the trial budget or
+        search space is exhausted (429)."""
+        try:
+            resp = (self._trials()
+                    .suggest(parent=self._make_study_name(),
+                             body={
+                                 "client_id": client_id,
+                                 "suggestion_count":
+                                     constants.SUGGESTION_COUNT_PER_REQUEST,
+                             })
+                    .execute())
+        except Exception as e:
+            if _http_status(e) == 429:
+                logger.info("Reached max number of trials.")
+                return {}
+            logger.info("SuggestTrial failed.")
+            raise
+
+        operation = self._obtain_long_running_operation(resp)
+        suggestions = operation.get("response", {})
+        if "trials" not in suggestions:
+            if suggestions.get("studyState") == "INACTIVE":
+                raise SuggestionInactiveError(
+                    "The study is stopped due to an internal error.")
+        return suggestions
+
+    def report_intermediate_objective_value(self, step, elapsed_secs,
+                                            metric_list, trial_id):
+        """AddMeasurement (reference optimizer_client.py:136-164)."""
+        measurement = {
+            "stepCount": step,
+            "elapsedTime": {"seconds": int(elapsed_secs)},
+            "metrics": metric_list,
+        }
+        self._trials().addMeasurement(
+            name=self._make_trial_name(trial_id),
+            body={"measurement": measurement}).execute()
+
+    def should_trial_stop(self, trial_id):
+        """checkEarlyStoppingState + stop (reference
+        optimizer_client.py:166-202)."""
+        trial_name = self._make_trial_name(trial_id)
+        resp = (self._trials()
+                .checkEarlyStoppingState(name=trial_name)
+                .execute())
+        operation = self._obtain_long_running_operation(resp)
+        if operation.get("response", {}).get("shouldStop"):
+            logger.info("Stopping trial %s early.", trial_id)
+            self._trials().stop(name=trial_name).execute()
+            return True
+        return False
+
+    def complete_trial(self, trial_id, trial_infeasible=False,
+                       infeasibility_reason=None):
+        """Marks COMPLETED (reference optimizer_client.py:204-237)."""
+        return (self._trials()
+                .complete(name=self._make_trial_name(trial_id),
+                          body={
+                              "trial_infeasible": trial_infeasible,
+                              "infeasible_reason": infeasibility_reason,
+                          })
+                .execute())
+
+    def get_trial(self, trial_id):
+        return self._trials().get(
+            name=self._make_trial_name(trial_id)).execute()
+
+    def list_trials(self):
+        resp = self._trials().list(
+            parent=self._make_study_name()).execute()
+        return resp.get("trials", [])
+
+    # -- studies --------------------------------------------------------
+
+    def list_studies(self):
+        resp = self._studies().list(
+            parent=self._make_parent_name()).execute()
+        return resp.get("studies", [])
+
+    def delete_study(self, study_name=None):
+        if study_name is None:
+            study_name = self._make_study_name()
+        try:
+            self._studies().delete(name=study_name).execute()
+        except Exception as e:
+            if _http_status(e) == http.HTTPStatus.NOT_FOUND.value:
+                raise ValueError(
+                    "DeleteStudy failed. Study not found: {}.".format(
+                        study_name))
+            raise
+
+    # -- plumbing -------------------------------------------------------
+
+    def _studies(self):
+        return self.service_client.projects().locations().studies()
+
+    def _trials(self):
+        return self._studies().trials()
+
+    def _obtain_long_running_operation(self, resp):
+        """Polls an LRO with 1.41^n backoff, <=30 attempts (~10 min)
+        (reference optimizer_client.py:294-348)."""
+        op_id = resp["name"].split("/")[-1]
+        operation_name = "projects/{}/locations/{}/operations/{}".format(
+            self.project_id, self.region, op_id)
+        get_op = (self.service_client.projects()
+                  .locations()
+                  .operations()
+                  .get(name=operation_name))
+        operation = get_op.execute()
+
+        polling_secs = 1
+        num_attempts = 0
+        while not operation.get("done"):
+            sleep_time = self._polling_delay(num_attempts, polling_secs)
+            num_attempts += 1
+            logger.info("Waiting for operation; attempt %d; sleeping %s",
+                        num_attempts, sleep_time)
+            time.sleep(sleep_time.total_seconds())
+            if num_attempts > 30:
+                raise RuntimeError("GetLongRunningOperations timeout.")
+            operation = get_op.execute()
+        if "error" in operation:
+            # LROs report failure via an `error` field, not `response`.
+            raise RuntimeError(
+                "Operation {} failed: {}".format(
+                    operation.get("name"), operation["error"]))
+        return operation
+
+    @staticmethod
+    def _polling_delay(num_attempts, time_scale):
+        """Bounded exponential backoff (reference
+        optimizer_client.py:350-361)."""
+        small_interval = 0.3
+        interval = max(time_scale,
+                       small_interval) * 1.41 ** min(num_attempts, 9)
+        return datetime.timedelta(seconds=interval)
+
+    def _make_study_name(self):
+        return "projects/{}/locations/{}/studies/{}".format(
+            self.project_id, self.region, self.study_id)
+
+    def _make_trial_name(self, trial_id):
+        return "{}/trials/{}".format(self._make_study_name(), trial_id)
+
+    def _make_parent_name(self):
+        return "projects/{}/locations/{}".format(self.project_id,
+                                                 self.region)
+
+
+def build_service_client(region):
+    """Builds a googleapiclient service against the regional Vizier
+    endpoint (the reference ships a pinned discovery document,
+    optimizer_client.py:404-411; building from the live regional
+    endpoint avoids the stale-document problem)."""
+    if discovery is None:
+        raise RuntimeError(
+            "google-api-python-client is required for the Vizier tuner.")
+    endpoint = constants.OPTIMIZER_API_ENDPOINT.format(region=region)
+    return discovery.build(
+        "ml", "v1", cache_discovery=False,
+        discoveryServiceUrl="{}/$discovery/rest?version=v1".format(endpoint),
+        requestBuilder=google_api_client.CloudTpuHttpRequest)
+
+
+def create_or_load_study(project_id, region, study_id, study_config=None,
+                         service_client=None):
+    """Race-safe factory (reference optimizer_client.py:364-448):
+    create; on 409 (someone else won the race) load instead."""
+    if service_client is None:
+        service_client = build_service_client(region)
+
+    study_parent = "projects/{}/locations/{}".format(project_id, region)
+    studies = service_client.projects().locations().studies()
+
+    if study_config is None:
+        _get_study(service_client, study_parent, study_id,
+                   study_should_exist=True)
+    else:
+        request = studies.create(
+            body={"study_config": study_config},
+            parent=study_parent,
+            studyId=study_id)
+        try:
+            logger.info(request.execute())
+        except Exception as e:
+            if _http_status(e) != 409:
+                raise
+            _get_study(service_client, study_parent, study_id)
+
+    return OptimizerClient(service_client, project_id, region, study_id)
+
+
+def _get_study(service_client, study_parent, study_id,
+               study_should_exist=False):
+    """GET with bounded retry (reference optimizer_client.py:451-496)."""
+    study_name = "{}/studies/{}".format(study_parent, study_id)
+    num_tries = 0
+    while True:
+        try:
+            (service_client.projects().locations().studies()
+             .get(name=study_name).execute())
+            return
+        except Exception as e:
+            status = _http_status(e)
+            if status == http.HTTPStatus.NOT_FOUND.value:
+                if study_should_exist:
+                    raise ValueError(
+                        "GetStudy failed. Study not found: {}.".format(
+                            study_id))
+                # Created by another process moments ago; retry.
+            num_tries += 1
+            if num_tries >= constants.MAX_NUM_TRIES_FOR_STUDIES:
+                raise RuntimeError(
+                    "GetStudy wasn't successful after {} tries: {}".format(
+                        constants.MAX_NUM_TRIES_FOR_STUDIES, e))
+            time.sleep(1)

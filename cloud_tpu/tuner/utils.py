@@ -1,0 +1,190 @@
+"""Converters: HyperParameters/Objective <-> Vizier study configs.
+
+Reference parity: tuner/utils.py:47-399 — bidirectional conversion
+between the tuner's search-space API and the CAIP Optimizer (Vizier)
+`study_config`/trial wire format, including step->DISCRETE flattening
+and log-scale mapping.
+"""
+
+from cloud_tpu.tuner import hyperparameters as hp_module
+
+_SCALE_MAP = {
+    "linear": "UNIT_LINEAR_SCALE",
+    "log": "UNIT_LOG_SCALE",
+    "reverse_log": "UNIT_REVERSE_LOG_SCALE",
+}
+
+_GOAL_MAP = {"max": "MAXIMIZE", "min": "MINIMIZE"}
+
+
+def format_goal(direction):
+    """'min'/'max' <-> Vizier goal (reference utils.py:318-346)."""
+    if direction in _GOAL_MAP:
+        return _GOAL_MAP[direction]
+    for k, v in _GOAL_MAP.items():
+        if direction == v:
+            return k
+    raise ValueError("Unknown goal/direction: {!r}".format(direction))
+
+
+def format_objective(objective, direction=None):
+    """Normalizes objective input to a list of `Objective`
+    (reference utils.py:285-316)."""
+    if isinstance(objective, hp_module.Objective):
+        return [objective]
+    if isinstance(objective, str):
+        return [hp_module.Objective(
+            objective,
+            direction or hp_module.default_objective_direction(objective))]
+    if isinstance(objective, (list, tuple)):
+        out = []
+        for obj in objective:
+            out.extend(format_objective(obj, direction))
+        return out
+    raise TypeError(
+        "Objective must be a string, Objective, or list; got {!r}."
+        .format(objective))
+
+
+def _convert_parameter(param):
+    """One HyperParameter -> Vizier ParameterSpec
+    (reference utils.py:220-282)."""
+    spec = {"parameter": param.name}
+    if param.kind == "choice":
+        if all(isinstance(v, str) for v in param.values):
+            spec["type"] = "CATEGORICAL"
+            spec["categorical_value_spec"] = {"values": list(param.values)}
+        else:
+            spec["type"] = "DISCRETE"
+            spec["discrete_value_spec"] = {
+                "values": [float(v) for v in param.values]}
+    elif param.kind == "int":
+        if param.step:
+            spec["type"] = "DISCRETE"
+            spec["discrete_value_spec"] = {
+                "values": [float(v) for v in range(
+                    param.min_value, param.max_value + 1,
+                    int(param.step))]}
+        else:
+            spec["type"] = "INTEGER"
+            spec["integer_value_spec"] = {
+                "min_value": param.min_value,
+                "max_value": param.max_value,
+            }
+            spec["scale_type"] = _SCALE_MAP[param.sampling]
+    elif param.kind == "float":
+        if param.step:
+            values, v = [], param.min_value
+            while v <= param.max_value + 1e-12:
+                values.append(round(v, 12))
+                v += param.step
+            spec["type"] = "DISCRETE"
+            spec["discrete_value_spec"] = {"values": values}
+        else:
+            spec["type"] = "DOUBLE"
+            spec["double_value_spec"] = {
+                "min_value": param.min_value,
+                "max_value": param.max_value,
+            }
+            spec["scale_type"] = _SCALE_MAP[param.sampling]
+    elif param.kind == "boolean":
+        spec["type"] = "CATEGORICAL"
+        spec["categorical_value_spec"] = {"values": ["True", "False"]}
+    elif param.kind == "fixed":
+        if isinstance(param.value, str):
+            spec["type"] = "CATEGORICAL"
+            spec["categorical_value_spec"] = {"values": [param.value]}
+        else:
+            spec["type"] = "DISCRETE"
+            spec["discrete_value_spec"] = {
+                "values": [float(param.value)]}
+    else:
+        raise ValueError("Unknown parameter kind {!r}.".format(param.kind))
+    return spec
+
+
+def make_study_config(objective, hyperparams):
+    """HyperParameters + objective -> Vizier study_config
+    (reference utils.py:47-81: default algorithm + decay-curve automated
+    stopping)."""
+    objectives = format_objective(objective)
+    return {
+        "algorithm": "ALGORITHM_UNSPECIFIED",
+        "automatedStoppingConfig": {
+            "decayCurveStoppingConfig": {"useElapsedTime": True}},
+        "metrics": [{"metric": o.name, "goal": format_goal(o.direction)}
+                    for o in objectives],
+        "parameters": [_convert_parameter(p)
+                       for p in hyperparams.space.values()],
+    }
+
+
+def convert_study_config_to_objective(study_config):
+    """study_config -> [Objective] (reference utils.py:84-110)."""
+    metrics = study_config.get("metrics") or []
+    if not metrics:
+        raise ValueError("Study config has no metrics.")
+    return [hp_module.Objective(m["metric"], format_goal(m["goal"]))
+            for m in metrics]
+
+
+def convert_study_config_to_hps(study_config):
+    """study_config -> HyperParameters (reference utils.py:112-158)."""
+    hps = hp_module.HyperParameters()
+    for spec in study_config.get("parameters", []):
+        name = spec["parameter"]
+        if spec["type"] == "CATEGORICAL":
+            values = spec["categorical_value_spec"]["values"]
+            if set(values) == {"True", "False"}:
+                hps.Boolean(name)
+            else:
+                hps.Choice(name, values)
+        elif spec["type"] == "DISCRETE":
+            hps.Choice(name, spec["discrete_value_spec"]["values"])
+        elif spec["type"] == "INTEGER":
+            value_spec = spec["integer_value_spec"]
+            hps.Int(name, int(value_spec["min_value"]),
+                    int(value_spec["max_value"]))
+        elif spec["type"] == "DOUBLE":
+            value_spec = spec["double_value_spec"]
+            sampling = "linear"
+            for k, v in _SCALE_MAP.items():
+                if spec.get("scale_type") == v:
+                    sampling = k
+            hps.Float(name, value_spec["min_value"],
+                      value_spec["max_value"], sampling=sampling)
+        else:
+            raise ValueError("Unknown parameter type {!r}."
+                             .format(spec["type"]))
+    return hps
+
+
+def get_trial_id(optimizer_trial):
+    """Full Vizier trial name -> short trial id
+    (reference utils.py:360-371)."""
+    return optimizer_trial["name"].split("/")[-1]
+
+
+def convert_optimizer_trial_to_hps(base_hps, optimizer_trial):
+    """Vizier trial params -> HyperParameters values
+    (reference utils.py:374-388)."""
+    hps = base_hps.copy()
+    for param in optimizer_trial.get("parameters", []):
+        name = param["parameter"]
+        if "floatValue" in param:
+            value = float(param["floatValue"])
+            spec = hps.space.get(name)
+            if spec is not None and spec.kind == "int":
+                value = int(value)
+            if (spec is not None and spec.kind == "choice"
+                    and all(isinstance(v, int) for v in spec.values)):
+                value = int(value)
+        elif "intValue" in param:
+            value = int(param["intValue"])
+        else:
+            value = param["stringValue"]
+            spec = hps.space.get(name)
+            if spec is not None and spec.kind == "boolean":
+                value = value == "True"
+        hps.values[name] = value
+    return hps
