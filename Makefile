@@ -1,0 +1,21 @@
+# Convenience targets for the native components and tests.
+
+NATIVE_DIR := src/cpp/monitoring
+NATIVE_BUILD := $(NATIVE_DIR)/build
+
+.PHONY: native native-test test all clean
+
+all: native
+
+native:
+	cmake -B $(NATIVE_BUILD) -G Ninja $(NATIVE_DIR)
+	cmake --build $(NATIVE_BUILD)
+
+native-test: native
+	$(NATIVE_BUILD)/monitoring_test
+
+test: native-test
+	python -m pytest tests/ -q
+
+clean:
+	rm -rf $(NATIVE_BUILD)

@@ -1,0 +1,22 @@
+"""Runtime metrics + Cloud Monitoring export (native C++ core).
+
+Reference parity: the one native component (SURVEY §2.2 N1-N5) — a
+whitelisted, env-gated, 10s-periodic exporter of runtime metrics to
+Cloud Monitoring, rebuilt against this framework's own registry.
+"""
+
+from cloud_tpu.monitoring.native import (config_debug_string,
+                                         counter_increment, export_count,
+                                         flush, gauge_set,
+                                         histogram_observe,
+                                         native_available, reset_for_testing,
+                                         set_description, snapshot_json,
+                                         start_exporter, stop_exporter)
+
+# Canonical runtime metric names (the default whitelist in
+# src/cpp/monitoring/config.cc).
+TRAINING_STEPS = "/cloud_tpu/training/steps"
+TRAINING_EXAMPLES = "/cloud_tpu/training/examples"
+STEP_TIME_HISTOGRAM = "/cloud_tpu/training/step_time_usecs_histogram"
+
+STEP_TIME_BOUNDS = [1e3, 1e4, 1e5, 1e6, 1e7, 1e8]

@@ -1,0 +1,211 @@
+"""ctypes binding to the native monitoring library.
+
+The reference links its exporter into the TF runtime as a C++ plugin
+(reference src/cpp/monitoring/stackdriver_exporter.cc:128
+REGISTER_TF_METRICS_EXPORTER). Here the native library is loaded into
+the Python process via ctypes (pybind11 is not in this image) and the
+framework emits runtime metrics through it. A pure-Python fallback
+registry keeps the API alive when the shared library has not been built.
+"""
+
+import ctypes
+import json
+import os
+import threading
+
+_LIB_ENV = "CLOUD_TPU_MONITORING_LIB"
+_LIB_NAME = "libcloud_tpu_monitoring.so"
+
+
+def _candidate_paths():
+    env = os.environ.get(_LIB_ENV)
+    if env:
+        yield env
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    yield os.path.join(here, _LIB_NAME)
+    yield os.path.join(repo, "src", "cpp", "monitoring", "build", _LIB_NAME)
+
+
+def _load():
+    for path in _candidate_paths():
+        if not os.path.exists(path):
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+            lib.cloud_tpu_counter_increment.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64]
+            lib.cloud_tpu_gauge_set.argtypes = [
+                ctypes.c_char_p, ctypes.c_double]
+            lib.cloud_tpu_histogram_observe.argtypes = [
+                ctypes.c_char_p, ctypes.c_double,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+            lib.cloud_tpu_metric_set_description.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p]
+            lib.cloud_tpu_snapshot_json.restype = ctypes.c_void_p
+            lib.cloud_tpu_config_debug_string.restype = ctypes.c_void_p
+            lib.cloud_tpu_free.argtypes = [ctypes.c_void_p]
+            lib.cloud_tpu_exporter_start.argtypes = [ctypes.c_int64]
+            lib.cloud_tpu_exporter_start.restype = ctypes.c_int
+            lib.cloud_tpu_exporter_export_count.restype = ctypes.c_int64
+            return lib
+        except OSError:
+            # Stale/foreign .so: keep looking, fall back to Python.
+            continue
+    return None
+
+
+_lib = _load()
+
+
+class _PyFallback:
+    """Minimal in-process registry mirroring the C API semantics."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.counters = {}
+        self.gauges = {}
+        self.histograms = {}
+
+    def counter_increment(self, name, delta):
+        with self._mu:
+            self.counters[name] = self.counters.get(name, 0) + delta
+
+    def gauge_set(self, name, value):
+        with self._mu:
+            self.gauges[name] = value
+
+    def histogram_observe(self, name, value, bounds):
+        with self._mu:
+            h = self.histograms.setdefault(
+                name, {"bounds": list(bounds), "values": []})
+            h["values"].append(value)
+
+    def snapshot_json(self):
+        with self._mu:
+            project = os.environ.get("CLOUD_TPU_MONITORING_PROJECT_ID", "")
+            series = []
+            for name, value in self.counters.items():
+                series.append({
+                    "metric": {"type":
+                               "custom.googleapis.com" + name},
+                    "metricKind": "CUMULATIVE",
+                    "valueType": "INT64",
+                    "points": [{"value": {"int64Value": value}}],
+                })
+            for name, value in self.gauges.items():
+                series.append({
+                    "metric": {"type":
+                               "custom.googleapis.com" + name},
+                    "metricKind": "GAUGE",
+                    "valueType": "DOUBLE",
+                    "points": [{"value": {"doubleValue": value}}],
+                })
+            for name, h in self.histograms.items():
+                values = h["values"]
+                count = len(values)
+                mean = sum(values) / count if count else 0.0
+                series.append({
+                    "metric": {"type":
+                               "custom.googleapis.com" + name},
+                    "metricKind": "CUMULATIVE",
+                    "valueType": "DISTRIBUTION",
+                    "points": [{"value": {"distributionValue": {
+                        "count": count,
+                        "mean": mean,
+                        "bucketOptions": {"explicitBuckets": {
+                            "bounds": h["bounds"]}},
+                    }}}],
+                })
+            if not series:
+                return ""
+            return json.dumps(
+                {"name": "projects/" + project, "timeSeries": series})
+
+
+_fallback = _PyFallback()
+
+
+def native_available():
+    return _lib is not None
+
+
+def counter_increment(name, delta=1):
+    if _lib is not None:
+        _lib.cloud_tpu_counter_increment(name.encode(), int(delta))
+    else:
+        _fallback.counter_increment(name, delta)
+
+
+def gauge_set(name, value):
+    if _lib is not None:
+        _lib.cloud_tpu_gauge_set(name.encode(), float(value))
+    else:
+        _fallback.gauge_set(name, value)
+
+
+def histogram_observe(name, value, bounds):
+    if _lib is not None:
+        arr = (ctypes.c_double * len(bounds))(*bounds)
+        _lib.cloud_tpu_histogram_observe(name.encode(), float(value), arr,
+                                         len(bounds))
+    else:
+        _fallback.histogram_observe(name, value, bounds)
+
+
+def set_description(name, description):
+    if _lib is not None:
+        _lib.cloud_tpu_metric_set_description(name.encode(),
+                                              description.encode())
+
+
+def snapshot_json():
+    """Serialized CreateTimeSeries request for current metrics."""
+    if _lib is not None:
+        ptr = _lib.cloud_tpu_snapshot_json()
+        try:
+            return ctypes.string_at(ptr).decode()
+        finally:
+            _lib.cloud_tpu_free(ptr)
+    return _fallback.snapshot_json()
+
+
+def config_debug_string():
+    if _lib is not None:
+        ptr = _lib.cloud_tpu_config_debug_string()
+        try:
+            return ctypes.string_at(ptr).decode()
+        finally:
+            _lib.cloud_tpu_free(ptr)
+    return "python-fallback"
+
+
+def start_exporter(interval_micros=10_000_000):
+    """Starts the native periodic exporter (no-op without the library or
+    when CLOUD_TPU_MONITORING_ENABLED != true)."""
+    if _lib is None:
+        return False
+    return bool(_lib.cloud_tpu_exporter_start(int(interval_micros)))
+
+
+def flush():
+    if _lib is not None:
+        _lib.cloud_tpu_exporter_flush()
+
+
+def export_count():
+    return _lib.cloud_tpu_exporter_export_count() if _lib is not None else 0
+
+
+def stop_exporter():
+    if _lib is not None:
+        _lib.cloud_tpu_exporter_stop()
+
+
+def reset_for_testing():
+    if _lib is not None:
+        _lib.cloud_tpu_registry_reset()
+        _lib.cloud_tpu_config_reset()
+    else:
+        global _fallback
+        _fallback = _PyFallback()

@@ -78,6 +78,26 @@ OPTIMIZERS = {
 }
 
 
+def _emit_runtime_metrics(steps, examples, elapsed_secs):
+    """Feeds the native metrics registry and ensures the periodic C++
+    exporter is running (it refuses unless CLOUD_TPU_MONITORING_ENABLED
+    is set) — once per epoch, off the hot loop."""
+    if steps <= 0:
+        return
+    try:
+        from cloud_tpu import monitoring
+        monitoring.start_exporter()  # idempotent, env-gated
+        monitoring.counter_increment(monitoring.TRAINING_STEPS, steps)
+        monitoring.counter_increment(monitoring.TRAINING_EXAMPLES,
+                                     examples)
+        monitoring.histogram_observe(
+            monitoring.STEP_TIME_HISTOGRAM,
+            elapsed_secs / steps * 1e6,
+            monitoring.STEP_TIME_BOUNDS)
+    except Exception:  # monitoring must never break training
+        logger.debug("metric emission failed", exc_info=True)
+
+
 class TrainState:
     """Step + params + optimizer state + auxiliary model variables
     (e.g. flax batch_stats), registered as a pytree."""
@@ -394,10 +414,14 @@ class Trainer:
                 cb.on_epoch_begin(epoch)
             step_logs = []
             count = 0
+            examples = 0
             t0 = time.time()
             for step, batch in enumerate(self._epoch_batches(dataset)):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
                     break
+                leaves = jax.tree_util.tree_leaves(batch)
+                if leaves:
+                    examples += int(leaves[0].shape[0])
                 batch = self._feed(batch)
                 self.state, logs = self._jit_train_step(self.state, batch)
                 # Keep logs as device arrays: no host sync inside the hot
@@ -411,7 +435,9 @@ class Trainer:
                 logs = {k: float(v) for k, v in stacked.items()}
             else:
                 logs = {}
-            logs["steps_per_sec"] = count / max(time.time() - t0, 1e-9)
+            elapsed = max(time.time() - t0, 1e-9)
+            logs["steps_per_sec"] = count / elapsed
+            _emit_runtime_metrics(count, examples, elapsed)
 
             if validation_data is not None:
                 val_logs = self.evaluate(*validation_data,

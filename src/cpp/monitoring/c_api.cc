@@ -1,0 +1,108 @@
+// C API: the ctypes boundary for cloud_tpu.monitoring (pybind11 is not
+// available in this image; plain extern "C" + ctypes is the binding).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "config.h"
+#include "exporter.h"
+#include "metrics_registry.h"
+#include "stackdriver_client.h"
+
+namespace {
+
+cloud_tpu::monitoring::Exporter* g_exporter = nullptr;
+std::mutex g_exporter_mu;
+
+cloud_tpu::monitoring::Exporter* GetExporter(
+    int64_t interval_micros =
+        cloud_tpu::monitoring::kDefaultIntervalMicros) {
+  // ctypes calls release the GIL; creation must be synchronized.
+  std::lock_guard<std::mutex> lock(g_exporter_mu);
+  if (g_exporter == nullptr) {
+    g_exporter = new cloud_tpu::monitoring::Exporter(
+        cloud_tpu::monitoring::StackdriverClient::Get(), interval_micros);
+  }
+  return g_exporter;
+}
+
+char* CopyString(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void cloud_tpu_counter_increment(const char* name, int64_t delta) {
+  cloud_tpu::monitoring::MetricsRegistry::Get()->IncrementCounter(name,
+                                                                  delta);
+}
+
+void cloud_tpu_gauge_set(const char* name, double value) {
+  cloud_tpu::monitoring::MetricsRegistry::Get()->SetGauge(name, value);
+}
+
+void cloud_tpu_histogram_observe(const char* name, double value,
+                                 const double* bounds, int num_bounds) {
+  std::vector<double> bound_vec(bounds, bounds + num_bounds);
+  cloud_tpu::monitoring::MetricsRegistry::Get()->ObserveHistogram(
+      name, value, bound_vec);
+}
+
+void cloud_tpu_metric_set_description(const char* name,
+                                      const char* description) {
+  cloud_tpu::monitoring::MetricsRegistry::Get()->SetDescription(
+      name, description);
+}
+
+// Serialized CreateTimeSeries request for the current registry contents
+// (caller frees with cloud_tpu_free).
+char* cloud_tpu_snapshot_json() {
+  auto snapshots =
+      cloud_tpu::monitoring::MetricsRegistry::Get()->Snapshot();
+  const cloud_tpu::monitoring::Config* config =
+      cloud_tpu::monitoring::Config::Get();
+  return CopyString(
+      cloud_tpu::monitoring::StackdriverClient::TimeSeriesJson(
+          config->project_id(), snapshots));
+}
+
+char* cloud_tpu_config_debug_string() {
+  return CopyString(
+      cloud_tpu::monitoring::Config::Get()->DebugString());
+}
+
+void cloud_tpu_free(char* ptr) { std::free(ptr); }
+
+// Starts the periodic exporter (no-op unless
+// CLOUD_TPU_MONITORING_ENABLED=true). Returns 1 if running.
+int cloud_tpu_exporter_start(int64_t interval_micros) {
+  return GetExporter(interval_micros)->PeriodicallyExportMetrics() ? 1 : 0;
+}
+
+// One synchronous export pass (also what the periodic thread runs).
+void cloud_tpu_exporter_flush() { GetExporter()->ExportMetrics(); }
+
+int64_t cloud_tpu_exporter_export_count() {
+  return g_exporter == nullptr ? 0 : g_exporter->export_count();
+}
+
+void cloud_tpu_exporter_stop() {
+  if (g_exporter != nullptr) g_exporter->Stop();
+}
+
+void cloud_tpu_registry_reset() {
+  cloud_tpu::monitoring::MetricsRegistry::Get()->Reset();
+}
+
+void cloud_tpu_config_reset() {
+  cloud_tpu::monitoring::Config::ResetForTesting();
+}
+
+}  // extern "C"

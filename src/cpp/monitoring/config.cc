@@ -1,0 +1,86 @@
+#include "config.h"
+
+#include <cstdlib>
+#include <mutex>
+#include <sstream>
+
+namespace cloud_tpu {
+namespace monitoring {
+
+const char kEnabledEnvVar[] = "CLOUD_TPU_MONITORING_ENABLED";
+const char kProjectIdEnvVar[] = "CLOUD_TPU_MONITORING_PROJECT_ID";
+const char kWhitelistEnvVar[] = "CLOUD_TPU_MONITORING_METRICS_WHITELIST";
+const char kExportPathEnvVar[] = "CLOUD_TPU_MONITORING_EXPORT_PATH";
+
+namespace {
+
+// Default whitelist: the runtime metrics the framework emits on the hot
+// path (the analogue of the reference's TF graph/data defaults,
+// stackdriver_config.cc:37-44).
+const char* const kDefaultWhitelist[] = {
+    "/cloud_tpu/training/steps",
+    "/cloud_tpu/training/examples",
+    "/cloud_tpu/training/step_time_usecs_histogram",
+    "/cloud_tpu/data/bytes_fetched",
+    "/cloud_tpu/data/batch_latency_usecs_histogram",
+    "/cloud_tpu/compile/compile_time_usecs_histogram",
+};
+
+Config* g_config = nullptr;
+std::mutex g_mu;
+
+}  // namespace
+
+Config::Config() {
+  const char* enabled = std::getenv(kEnabledEnvVar);
+  enabled_ = enabled != nullptr && std::string(enabled) == "true";
+  const char* project = std::getenv(kProjectIdEnvVar);
+  if (project != nullptr) project_id_ = project;
+  const char* path = std::getenv(kExportPathEnvVar);
+  if (path != nullptr) export_path_ = path;
+
+  const char* raw = std::getenv(kWhitelistEnvVar);
+  if (raw == nullptr || std::string(raw).empty()) {
+    for (const char* name : kDefaultWhitelist) whitelist_.insert(name);
+    return;
+  }
+  // Comma-split (reference stackdriver_config.cc:26-35).
+  std::stringstream stream(raw);
+  std::string item;
+  while (std::getline(stream, item, ',')) {
+    if (!item.empty()) whitelist_.insert(item);
+  }
+}
+
+const Config* Config::Get() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (g_config == nullptr) g_config = new Config();
+  return g_config;
+}
+
+void Config::ResetForTesting() {
+  std::lock_guard<std::mutex> lock(g_mu);
+  delete g_config;
+  g_config = nullptr;
+}
+
+bool Config::IsWhitelisted(const std::string& metric_name) const {
+  return whitelist_.count(metric_name) > 0;
+}
+
+std::string Config::DebugString() const {
+  std::stringstream out;
+  out << "enabled=" << (enabled_ ? "true" : "false")
+      << " project_id=" << project_id_ << " whitelist=[";
+  bool first = true;
+  for (const auto& name : whitelist_) {
+    if (!first) out << ",";
+    out << name;
+    first = false;
+  }
+  out << "]";
+  return out.str();
+}
+
+}  // namespace monitoring
+}  // namespace cloud_tpu

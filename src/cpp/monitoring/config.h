@@ -1,0 +1,52 @@
+// Env-driven exporter configuration (whitelist + gates).
+//
+// Reference parity: src/cpp/monitoring/stackdriver_config.{h,cc} — a
+// singleton metric whitelist parsed from a comma-separated env var with
+// compiled-in defaults (reference stackdriver_config.cc:26-50), plus the
+// enable/project env contract read by the exporter and client
+// (stackdriver_exporter.cc:31-36, stackdriver_client.cc:38-43).
+
+#ifndef CLOUD_TPU_MONITORING_CONFIG_H_
+#define CLOUD_TPU_MONITORING_CONFIG_H_
+
+#include <set>
+#include <string>
+
+namespace cloud_tpu {
+namespace monitoring {
+
+// Env vars (the CLOUD_TPU_* analogue of the reference's
+// TF_MONITORING_STACKDRIVER_* contract).
+extern const char kEnabledEnvVar[];      // CLOUD_TPU_MONITORING_ENABLED
+extern const char kProjectIdEnvVar[];    // CLOUD_TPU_MONITORING_PROJECT_ID
+extern const char kWhitelistEnvVar[];    // CLOUD_TPU_MONITORING_METRICS_WHITELIST
+extern const char kExportPathEnvVar[];   // CLOUD_TPU_MONITORING_EXPORT_PATH
+
+class Config {
+ public:
+  // Parses env on first use (singleton, like reference
+  // stackdriver_config.cc:20-24).
+  static const Config* Get();
+  // Re-parses env (test isolation; the reference's singleton is
+  // unresettable, which its tests work around with process isolation).
+  static void ResetForTesting();
+
+  bool IsWhitelisted(const std::string& metric_name) const;
+  bool enabled() const { return enabled_; }
+  const std::string& project_id() const { return project_id_; }
+  const std::string& export_path() const { return export_path_; }
+  std::string DebugString() const;
+
+ private:
+  Config();
+
+  bool enabled_ = false;
+  std::string project_id_;
+  std::string export_path_;
+  std::set<std::string> whitelist_;
+};
+
+}  // namespace monitoring
+}  // namespace cloud_tpu
+
+#endif  // CLOUD_TPU_MONITORING_CONFIG_H_

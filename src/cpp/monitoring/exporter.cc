@@ -1,0 +1,94 @@
+#include "exporter.h"
+
+#include <chrono>
+
+#include "config.h"
+#include "metrics_registry.h"
+
+namespace cloud_tpu {
+namespace monitoring {
+
+namespace {
+
+bool IsEmpty(const MetricSnapshot& s) {
+  switch (s.kind) {
+    case MetricKind::kCounter:
+      return s.counter_value == 0;
+    case MetricKind::kGauge:
+      return false;  // a set gauge is always a point
+    case MetricKind::kHistogram:
+      return s.histogram.count == 0;
+  }
+  return true;
+}
+
+}  // namespace
+
+Exporter::Exporter(StackdriverClient* client, int64_t interval_micros)
+    : client_(client), interval_micros_(interval_micros) {}
+
+Exporter::~Exporter() { Stop(); }
+
+bool Exporter::PeriodicallyExportMetrics() {
+  if (!Config::Get()->enabled()) return false;  // exporter.cc:31-36
+  std::lock_guard<std::mutex> lock(mu_);
+  if (started_) return true;
+  started_ = true;
+  thread_ = std::thread([this] {
+    std::unique_lock<std::mutex> lock(mu_);
+    while (!stopping_) {
+      lock.unlock();
+      ExportMetrics();
+      lock.lock();
+      cv_.wait_for(lock,
+                   std::chrono::microseconds(interval_micros_),
+                   [this] { return stopping_; });
+    }
+  });
+  return true;
+}
+
+void Exporter::ExportMetrics() {
+  const Config* config = Config::Get();
+  std::vector<MetricSnapshot> snapshots =
+      MetricsRegistry::Get()->Snapshot();
+  // Whitelist + non-empty filter (reference exporter.cc:38-68).
+  std::vector<MetricSnapshot> filtered;
+  for (auto& s : snapshots) {
+    if (config->IsWhitelisted(s.name) && !IsEmpty(s)) {
+      filtered.push_back(std::move(s));
+    }
+  }
+  if (filtered.empty()) return;
+  ExportMetricDescriptors(filtered);
+  client_->CreateTimeSeries(filtered);
+  export_count_++;
+}
+
+void Exporter::ExportMetricDescriptors(
+    const std::vector<MetricSnapshot>& snapshots) {
+  for (const auto& s : snapshots) {
+    bool is_new;
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      is_new = registered_descriptors_.insert(s.name).second;
+    }
+    if (is_new) client_->CreateMetricDescriptor(s);
+  }
+}
+
+void Exporter::Stop() {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    stopping_ = true;
+  }
+  cv_.notify_all();
+  if (thread_.joinable()) thread_.join();
+  // Allow a later restart (start->stop->start must actually export).
+  std::lock_guard<std::mutex> lock(mu_);
+  started_ = false;
+  stopping_ = false;
+}
+
+}  // namespace monitoring
+}  // namespace cloud_tpu

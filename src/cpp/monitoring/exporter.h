@@ -1,0 +1,66 @@
+// Periodic metrics exporter.
+//
+// Reference parity: src/cpp/monitoring/stackdriver_exporter.{h,cc} — a
+// background thread that every 10s (kIntervalMicros, reference
+// exporter.cc:28) collects from the registry, filters to whitelisted
+// non-empty metrics (exporter.cc:38-68), lazily registers each metric's
+// descriptor exactly once (exporter.cc:105-126), and pushes time series;
+// gated by an env var (exporter.cc:31-36); mutex-guarded state
+// (exporter.h:43-46).
+
+#ifndef CLOUD_TPU_MONITORING_EXPORTER_H_
+#define CLOUD_TPU_MONITORING_EXPORTER_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+
+#include "stackdriver_client.h"
+
+namespace cloud_tpu {
+namespace monitoring {
+
+constexpr int64_t kDefaultIntervalMicros = 10 * 1000 * 1000;  // 10s
+
+class Exporter {
+ public:
+  explicit Exporter(StackdriverClient* client,
+                    int64_t interval_micros = kDefaultIntervalMicros);
+  ~Exporter();
+
+  // Starts the periodic thread if the env gate is on (reference
+  // exporter.cc:72-84). Returns whether it started.
+  bool PeriodicallyExportMetrics();
+
+  // One export pass (also used by the periodic thread).
+  void ExportMetrics();
+
+  void Stop();
+
+  int64_t export_count() const { return export_count_; }
+
+ private:
+  void ExportMetricDescriptors(
+      const std::vector<MetricSnapshot>& snapshots);
+
+  StackdriverClient* client_;
+  int64_t interval_micros_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool stopping_ = false;
+  std::thread thread_;
+  bool started_ = false;
+  // Descriptor dedup (reference exporter.cc:105-126).
+  std::set<std::string> registered_descriptors_;
+  std::atomic<int64_t> export_count_{0};
+};
+
+}  // namespace monitoring
+}  // namespace cloud_tpu
+
+#endif  // CLOUD_TPU_MONITORING_EXPORTER_H_

@@ -1,0 +1,89 @@
+#include "metrics_registry.h"
+
+#include <algorithm>
+#include <chrono>
+
+namespace cloud_tpu {
+namespace monitoring {
+
+namespace {
+int64_t NowMicros() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
+MetricsRegistry* MetricsRegistry::Get() {
+  static MetricsRegistry* registry = new MetricsRegistry();
+  return registry;
+}
+
+void MetricsRegistry::IncrementCounter(const std::string& name,
+                                       int64_t delta) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto& metric = metrics_[name];
+  metric.kind = MetricKind::kCounter;
+  metric.counter += delta;
+}
+
+void MetricsRegistry::SetGauge(const std::string& name, double value) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto& metric = metrics_[name];
+  metric.kind = MetricKind::kGauge;
+  metric.gauge = value;
+}
+
+void MetricsRegistry::ObserveHistogram(const std::string& name,
+                                       double value,
+                                       const std::vector<double>& bounds) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto& metric = metrics_[name];
+  if (metric.histogram.bucket_bounds.empty()) {
+    metric.kind = MetricKind::kHistogram;
+    metric.histogram.bucket_bounds = bounds;
+    metric.histogram.bucket_counts.assign(bounds.size() + 1, 0);
+  }
+  auto& h = metric.histogram;
+  // First bucket whose upper bound is > value; last bucket overflows.
+  size_t idx = std::upper_bound(h.bucket_bounds.begin(),
+                                h.bucket_bounds.end(), value) -
+               h.bucket_bounds.begin();
+  h.bucket_counts[idx] += 1;
+  h.sum += value;
+  h.sum_squares += value * value;
+  h.count += 1;
+}
+
+void MetricsRegistry::SetDescription(const std::string& name,
+                                     const std::string& description) {
+  std::lock_guard<std::mutex> lock(mu_);
+  metrics_[name].description = description;
+}
+
+std::vector<MetricSnapshot> MetricsRegistry::Snapshot() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<MetricSnapshot> out;
+  out.reserve(metrics_.size());
+  const int64_t now = NowMicros();
+  for (const auto& entry : metrics_) {
+    MetricSnapshot snap;
+    snap.name = entry.first;
+    snap.description = entry.second.description;
+    snap.kind = entry.second.kind;
+    snap.counter_value = entry.second.counter;
+    snap.gauge_value = entry.second.gauge;
+    snap.histogram = entry.second.histogram;
+    snap.timestamp_micros = now;
+    out.push_back(std::move(snap));
+  }
+  return out;
+}
+
+void MetricsRegistry::Reset() {
+  std::lock_guard<std::mutex> lock(mu_);
+  metrics_.clear();
+}
+
+}  // namespace monitoring
+}  // namespace cloud_tpu

@@ -1,0 +1,76 @@
+// Metrics registry for the cloud_tpu native runtime.
+//
+// The reference's exporter reads TensorFlow's global CollectionRegistry
+// (reference src/cpp/monitoring/stackdriver_exporter.cc:86-89). This
+// framework owns its metric source: a process-global, thread-safe
+// registry of int64 counters, double gauges, and histograms with
+// explicit bucket bounds — the shapes the Cloud Monitoring conversion
+// layer (stackdriver_client.{h,cc}) understands.
+
+#ifndef CLOUD_TPU_MONITORING_METRICS_REGISTRY_H_
+#define CLOUD_TPU_MONITORING_METRICS_REGISTRY_H_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace cloud_tpu {
+namespace monitoring {
+
+struct HistogramData {
+  std::vector<double> bucket_bounds;  // ascending upper bounds
+  std::vector<int64_t> bucket_counts;  // size = bounds + 1 (overflow)
+  double sum = 0.0;
+  double sum_squares = 0.0;
+  int64_t count = 0;
+};
+
+enum class MetricKind { kCounter, kGauge, kHistogram };
+
+struct MetricSnapshot {
+  std::string name;
+  std::string description;
+  MetricKind kind = MetricKind::kCounter;
+  int64_t counter_value = 0;
+  double gauge_value = 0.0;
+  HistogramData histogram;
+  int64_t timestamp_micros = 0;
+};
+
+// Process-global registry. All operations are thread-safe.
+class MetricsRegistry {
+ public:
+  static MetricsRegistry* Get();
+
+  void IncrementCounter(const std::string& name, int64_t delta);
+  void SetGauge(const std::string& name, double value);
+  // Creates the histogram on first observation with the given bounds
+  // (subsequent bounds arguments are ignored).
+  void ObserveHistogram(const std::string& name, double value,
+                        const std::vector<double>& bounds);
+  void SetDescription(const std::string& name,
+                      const std::string& description);
+
+  std::vector<MetricSnapshot> Snapshot() const;
+  void Reset();  // test isolation
+
+ private:
+  struct Metric {
+    MetricKind kind;
+    std::string description;
+    int64_t counter = 0;
+    double gauge = 0.0;
+    HistogramData histogram;
+  };
+
+  mutable std::mutex mu_;
+  std::map<std::string, Metric> metrics_;
+};
+
+}  // namespace monitoring
+}  // namespace cloud_tpu
+
+#endif  // CLOUD_TPU_MONITORING_METRICS_REGISTRY_H_

@@ -1,0 +1,172 @@
+// Assert-based tests (gtest is not in this image). Mirrors the
+// reference's golden-request tests (stackdriver_client_test.cc:86-212):
+// exact serialized-request matching for both RPC builders, plus
+// registry/whitelist/exporter behavior with a capturing transport.
+
+#include <cassert>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "config.h"
+#include "exporter.h"
+#include "metrics_registry.h"
+#include "stackdriver_client.h"
+
+using cloud_tpu::monitoring::Config;
+using cloud_tpu::monitoring::Exporter;
+using cloud_tpu::monitoring::HistogramData;
+using cloud_tpu::monitoring::MetricKind;
+using cloud_tpu::monitoring::MetricSnapshot;
+using cloud_tpu::monitoring::MetricsRegistry;
+using cloud_tpu::monitoring::StackdriverClient;
+
+#define CHECK_CONTAINS(haystack, needle)                              \
+  do {                                                                \
+    if ((haystack).find(needle) == std::string::npos) {               \
+      std::fprintf(stderr, "FAIL %s:%d: %s not found in:\n%s\n",      \
+                   __FILE__, __LINE__, needle, (haystack).c_str());   \
+      std::exit(1);                                                   \
+    }                                                                 \
+  } while (0)
+
+namespace {
+
+MetricSnapshot CounterSnap(const std::string& name, int64_t value) {
+  MetricSnapshot s;
+  s.name = name;
+  s.kind = MetricKind::kCounter;
+  s.counter_value = value;
+  s.timestamp_micros = 1500000000000000;  // fixed for golden output
+  return s;
+}
+
+void TestTimeSeriesGolden() {
+  MetricSnapshot s = CounterSnap("/cloud_tpu/training/steps", 42);
+  std::string json = StackdriverClient::TimeSeriesJson("proj", {s});
+  // Golden request (reference pins exact protos,
+  // stackdriver_client_test.cc:97-156).
+  const std::string expected =
+      "{\"name\":\"projects/proj\",\"timeSeries\":[{\"metric\":{\"type\":"
+      "\"custom.googleapis.com/cloud_tpu/training/steps\"},"
+      "\"resource\":{\"type\":\"global\",\"labels\":{\"project_id\":"
+      "\"proj\"}},\"metricKind\":\"CUMULATIVE\",\"valueType\":\"INT64\","
+      "\"points\":[{\"interval\":{\"endTime\":{\"seconds\":1500000000,"
+      "\"nanos\":0}},\"value\":{\"int64Value\":42}}]}]}";
+  assert(json == expected);
+}
+
+void TestDistributionConversion() {
+  MetricsRegistry::Get()->Reset();
+  std::vector<double> bounds = {1.0, 10.0, 100.0};
+  MetricsRegistry::Get()->ObserveHistogram("/h", 0.5, bounds);
+  MetricsRegistry::Get()->ObserveHistogram("/h", 5.0, bounds);
+  MetricsRegistry::Get()->ObserveHistogram("/h", 500.0, bounds);
+  auto snaps = MetricsRegistry::Get()->Snapshot();
+  assert(snaps.size() == 1);
+  const HistogramData& h = snaps[0].histogram;
+  assert(h.count == 3);
+  assert(h.bucket_counts.size() == 4);
+  assert(h.bucket_counts[0] == 1);  // 0.5 <= 1
+  assert(h.bucket_counts[1] == 1);  // 5 <= 10
+  assert(h.bucket_counts[3] == 1);  // 500 overflow
+  std::string json = StackdriverClient::TimeSeriesJson("p", snaps);
+  CHECK_CONTAINS(json, "\"distributionValue\"");
+  CHECK_CONTAINS(json, "\"count\":3");
+  // mean = 505.5/3 = 168.5
+  CHECK_CONTAINS(json, "\"mean\":168.5");
+  CHECK_CONTAINS(json, "\"bounds\":[1,10,100]");
+  CHECK_CONTAINS(json, "\"bucketCounts\":[1,1,0,1]");
+}
+
+void TestDescriptorGolden() {
+  MetricSnapshot s = CounterSnap("/cloud_tpu/training/steps", 1);
+  s.description = "Completed training steps";
+  std::string json = StackdriverClient::MetricDescriptorJson("proj", s);
+  const std::string expected =
+      "{\"name\":\"projects/proj\",\"metricDescriptor\":{\"type\":"
+      "\"custom.googleapis.com/cloud_tpu/training/steps\","
+      "\"metricKind\":\"CUMULATIVE\",\"valueType\":\"INT64\","
+      "\"description\":\"Completed training steps\"}}";
+  assert(json == expected);
+}
+
+void TestWhitelistAndGate() {
+  Config::ResetForTesting();
+  unsetenv(cloud_tpu::monitoring::kWhitelistEnvVar);
+  unsetenv(cloud_tpu::monitoring::kEnabledEnvVar);
+  const Config* config = Config::Get();
+  assert(config->IsWhitelisted("/cloud_tpu/training/steps"));
+  assert(!config->IsWhitelisted("/not/registered"));
+  assert(!config->enabled());
+
+  Config::ResetForTesting();
+  setenv(cloud_tpu::monitoring::kWhitelistEnvVar, "/a,/b", 1);
+  setenv(cloud_tpu::monitoring::kEnabledEnvVar, "true", 1);
+  config = Config::Get();
+  assert(config->IsWhitelisted("/a"));
+  assert(config->IsWhitelisted("/b"));
+  assert(!config->IsWhitelisted("/cloud_tpu/training/steps"));
+  assert(config->enabled());
+  Config::ResetForTesting();
+  unsetenv(cloud_tpu::monitoring::kWhitelistEnvVar);
+  unsetenv(cloud_tpu::monitoring::kEnabledEnvVar);
+}
+
+void TestExporterFiltersAndDedups() {
+  Config::ResetForTesting();
+  setenv(cloud_tpu::monitoring::kWhitelistEnvVar,
+         "/cloud_tpu/training/steps", 1);
+  MetricsRegistry::Get()->Reset();
+  MetricsRegistry::Get()->IncrementCounter("/cloud_tpu/training/steps", 3);
+  MetricsRegistry::Get()->IncrementCounter("/not/whitelisted", 7);
+
+  std::vector<std::pair<std::string, std::string>> sent;
+  StackdriverClient client("proj",
+                           [&sent](const std::string& method,
+                                   const std::string& json) {
+                             sent.emplace_back(method, json);
+                             return true;
+                           });
+  Exporter exporter(&client);
+  exporter.ExportMetrics();
+  exporter.ExportMetrics();
+
+  // Pass 1: descriptor + series; pass 2: series only (descriptor
+  // dedup, reference exporter.cc:105-126).
+  assert(sent.size() == 3);
+  assert(sent[0].first == "CreateMetricDescriptor");
+  assert(sent[1].first == "CreateTimeSeries");
+  assert(sent[2].first == "CreateTimeSeries");
+  CHECK_CONTAINS(sent[1].second, "/cloud_tpu/training/steps");
+  // The non-whitelisted metric never leaves the process.
+  assert(sent[1].second.find("/not/whitelisted") == std::string::npos);
+  assert(exporter.export_count() == 2);
+
+  Config::ResetForTesting();
+  unsetenv(cloud_tpu::monitoring::kWhitelistEnvVar);
+}
+
+void TestPeriodicGate() {
+  Config::ResetForTesting();
+  unsetenv(cloud_tpu::monitoring::kEnabledEnvVar);
+  StackdriverClient client("proj", nullptr);
+  Exporter exporter(&client);
+  // Gate off -> refuses to start (reference exporter.cc:31-36).
+  assert(!exporter.PeriodicallyExportMetrics());
+  Config::ResetForTesting();
+}
+
+}  // namespace
+
+int main() {
+  TestTimeSeriesGolden();
+  TestDistributionConversion();
+  TestDescriptorGolden();
+  TestWhitelistAndGate();
+  TestExporterFiltersAndDedups();
+  TestPeriodicGate();
+  std::printf("ALL MONITORING TESTS PASSED\n");
+  return 0;
+}

@@ -1,0 +1,183 @@
+#include "stackdriver_client.h"
+
+#include <cstdio>
+#include <sstream>
+
+#include "config.h"
+
+namespace cloud_tpu {
+namespace monitoring {
+
+namespace {
+
+// Metric type prefix (reference stackdriver_client.cc:46). Metric names
+// already carry their /cloud_tpu/... namespace, so the prefix is the
+// bare custom-metrics domain.
+const char kMetricTypePrefix[] = "custom.googleapis.com";
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+
+std::string FormatDouble(double v) {
+  char buf[64];
+  std::snprintf(buf, sizeof(buf), "%g", v);
+  return buf;
+}
+
+// RFC3339 interval from a snapshot timestamp (reference converts
+// timestamps at client.cc:63-67).
+std::string IntervalJson(int64_t micros) {
+  std::stringstream out;
+  out << "{\"endTime\":{\"seconds\":" << micros / 1000000
+      << ",\"nanos\":" << (micros % 1000000) * 1000 << "}}";
+  return out.str();
+}
+
+// Histogram -> Cloud Monitoring Distribution (reference
+// client.cc:69-98: mean, sum-of-squared-deviation, explicit bounds).
+std::string DistributionJson(const HistogramData& h) {
+  const double mean = h.count > 0 ? h.sum / h.count : 0.0;
+  // sum((x - mean)^2) = sum(x^2) - n*mean^2.
+  const double ssd =
+      h.count > 0 ? h.sum_squares - h.count * mean * mean : 0.0;
+  std::stringstream out;
+  out << "{\"count\":" << h.count << ",\"mean\":" << FormatDouble(mean)
+      << ",\"sumOfSquaredDeviation\":" << FormatDouble(ssd)
+      << ",\"bucketOptions\":{\"explicitBuckets\":{\"bounds\":[";
+  for (size_t i = 0; i < h.bucket_bounds.size(); ++i) {
+    if (i) out << ",";
+    out << FormatDouble(h.bucket_bounds[i]);
+  }
+  out << "]}},\"bucketCounts\":[";
+  for (size_t i = 0; i < h.bucket_counts.size(); ++i) {
+    if (i) out << ",";
+    out << h.bucket_counts[i];
+  }
+  out << "]}";
+  return out.str();
+}
+
+// One TimeSeries entry. Only the latest point is sent per series
+// (reference keeps the first point only, client.cc:133-135 — one point
+// per CreateTimeSeries call is a service requirement).
+std::string OneSeriesJson(const std::string& project_id,
+                          const MetricSnapshot& s) {
+  std::stringstream out;
+  out << "{\"metric\":{\"type\":\"" << kMetricTypePrefix
+      << JsonEscape(s.name) << "\"},\"resource\":{\"type\":\"global\","
+      << "\"labels\":{\"project_id\":\"" << JsonEscape(project_id)
+      << "\"}},";
+  switch (s.kind) {
+    case MetricKind::kCounter:
+      out << "\"metricKind\":\"CUMULATIVE\",\"valueType\":\"INT64\","
+          << "\"points\":[{\"interval\":"
+          << IntervalJson(s.timestamp_micros)
+          << ",\"value\":{\"int64Value\":" << s.counter_value << "}}]";
+      break;
+    case MetricKind::kGauge:
+      out << "\"metricKind\":\"GAUGE\",\"valueType\":\"DOUBLE\","
+          << "\"points\":[{\"interval\":"
+          << IntervalJson(s.timestamp_micros)
+          << ",\"value\":{\"doubleValue\":"
+          << FormatDouble(s.gauge_value) << "}}]";
+      break;
+    case MetricKind::kHistogram:
+      out << "\"metricKind\":\"CUMULATIVE\",\"valueType\":"
+          << "\"DISTRIBUTION\",\"points\":[{\"interval\":"
+          << IntervalJson(s.timestamp_micros)
+          << ",\"value\":{\"distributionValue\":"
+          << DistributionJson(s.histogram) << "}}]";
+      break;
+  }
+  out << "}";
+  return out.str();
+}
+
+}  // namespace
+
+std::string StackdriverClient::TimeSeriesJson(
+    const std::string& project_id,
+    const std::vector<MetricSnapshot>& snapshots) {
+  if (snapshots.empty()) return "";
+  std::stringstream out;
+  out << "{\"name\":\"projects/" << JsonEscape(project_id)
+      << "\",\"timeSeries\":[";
+  for (size_t i = 0; i < snapshots.size(); ++i) {
+    if (i) out << ",";
+    out << OneSeriesJson(project_id, snapshots[i]);
+  }
+  out << "]}";
+  return out.str();
+}
+
+std::string StackdriverClient::MetricDescriptorJson(
+    const std::string& project_id, const MetricSnapshot& s) {
+  // Kind/value-type mapping (reference client.cc:138-183).
+  const char* kind = s.kind == MetricKind::kGauge ? "GAUGE" : "CUMULATIVE";
+  const char* value_type =
+      s.kind == MetricKind::kCounter
+          ? "INT64"
+          : (s.kind == MetricKind::kGauge ? "DOUBLE" : "DISTRIBUTION");
+  std::stringstream out;
+  out << "{\"name\":\"projects/" << JsonEscape(project_id)
+      << "\",\"metricDescriptor\":{\"type\":\"" << kMetricTypePrefix
+      << JsonEscape(s.name) << "\",\"metricKind\":\"" << kind
+      << "\",\"valueType\":\"" << value_type << "\",\"description\":\""
+      << JsonEscape(s.description) << "\"}}";
+  return out.str();
+}
+
+StackdriverClient::StackdriverClient(std::string project_id,
+                                     Transport transport)
+    : project_id_(std::move(project_id)),
+      transport_(std::move(transport)) {}
+
+StackdriverClient* StackdriverClient::Get() {
+  static StackdriverClient* client = [] {
+    const Config* config = Config::Get();
+    return new StackdriverClient(config->project_id(),
+                                 FileTransport(config->export_path()));
+  }();
+  return client;
+}
+
+std::string StackdriverClient::CreateTimeSeries(
+    const std::vector<MetricSnapshot>& snapshots) {
+  std::string request = TimeSeriesJson(project_id_, snapshots);
+  if (!request.empty() && transport_) {
+    transport_("CreateTimeSeries", request);
+  }
+  return request;
+}
+
+std::string StackdriverClient::CreateMetricDescriptor(
+    const MetricSnapshot& snapshot) {
+  std::string request = MetricDescriptorJson(project_id_, snapshot);
+  if (transport_) transport_("CreateMetricDescriptor", request);
+  return request;
+}
+
+Transport FileTransport(const std::string& path) {
+  return [path](const std::string& method, const std::string& json) {
+    FILE* out = path.empty() ? stderr : std::fopen(path.c_str(), "a");
+    if (out == nullptr) return false;
+    std::fprintf(out, "{\"method\":\"%s\",\"request\":%s}\n",
+                 method.c_str(), json.c_str());
+    if (!path.empty()) std::fclose(out);
+    return true;
+  };
+}
+
+}  // namespace monitoring
+}  // namespace cloud_tpu

@@ -1,0 +1,69 @@
+// Cloud Monitoring request builder + pluggable transport.
+//
+// Reference parity: src/cpp/monitoring/stackdriver_client.{h,cc} — the
+// singleton client that converts runtime metrics into Cloud Monitoring
+// `CreateTimeSeries` / `CreateMetricDescriptor` requests (histogram ->
+// Distribution with mean/sum-of-squared-deviation/bucket bounds,
+// client.cc:69-98; kind/value-type mapping, client.cc:138-183; metric
+// type prefix `custom.googleapis.com`, client.cc:46). The reference
+// serializes to googleapis protos over gRPC; this implementation
+// produces the canonical JSON encodings of the same protos and hands
+// them to a pluggable Transport (file/stderr by default, a gRPC or REST
+// sender in deployment) so the conversion layer is fully testable with
+// golden JSON (the reference pins golden protos the same way,
+// stackdriver_client_test.cc:79-156).
+
+#ifndef CLOUD_TPU_MONITORING_STACKDRIVER_CLIENT_H_
+#define CLOUD_TPU_MONITORING_STACKDRIVER_CLIENT_H_
+
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "metrics_registry.h"
+
+namespace cloud_tpu {
+namespace monitoring {
+
+// Sends one serialized request; returns false on failure.
+// method is "CreateTimeSeries" or "CreateMetricDescriptor".
+using Transport =
+    std::function<bool(const std::string& method, const std::string& json)>;
+
+class StackdriverClient {
+ public:
+  // Singleton wired to the env-configured project and the default
+  // transport (reference client.cc:45-61).
+  static StackdriverClient* Get();
+
+  StackdriverClient(std::string project_id, Transport transport);
+
+  // Builds + sends a CreateTimeSeries request for the snapshots.
+  // Returns the serialized request (empty when there was nothing to
+  // send).
+  std::string CreateTimeSeries(
+      const std::vector<MetricSnapshot>& snapshots);
+
+  // Builds + sends a CreateMetricDescriptor request for one metric.
+  std::string CreateMetricDescriptor(const MetricSnapshot& snapshot);
+
+  // Conversion helpers (exposed for golden tests).
+  static std::string TimeSeriesJson(const std::string& project_id,
+                                    const std::vector<MetricSnapshot>& s);
+  static std::string MetricDescriptorJson(const std::string& project_id,
+                                          const MetricSnapshot& s);
+
+ private:
+  std::string project_id_;
+  Transport transport_;
+};
+
+// Default transport: appends JSONL records to
+// $CLOUD_TPU_MONITORING_EXPORT_PATH (or stderr when unset). The
+// deployment gRPC sender plugs in here without touching conversion.
+Transport FileTransport(const std::string& path);
+
+}  // namespace monitoring
+}  // namespace cloud_tpu
+
+#endif  // CLOUD_TPU_MONITORING_STACKDRIVER_CLIENT_H_

@@ -1,0 +1,92 @@
+"""Python-side tests for the native monitoring stack.
+
+The C++ layer has its own golden tests (src/cpp/monitoring/
+monitoring_test.cc, mirroring reference stackdriver_client_test.cc);
+these cover the ctypes boundary, the env contract, and the training
+integration.
+"""
+
+import json
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from cloud_tpu import monitoring
+from cloud_tpu.monitoring import native
+
+NATIVE = native.native_available()
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    monitoring.reset_for_testing()
+    yield
+    monitoring.reset_for_testing()
+
+
+class TestRegistryBinding:
+
+    def test_counter_gauge_histogram_snapshot(self, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_MONITORING_PROJECT_ID", "proj")
+        monitoring.reset_for_testing()  # re-read env (native config)
+        monitoring.counter_increment("/cloud_tpu/training/steps", 5)
+        monitoring.gauge_set("/cloud_tpu/mem/hbm_used", 0.5)
+        monitoring.histogram_observe(
+            "/cloud_tpu/training/step_time_usecs_histogram", 1234.0,
+            monitoring.STEP_TIME_BOUNDS)
+        payload = json.loads(monitoring.snapshot_json())
+        assert payload["name"] == "projects/proj"
+        types = {s["metric"]["type"] for s in payload["timeSeries"]}
+        assert ("custom.googleapis.com/cloud_tpu/training/steps"
+                in types)
+
+    @pytest.mark.skipif(not NATIVE, reason="native library not built")
+    def test_native_library_loaded(self):
+        assert "whitelist" in monitoring.config_debug_string()
+
+    @pytest.mark.skipif(not NATIVE, reason="native library not built")
+    def test_flush_writes_export_file(self, tmp_path, monkeypatch):
+        export = str(tmp_path / "export.jsonl")
+        monkeypatch.setenv("CLOUD_TPU_MONITORING_EXPORT_PATH", export)
+        monkeypatch.setenv("CLOUD_TPU_MONITORING_PROJECT_ID", "proj")
+        # Env is read at singleton init inside the already-loaded library;
+        # run the flush in a fresh process so the contract is exercised
+        # exactly as deployment would.
+        code = (
+            "from cloud_tpu.monitoring import native\n"
+            "native.counter_increment('/cloud_tpu/training/steps', 9)\n"
+            "native.flush()\n")
+        result = subprocess.run(
+            ["python", "-c", code], capture_output=True, text=True,
+            env=dict(os.environ, PYTHONPATH="/root/repo"),
+            timeout=120)
+        assert result.returncode == 0, result.stderr
+        lines = [json.loads(l) for l in open(export)]
+        methods = [l["method"] for l in lines]
+        assert methods == ["CreateMetricDescriptor", "CreateTimeSeries"]
+        series = lines[1]["request"]["timeSeries"][0]
+        assert series["points"][0]["value"]["int64Value"] == 9
+
+    @pytest.mark.skipif(not NATIVE, reason="native library not built")
+    def test_periodic_exporter_gate(self, monkeypatch):
+        # Gate off -> start refuses (reference exporter.cc:31-36).
+        monkeypatch.delenv("CLOUD_TPU_MONITORING_ENABLED", raising=False)
+        monitoring.reset_for_testing()
+        assert monitoring.start_exporter() is False
+
+
+class TestTrainingIntegration:
+
+    def test_fit_emits_runtime_metrics(self):
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import Trainer
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=64).astype(np.int32)
+        Trainer(MLP(hidden=16, num_classes=4)).fit(
+            x, y, epochs=1, batch_size=32, verbose=False)
+        snapshot = monitoring.snapshot_json()
+        assert "/cloud_tpu/training/steps" in snapshot
