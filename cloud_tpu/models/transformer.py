@@ -24,9 +24,12 @@ from jax.sharding import PartitionSpec as P
 class CausalSelfAttention(nn.Module):
     num_heads: int
     compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"  # auto | flash | reference
 
     @nn.compact
     def __call__(self, x, mask=None):
+        from cloud_tpu import ops
+
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         dense = lambda feats, name: nn.DenseGeneral(
@@ -37,17 +40,11 @@ class CausalSelfAttention(nn.Module):
         k = dense((self.num_heads, head_dim), "key")(x)
         v = dense((self.num_heads, head_dim), "value")(x)
 
-        q = q / np.sqrt(head_dim).astype(self.compute_dtype)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
-        seq = x.shape[1]
-        causal = jnp.tril(jnp.ones((seq, seq), dtype=bool))
-        if mask is not None:
-            causal = causal & mask[:, None, None, :]
-        logits = jnp.where(causal, logits, jnp.finfo(jnp.float32).min)
-        weights = nn.softmax(logits.astype(jnp.float32), axis=-1)
-        weights = weights.astype(self.compute_dtype)
-
-        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        # "auto" uses the Pallas flash kernel on TPU (mask-free shapes),
+        # the jnp reference elsewhere; both are causal with 1/sqrt(D).
+        out = ops.attention(q, k, v, causal=True, mask=mask,
+                            impl=self.attention_impl)
+        out = out.astype(self.compute_dtype)
         return nn.DenseGeneral(d_model, axis=(-2, -1),
                                dtype=self.compute_dtype, name="out")(out)
 
@@ -57,11 +54,13 @@ class TransformerBlock(nn.Module):
     d_ff: int
     dropout_rate: float = 0.0
     compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
         y = nn.LayerNorm(dtype=self.compute_dtype, name="ln_attn")(x)
         y = CausalSelfAttention(self.num_heads, self.compute_dtype,
+                                self.attention_impl,
                                 name="attention")(y, mask)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
@@ -88,6 +87,7 @@ class TransformerLM(nn.Module):
     max_seq_len: int = 2048
     dropout_rate: float = 0.0
     compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -105,6 +105,7 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = TransformerBlock(self.num_heads, self.d_ff,
                                  self.dropout_rate, self.compute_dtype,
+                                 self.attention_impl,
                                  name="block_%d" % i)(
                                      x, mask, deterministic)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_final")(x)

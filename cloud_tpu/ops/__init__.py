@@ -1,0 +1,7 @@
+"""TPU compute kernels (Pallas) and their jnp reference implementations."""
+
+from cloud_tpu.ops.attention import attention
+from cloud_tpu.ops.attention import flash_attention
+from cloud_tpu.ops.attention import mha_reference
+
+__all__ = ["attention", "flash_attention", "mha_reference"]

@@ -1,0 +1,419 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The compute-path counterpart the reference never had: its attention runs
+wherever `tf.distribute` puts Keras layers (reference core/preprocess.py
+picks a strategy, TF picks kernels). Here the hot op is a hand-written
+TPU kernel: blockwise online-softmax attention that never materializes
+the [S, S] score matrix in HBM, keeps the matmuls on the MXU in bf16/f32,
+and streams K/V blocks through VMEM.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- Grid is (batch*heads, q_blocks, k_blocks) with the k dimension
+  innermost; VMEM scratch (acc, m, l) carries the online-softmax state
+  across k steps, and the output block is written on the last k step.
+- m/l live in (block_q, 128) lane-broadcast scratch, and the saved
+  logsumexp residual is materialized lane-broadcast ([BH, S, 128]) so the
+  backward kernels can read it without cross-lane relayouts (Mosaic has
+  no cheap (N,1)<->(1,N) transpose).
+- Causal blocks strictly above the diagonal are skipped via `pl.when`.
+- Backward = two kernels (dq over k-blocks; dk/dv over q-blocks), the
+  standard FlashAttention-2 recomputation split, wired through
+  `jax.custom_vjp`.
+- Sequences are padded to a block multiple outside the custom_vjp, so
+  autodiff of pad/slice handles the edges; padded keys are masked inside
+  the kernel, padded dO rows are zero so they contribute nothing.
+
+On non-TPU backends the kernels run in Pallas interpret mode (tests), so
+the same code path is exercised everywhere.
+"""
+
+import functools
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+class _Config(NamedTuple):
+    causal: bool
+    sm_scale: float
+    block_q: int
+    block_k: int
+    kv_len: int  # true (unpadded) sequence length
+    interpret: bool
+
+
+def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None):
+    """Pure-jnp multi-head attention, layout [B, S, H, D].
+
+    The correctness oracle for the kernel and the fallback path for
+    shapes/backends the kernel does not cover.
+    """
+    head_dim = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+    logits = logits.astype(jnp.float32)
+    seq_q, seq_k = q.shape[1], k.shape[1]
+    if causal:
+        allowed = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool))
+        logits = jnp.where(allowed, logits, _NEG_INF)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, config, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    block_q, block_k = config.block_q, config.block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * config.sm_scale
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < config.kv_len
+        if config.causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_curr = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_curr)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)
+        l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
+
+    if config.causal:
+        # Blocks strictly above the diagonal contribute nothing: skip.
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _masked_step():
+            _step()
+    else:
+        _step()
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(safe_l)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
+
+
+def _flash_forward(config, q, k, v):
+    """q/k/v: [BH, S_pad, D] -> (out [BH, S_pad, D], lse [BH, S_pad, 128])."""
+    bh, seq, head_dim = q.shape
+    num_q = seq // config.block_q
+    num_k = seq // config.block_k
+    grid = (bh, num_q, num_k)
+    kernel = functools.partial(_fwd_kernel, config=config, num_k=num_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, config.block_q, head_dim),
+                         lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, config.block_k, head_dim),
+                         lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, config.block_k, head_dim),
+                         lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, config.block_q, head_dim),
+                         lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, config.block_q, _LANES),
+                         lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((config.block_q, head_dim), jnp.float32),
+            pltpu.VMEM((config.block_q, _LANES), jnp.float32),
+            pltpu.VMEM((config.block_q, _LANES), jnp.float32),
+        ],
+        interpret=config.interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _attn_probs(config, qi, ki, q, k, lse_col):
+    """Recomputes the (block_q, block_k) probability block."""
+    block_q, block_k = config.block_q, config.block_k
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * config.sm_scale
+    col = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = col < config.kv_len
+    if config.causal:
+        row = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = mask & (col <= row)
+    s = jnp.where(mask, s, _NEG_INF)
+    return jnp.exp(s - lse_col)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, config, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        p = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * config.sm_scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if config.causal:
+        @pl.when(ki * config.block_k <= qi * config.block_q
+                 + config.block_q - 1)
+        def _masked_step():
+            _step()
+    else:
+        _step()
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_acc, dv_acc, *, config, num_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        p = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1])
+        # dV += P^T dO   (contract over the q rows)
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * config.sm_scale
+        # dK += dS^T Q
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if config.causal:
+        @pl.when(ki * config.block_k <= qi * config.block_q
+                 + config.block_q - 1)
+        def _masked_step():
+            _step()
+    else:
+        _step()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(config, q, k, v, out, lse, g):
+    bh, seq, head_dim = q.shape
+    num_q = seq // config.block_q
+    num_k = seq // config.block_k
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, seq, _LANES))
+
+    q_spec = pl.BlockSpec((1, config.block_q, head_dim),
+                          lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, config.block_q, _LANES),
+                            lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, config.block_k, head_dim),
+                          lambda b, i, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, config=config, num_k=num_k),
+        grid=(bh, num_q, num_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        scratch_shapes=[
+            pltpu.VMEM((config.block_q, head_dim), jnp.float32)],
+        interpret=config.interpret,
+    )(q, k, v, g, lse, delta)[0]
+
+    # dk/dv: k-blocks are the outer (parallel) dim, q-blocks innermost.
+    qT_spec = pl.BlockSpec((1, config.block_q, head_dim),
+                           lambda b, j, i: (b, i, 0))
+    rowT_spec = pl.BlockSpec((1, config.block_q, _LANES),
+                             lambda b, j, i: (b, i, 0))
+    kT_spec = pl.BlockSpec((1, config.block_k, head_dim),
+                           lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, config=config, num_q=num_q),
+        grid=(bh, num_k, num_q),
+        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
+        out_specs=[kT_spec, kT_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((config.block_k, head_dim), jnp.float32),
+            pltpu.VMEM((config.block_k, head_dim), jnp.float32),
+        ],
+        interpret=config.interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_attention(config, q, k, v):
+    out, _ = _flash_forward(config, q, k, v)
+    return out
+
+
+def _flash_attention_fwd(config, q, k, v):
+    out, lse = _flash_forward(config, q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(config, residuals, g):
+    q, k, v, out, lse = residuals
+    return _flash_backward(config, q, k, v, out, lse, g)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def flash_attention(q, k, v, causal=True, sm_scale=None,
+                    block_q=128, block_k=128,
+                    interpret: Optional[bool] = None):
+    """Blockwise flash attention, layout [batch, seq, heads, head_dim].
+
+    Args:
+        q, k, v: [B, S, H, D] arrays (any float dtype; compute is f32 on
+            the MXU, output in the input dtype).
+        causal: Apply a causal (autoregressive) mask.
+        sm_scale: Softmax temperature; default 1/sqrt(D).
+        block_q / block_k: Kernel tile sizes along the sequence. S is
+            padded up to a multiple internally.
+        interpret: Force Pallas interpret mode. Default: interpret
+            everywhere except on real TPU backends.
+
+    Returns:
+        [B, S, H, D] attention output, differentiable w.r.t. q/k/v.
+    """
+    batch, seq, heads, head_dim = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    block = max(block_q, block_k)
+    if block_q % min(block_q, block_k) or block_k % min(block_q, block_k):
+        raise ValueError(
+            "block_q={} and block_k={} must divide one another.".format(
+                block_q, block_k))
+    seq_pad = -(-seq // block) * block
+    block_q = min(block_q, seq_pad)
+    block_k = min(block_k, seq_pad)
+
+    config = _Config(causal=bool(causal), sm_scale=float(sm_scale),
+                     block_q=block_q, block_k=block_k, kv_len=seq,
+                     interpret=bool(interpret))
+
+    def fold(x):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(
+            batch * heads, seq, head_dim)
+        if seq_pad != seq:
+            x = jnp.pad(x, ((0, 0), (0, seq_pad - seq), (0, 0)))
+        return x
+
+    out = _flash_attention(config, fold(q), fold(k), fold(v))
+    out = out[:, :seq].reshape(batch, heads, seq, head_dim)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def attention(q, k, v, causal=True, sm_scale=None, mask=None, impl="auto"):
+    """Dispatching attention: pallas flash kernel or jnp reference.
+
+    impl: "auto" picks the flash kernel on TPU for mask-free shapes,
+    the jnp reference elsewhere; "flash"/"reference" force a path.
+    """
+    if impl == "flash":
+        if mask is not None:
+            raise NotImplementedError(
+                "flash path does not take a padding mask; use "
+                "impl='reference'.")
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if impl == "reference":
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                             mask=mask)
+    if impl != "auto":
+        raise ValueError("Unknown attention impl: {!r}".format(impl))
+    if mask is None and jax.default_backend() == "tpu":
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                         mask=mask)
