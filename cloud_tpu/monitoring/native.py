@@ -49,8 +49,9 @@ def _load():
             lib.cloud_tpu_exporter_start.restype = ctypes.c_int
             lib.cloud_tpu_exporter_export_count.restype = ctypes.c_int64
             return lib
-        except OSError:
-            # Stale/foreign .so: keep looking, fall back to Python.
+        except (OSError, AttributeError):
+            # Unloadable or stale .so (missing symbols): keep looking,
+            # fall back to Python.
             continue
     return None
 

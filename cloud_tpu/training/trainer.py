@@ -420,7 +420,7 @@ class Trainer:
                 if steps_per_epoch is not None and step >= steps_per_epoch:
                     break
                 leaves = jax.tree_util.tree_leaves(batch)
-                if leaves:
+                if leaves and getattr(leaves[0], "shape", ()):
                     examples += int(leaves[0].shape[0])
                 batch = self._feed(batch)
                 self.state, logs = self._jit_train_step(self.state, batch)

@@ -90,10 +90,12 @@ int cloud_tpu_exporter_start(int64_t interval_micros) {
 void cloud_tpu_exporter_flush() { GetExporter()->ExportMetrics(); }
 
 int64_t cloud_tpu_exporter_export_count() {
+  std::lock_guard<std::mutex> lock(g_exporter_mu);
   return g_exporter == nullptr ? 0 : g_exporter->export_count();
 }
 
 void cloud_tpu_exporter_stop() {
+  std::lock_guard<std::mutex> lock(g_exporter_mu);
   if (g_exporter != nullptr) g_exporter->Stop();
 }
 

@@ -60,7 +60,8 @@ const Config* Config::Get() {
 
 void Config::ResetForTesting() {
   std::lock_guard<std::mutex> lock(g_mu);
-  delete g_config;
+  // Intentionally leaked: Exporter threads and callers hold raw const
+  // pointers from Get(); deleting here would be a use-after-free.
   g_config = nullptr;
 }
 

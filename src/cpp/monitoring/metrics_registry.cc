@@ -23,6 +23,7 @@ void MetricsRegistry::IncrementCounter(const std::string& name,
                                        int64_t delta) {
   std::lock_guard<std::mutex> lock(mu_);
   auto& metric = metrics_[name];
+  if (metric.start_time_micros == 0) metric.start_time_micros = NowMicros();
   metric.kind = MetricKind::kCounter;
   metric.counter += delta;
 }
@@ -30,6 +31,7 @@ void MetricsRegistry::IncrementCounter(const std::string& name,
 void MetricsRegistry::SetGauge(const std::string& name, double value) {
   std::lock_guard<std::mutex> lock(mu_);
   auto& metric = metrics_[name];
+  if (metric.start_time_micros == 0) metric.start_time_micros = NowMicros();
   metric.kind = MetricKind::kGauge;
   metric.gauge = value;
 }
@@ -39,6 +41,7 @@ void MetricsRegistry::ObserveHistogram(const std::string& name,
                                        const std::vector<double>& bounds) {
   std::lock_guard<std::mutex> lock(mu_);
   auto& metric = metrics_[name];
+  if (metric.start_time_micros == 0) metric.start_time_micros = NowMicros();
   if (metric.histogram.bucket_bounds.empty()) {
     metric.kind = MetricKind::kHistogram;
     metric.histogram.bucket_bounds = bounds;
@@ -75,6 +78,7 @@ std::vector<MetricSnapshot> MetricsRegistry::Snapshot() const {
     snap.gauge_value = entry.second.gauge;
     snap.histogram = entry.second.histogram;
     snap.timestamp_micros = now;
+    snap.start_time_micros = entry.second.start_time_micros;
     out.push_back(std::move(snap));
   }
   return out;

@@ -38,6 +38,9 @@ struct MetricSnapshot {
   double gauge_value = 0.0;
   HistogramData histogram;
   int64_t timestamp_micros = 0;
+  // Creation time of the underlying metric; CUMULATIVE time series must
+  // report an interval start earlier than the end.
+  int64_t start_time_micros = 0;
 };
 
 // Process-global registry. All operations are thread-safe.
@@ -64,6 +67,7 @@ class MetricsRegistry {
     int64_t counter = 0;
     double gauge = 0.0;
     HistogramData histogram;
+    int64_t start_time_micros = 0;
   };
 
   mutable std::mutex mu_;

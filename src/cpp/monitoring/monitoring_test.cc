@@ -39,6 +39,7 @@ MetricSnapshot CounterSnap(const std::string& name, int64_t value) {
   s.kind = MetricKind::kCounter;
   s.counter_value = value;
   s.timestamp_micros = 1500000000000000;  // fixed for golden output
+  s.start_time_micros = 1400000000000000;
   return s;
 }
 
@@ -52,7 +53,8 @@ void TestTimeSeriesGolden() {
       "\"custom.googleapis.com/cloud_tpu/training/steps\"},"
       "\"resource\":{\"type\":\"global\",\"labels\":{\"project_id\":"
       "\"proj\"}},\"metricKind\":\"CUMULATIVE\",\"valueType\":\"INT64\","
-      "\"points\":[{\"interval\":{\"endTime\":{\"seconds\":1500000000,"
+      "\"points\":[{\"interval\":{\"startTime\":{\"seconds\":1400000000,"
+      "\"nanos\":0},\"endTime\":{\"seconds\":1500000000,"
       "\"nanos\":0}},\"value\":{\"int64Value\":42}}]}]}";
   assert(json == expected);
 }

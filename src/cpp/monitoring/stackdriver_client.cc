@@ -35,11 +35,19 @@ std::string FormatDouble(double v) {
   return buf;
 }
 
-// RFC3339 interval from a snapshot timestamp (reference converts
-// timestamps at client.cc:63-67).
-std::string IntervalJson(int64_t micros) {
+// Interval from snapshot timestamps (reference converts timestamps at
+// client.cc:63-67). CUMULATIVE kinds must carry a startTime strictly
+// earlier than endTime, so pass start_micros > 0 for counters and
+// histograms; GAUGE intervals are end-only (start_micros == 0).
+std::string IntervalJson(int64_t micros, int64_t start_micros = 0) {
   std::stringstream out;
-  out << "{\"endTime\":{\"seconds\":" << micros / 1000000
+  out << "{";
+  if (start_micros > 0) {
+    if (micros <= start_micros) micros = start_micros + 1;
+    out << "\"startTime\":{\"seconds\":" << start_micros / 1000000
+        << ",\"nanos\":" << (start_micros % 1000000) * 1000 << "},";
+  }
+  out << "\"endTime\":{\"seconds\":" << micros / 1000000
       << ",\"nanos\":" << (micros % 1000000) * 1000 << "}}";
   return out.str();
 }
@@ -82,7 +90,7 @@ std::string OneSeriesJson(const std::string& project_id,
     case MetricKind::kCounter:
       out << "\"metricKind\":\"CUMULATIVE\",\"valueType\":\"INT64\","
           << "\"points\":[{\"interval\":"
-          << IntervalJson(s.timestamp_micros)
+          << IntervalJson(s.timestamp_micros, s.start_time_micros)
           << ",\"value\":{\"int64Value\":" << s.counter_value << "}}]";
       break;
     case MetricKind::kGauge:
@@ -95,7 +103,7 @@ std::string OneSeriesJson(const std::string& project_id,
     case MetricKind::kHistogram:
       out << "\"metricKind\":\"CUMULATIVE\",\"valueType\":"
           << "\"DISTRIBUTION\",\"points\":[{\"interval\":"
-          << IntervalJson(s.timestamp_micros)
+          << IntervalJson(s.timestamp_micros, s.start_time_micros)
           << ",\"value\":{\"distributionValue\":"
           << DistributionJson(s.histogram) << "}}]";
       break;

@@ -60,7 +60,9 @@ class TestRegistryBinding:
             "native.flush()\n")
         result = subprocess.run(
             ["python", "-c", code], capture_output=True, text=True,
-            env=dict(os.environ, PYTHONPATH="/root/repo"),
+            env=dict(os.environ, PYTHONPATH=os.path.dirname(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))),
             timeout=120)
         assert result.returncode == 0, result.stderr
         lines = [json.loads(l) for l in open(export)]
