@@ -24,7 +24,7 @@ from jax.sharding import PartitionSpec as P
 class CausalSelfAttention(nn.Module):
     num_heads: int
     compute_dtype: jnp.dtype = jnp.bfloat16
-    attention_impl: str = "auto"  # auto | flash | reference
+    attention_impl: str = "auto"  # auto | flash | reference | ring
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -40,10 +40,21 @@ class CausalSelfAttention(nn.Module):
         k = dense((self.num_heads, head_dim), "key")(x)
         v = dense((self.num_heads, head_dim), "value")(x)
 
-        # "auto" uses the Pallas flash kernel on TPU (mask-free shapes),
-        # the jnp reference elsewhere; both are causal with 1/sqrt(D).
-        out = ops.attention(q, k, v, causal=True, mask=mask,
-                            impl=self.attention_impl)
+        if self.attention_impl == "ring":
+            # Sequence-parallel long-context path: the sequence dim is
+            # sharded over the ambient mesh's "sp" axis and K/V rotate
+            # around the ring (cloud_tpu/parallel/ring_attention.py).
+            from cloud_tpu.parallel import sequence_parallel_attention
+            if mask is not None:
+                raise NotImplementedError(
+                    "ring attention does not take a padding mask.")
+            out = sequence_parallel_attention(q, k, v, causal=True)
+        else:
+            # "auto" uses the Pallas flash kernel on TPU (mask-free
+            # shapes), the jnp reference elsewhere; both are causal
+            # with 1/sqrt(D).
+            out = ops.attention(q, k, v, causal=True, mask=mask,
+                                impl=self.attention_impl)
         out = out.astype(self.compute_dtype)
         return nn.DenseGeneral(d_model, axis=(-2, -1),
                                dtype=self.compute_dtype, name="out")(out)

@@ -1,0 +1,16 @@
+"""Parallelism: ambient mesh runtime, sharding specs, sequence parallel.
+
+The TPU-native substrate replacing the reference's delegation to
+`tf.distribute` strategies (SURVEY §2.3/§2.4): explicit
+`jax.sharding.Mesh` + NamedSharding layouts with XLA collectives over
+ICI/DCN, plus ring attention for long-context sequence parallelism
+(absent from the reference; first-class here).
+"""
+
+from cloud_tpu.parallel import runtime
+from cloud_tpu.parallel import sharding
+from cloud_tpu.parallel.ring_attention import ring_attention
+from cloud_tpu.parallel.ring_attention import sequence_parallel_attention
+
+__all__ = ["runtime", "sharding", "ring_attention",
+           "sequence_parallel_attention"]

@@ -419,9 +419,11 @@ class Trainer:
             for step, batch in enumerate(self._epoch_batches(dataset)):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
                     break
-                leaves = jax.tree_util.tree_leaves(batch)
-                if leaves and getattr(leaves[0], "shape", ()):
-                    examples += int(leaves[0].shape[0])
+                batched = next(
+                    (l for l in jax.tree_util.tree_leaves(batch)
+                     if getattr(l, "shape", ())), None)
+                if batched is not None:
+                    examples += int(batched.shape[0])
                 batch = self._feed(batch)
                 self.state, logs = self._jit_train_step(self.state, batch)
                 # Keep logs as device arrays: no host sync inside the hot
