@@ -41,6 +41,8 @@ def run(
     stream_logs=False,
     docker_image_bucket_name=None,
     job_labels=None,
+    container_builder_cls=None,
+    api_client=None,
     **kwargs
 ):
     """Runs your training code on Cloud TPUs (or GPUs) in GCP.
@@ -63,6 +65,11 @@ def run(
         docker_image_bucket_name: When set, containerize via GCS + Cloud
             Build instead of the local docker daemon.
         job_labels: Optional dict of up-to-64 str: str job labels.
+        container_builder_cls: Optional `ContainerBuilder` subclass
+            overriding the Local/Cloud choice — the injection seam for
+            offline use and tests.
+        api_client: Optional AI-Platform jobs API client forwarded to
+            `deploy.deploy_job` (same seam).
         **kwargs: Swallowed-then-rejected for forward compatibility with
             newer clients in older cloud environments (reference
             run.py:137-145).
@@ -141,7 +148,9 @@ def run(
         "docker_image_bucket_name": docker_image_bucket_name,
         "called_from_notebook": called_from_notebook,
     }
-    if docker_image_bucket_name is None:
+    if container_builder_cls is not None:
+        container_builder = container_builder_cls(*cb_args, **cb_kwargs)
+    elif docker_image_bucket_name is None:
         container_builder = containerize.LocalContainerBuilder(
             *cb_args, **cb_kwargs)
     else:
@@ -165,6 +174,7 @@ def run(
         entry_point_args,
         stream_logs,
         job_labels=job_labels,
+        api_client=api_client,
     )
 
     # In the self-launch case the rest of this script is the training

@@ -158,7 +158,8 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
 
 
 def sequence_parallel_attention(q, k, v, mesh=None, axis="sp", causal=True,
-                                sm_scale=None, batch_axis="auto"):
+                                sm_scale=None, batch_axis="auto",
+                                head_axis="auto"):
     """Ring attention over global [B, S, H, D] arrays on a mesh.
 
     The standalone entry point: shards the sequence dim over `axis` with
@@ -169,6 +170,12 @@ def sequence_parallel_attention(q, k, v, mesh=None, axis="sp", causal=True,
     batch_axis: Mesh axis the batch dim is sharded over — "auto" picks
     the ambient data axis ("dp") when the mesh has one, so ring (sp) and
     data (dp) parallelism compose without replicated compute.
+
+    head_axis: Mesh axis the head dim is sharded over — "auto" picks the
+    ambient model axis ("tp") when the mesh has one and the head count
+    divides it. Heads are independent in attention, so this composes
+    ring (sp) with Megatron-style tensor parallelism (tp-sharded qkv
+    heads stay resident; no cross-tp gather).
     """
     try:
         from jax import shard_map
@@ -193,26 +200,35 @@ def sequence_parallel_attention(q, k, v, mesh=None, axis="sp", causal=True,
             "Sequence length {} must divide the {!r} axis size {}.".format(
                 seq, axis, axis_size))
 
-    if batch_axis == "auto":
-        from cloud_tpu.parallel import sharding as _sharding
-        batch_axis = (_sharding.DATA_AXIS
-                      if _sharding.DATA_AXIS in mesh.axis_names else None)
-        # An indivisible batch (e.g. the size-1 sample batch model init
-        # uses) falls back to replicating over the batch axis; only the
-        # implicit path gets this leniency.
-        if batch_axis is not None and q.shape[0] % mesh.shape[batch_axis]:
-            batch_axis = None
-    elif batch_axis is not None:
-        if batch_axis not in mesh.axis_names:
+    from cloud_tpu.parallel import sharding as _sharding
+
+    def _resolve_axis(value, default_axis, dim, what):
+        """auto -> default axis when present+divisible; explicit axes
+        are validated, only the implicit path gets silent fallback."""
+        if value == "auto":
+            resolved = (default_axis
+                        if default_axis in mesh.axis_names else None)
+            if resolved is not None and dim % mesh.shape[resolved]:
+                resolved = None
+            return resolved
+        if value is None:
+            return None
+        if value not in mesh.axis_names:
             raise ValueError(
-                "Mesh axes {} have no {!r} batch axis.".format(
-                    tuple(mesh.axis_names), batch_axis))
-        if q.shape[0] % mesh.shape[batch_axis]:
+                "Mesh axes {} have no {!r} {} axis.".format(
+                    tuple(mesh.axis_names), value, what))
+        if dim % mesh.shape[value]:
             raise ValueError(
-                "Batch size {} is not divisible by the {!r} axis size "
-                "{}.".format(q.shape[0], batch_axis,
-                             mesh.shape[batch_axis]))
-    spec = P(batch_axis, axis, None, None)
+                "{} size {} is not divisible by the {!r} axis size "
+                "{}.".format(what.capitalize(), dim, value,
+                             mesh.shape[value]))
+        return value
+
+    batch_axis = _resolve_axis(batch_axis, _sharding.DATA_AXIS,
+                               q.shape[0], "batch")
+    head_axis = _resolve_axis(head_axis, _sharding.MODEL_AXIS,
+                              q.shape[2], "head")
+    spec = P(batch_axis, axis, head_axis, None)
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal,
                            sm_scale=sm_scale, kv_len=seq)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

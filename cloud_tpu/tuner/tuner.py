@@ -67,9 +67,16 @@ class CloudOracle:
                  study_config=None,
                  max_trials=None,
                  study_id=None,
-                 service_client=None):
-        self.project_id = project_id or gcp.get_project_name()
-        self.region = region or gcp.get_region()
+                 service_client=None,
+                 client=None):
+        # With an injected `client` the GCP identity is cosmetic: don't
+        # force credential/project resolution offline.
+        if client is not None:
+            self.project_id = project_id
+            self.region = region
+        else:
+            self.project_id = project_id or gcp.get_project_name()
+            self.region = region or gcp.get_region()
 
         if study_config is not None:
             if objective is not None or hyperparameters is not None:
@@ -96,7 +103,10 @@ class CloudOracle:
         self.max_trials = max_trials
         self.study_id = study_id or "cloud_tpu_tuner_{}".format(
             int(time.time()))
-        self.client = optimizer_client.create_or_load_study(
+        # Two injection seams: `service_client` fakes the REST transport
+        # under the real OptimizerClient; `client` replaces the
+        # OptimizerClient surface wholesale (offline demos, unit tests).
+        self.client = client or optimizer_client.create_or_load_study(
             self.project_id, self.region, self.study_id, self.study_config,
             service_client=service_client)
 

@@ -1,0 +1,65 @@
+"""Incremental metrics-stream watcher.
+
+TPU-native counterpart of the reference's TensorBoard event-file watcher
+(`get_tensorboard_log_watcher_from_path`, reference utils/tf_utils.py:
+27-51), which DistributingCloudTuner uses as its metrics return channel
+(reference tuner/tuner.py:532-560, parsing `epoch_*` tag conventions out
+of event streams). The native channel is structured jsonl written by
+`cloud_tpu.training.callbacks.MetricsLogger` — one JSON object per
+epoch — so the watcher is a byte-offset tail, not an event-proto parser,
+and the fragile tag-prefix convention disappears (SURVEY §7.4.6).
+
+Works over local paths and `gs://` objects through the storage seam;
+remote objects are re-read and diffed by offset, mirroring how the
+reference's DirectoryWatcher re-polls GCS.
+"""
+
+import json
+
+from cloud_tpu.utils import storage
+
+
+class MetricsWatcher:
+    """Tails a metrics jsonl stream, yielding only records not yet seen.
+
+    Usage (the tuner's live-readback loop):
+
+        watcher = MetricsWatcher(path)
+        while job_running():
+            for record in watcher.poll():
+                report(record)
+    """
+
+    def __init__(self, path):
+        self.path = path
+        self._offset = 0
+        self._partial = b""
+
+    def poll(self):
+        """Returns the list of complete records appended since last poll.
+
+        Missing files mean "not started yet" and return []. A trailing
+        partial line (a concurrent writer mid-append) is buffered until
+        its newline arrives.
+        """
+        if not storage.exists(self.path):
+            return []
+        data = storage.read_bytes(self.path)
+        if len(data) <= self._offset:
+            return []
+        new = self._partial + data[self._offset:]
+        self._offset = len(data)
+        lines = new.split(b"\n")
+        self._partial = lines.pop()
+        records = []
+        for line in lines:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+        return records
+
+
+def get_metrics_watcher_from_path(path):
+    """Factory mirroring the reference's watcher factory
+    (reference utils/tf_utils.py:27-51)."""
+    return MetricsWatcher(path)

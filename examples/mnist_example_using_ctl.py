@@ -1,0 +1,66 @@
+"""MNIST with a custom training loop and a user-managed mesh.
+
+The escape hatch: `distribution_strategy=None` launches user code
+unwrapped (reference run.py:79-83; CTL example
+core/tests/testdata/mnist_example_using_ctl.py, which builds its own
+MultiWorkerMirroredStrategy). The JAX form: build your own Mesh, place
+params and batches yourself, jit your own step.
+
+Run: python examples/mnist_example_using_ctl.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cloud_tpu.models import MLP
+
+
+def main():
+    # User-managed mesh over all local devices: pure data parallelism.
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    replicate = NamedSharding(mesh, P())
+    shard_batch = NamedSharding(mesh, P("dp"))
+
+    model = MLP(hidden=256, num_classes=10)
+    optimizer = optax.sgd(0.1, momentum=0.9)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2048, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, size=2048).astype(np.int32)
+
+    params = model.init(jax.random.PRNGKey(0), x[:1])
+    params = jax.device_put(params, replicate)
+    opt_state = jax.device_put(optimizer.init(params), replicate)
+
+    @jax.jit
+    def train_step(params, opt_state, bx, by):
+        def loss_fn(p):
+            logits = model.apply(p, bx)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, by).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    batch_size = 256
+    # Round down to a whole number of batches; the dp axis requires the
+    # batch dim to divide evenly across devices.
+    steps = len(x) // batch_size
+    for epoch in range(2):
+        epoch_loss = 0.0
+        for i in range(steps):
+            bx = jax.device_put(
+                x[i * batch_size:(i + 1) * batch_size], shard_batch)
+            by = jax.device_put(
+                y[i * batch_size:(i + 1) * batch_size], shard_batch)
+            params, opt_state, loss = train_step(params, opt_state, bx, by)
+            epoch_loss += float(loss)
+        print("epoch %d loss: %.4f" % (epoch, epoch_loss / steps))
+
+
+if __name__ == "__main__":
+    main()

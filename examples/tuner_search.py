@@ -1,0 +1,109 @@
+"""Hyperparameter search with CloudTuner.
+
+Reference parity: the KerasTuner-on-Vizier flow (reference
+tuner/tuner.py:333-381 and tuner/tests/examples) — define a search
+space, build a Trainer per trial, let the oracle drive suggestions. The
+Vizier boundary is injectable (`CloudOracle(client=...)`), so this
+example runs offline with a random-search fake while the whole
+trial-loop machinery (suggest -> train -> report-per-epoch -> complete)
+executes for real. Drop the `client` kwarg (with GCP credentials) to
+search against real Vizier.
+
+Run: python examples/tuner_search.py
+"""
+
+import numpy as np
+import optax
+
+from cloud_tpu.models import MLP
+from cloud_tpu.training import Trainer
+from cloud_tpu.tuner import CloudTuner, HyperParameters
+
+
+class FakeVizier:
+    """Random-search stand-in implementing the OptimizerClient surface
+    (cloud_tpu/tuner/optimizer_client.py)."""
+
+    def __init__(self, hps):
+        self.hps = hps
+        self.trials = []
+        self.measurements = {}
+
+    def get_suggestions(self, client_id):
+        hp = self.hps.random_sample(seed=len(self.trials))
+        # Vizier wire format: typed value keys per parameter.
+        params = []
+        for name, value in hp.values.items():
+            if isinstance(value, bool) or isinstance(value, str):
+                params.append({"parameter": name,
+                               "stringValue": str(value)})
+            elif isinstance(value, int):
+                params.append({"parameter": name, "intValue": value})
+            else:
+                params.append({"parameter": name, "floatValue": value})
+        trial = {"name": "trials/%d" % (len(self.trials) + 1),
+                 "parameters": params, "state": "ACTIVE"}
+        self.trials.append(trial)
+        return {"trials": [trial]}
+
+    def list_trials(self):
+        return list(self.trials)
+
+    def report_intermediate_objective_value(self, step, elapsed_secs,
+                                            metric_list, trial_id):
+        self.measurements.setdefault(trial_id, []).append(
+            {"stepCount": step, "metrics": metric_list})
+
+    def should_trial_stop(self, trial_id):
+        return False
+
+    def complete_trial(self, trial_id, trial_infeasible=False,
+                       infeasibility_reason=None):
+        trial = self.trials[int(trial_id) - 1]
+        trial["state"] = ("INFEASIBLE" if trial_infeasible
+                          else "COMPLETED")
+        reported = self.measurements.get(trial_id)
+        if reported:
+            trial["finalMeasurement"] = reported[-1]
+        return trial
+
+
+def build_trainer(hp):
+    """Model-per-trial factory, KerasTuner `build(hp)` style."""
+    return Trainer(
+        model=MLP(hidden=hp.get("hidden"), num_classes=10),
+        optimizer=optax.adam(hp.get("learning_rate")),
+        loss="sparse_categorical_crossentropy",
+        metrics=("accuracy",),
+    )
+
+
+def main():
+    hps = HyperParameters()
+    hps.Choice("hidden", [64, 128, 256])
+    hps.Float("learning_rate", 1e-4, 1e-2, sampling="log")
+
+    tuner = CloudTuner(
+        build_trainer,
+        directory="/tmp/cloud_tpu_tuner_demo",
+        project_id="my-project",
+        region="us-central1",
+        objective="accuracy",
+        hyperparameters=hps,
+        max_trials=3,
+        study_id="demo_study",
+        client=FakeVizier(hps),
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1024, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, size=1024).astype(np.int32)
+
+    tuner.search(x=x, y=y, epochs=1, batch_size=128, verbose=False)
+    best = tuner.get_best_hyperparameters()[0]
+    print("best hidden=%s lr=%.5f" % (best.get("hidden"),
+                                      best.get("learning_rate")))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Metrics watcher: incremental jsonl tailing.
+
+Parity model: reference utils/tests/unit/tf_utils_test.py (the event
+watcher factory test) and the real-event-file readback in
+tuner/tests/unit/tuner_test.py:497-515 — here against the native jsonl
+channel instead of TensorBoard event protos.
+"""
+
+import json
+import os
+
+from cloud_tpu.training.callbacks import MetricsLogger
+from cloud_tpu.utils.metrics_watcher import (MetricsWatcher,
+                                             get_metrics_watcher_from_path)
+
+
+class TestMetricsWatcher:
+    def test_missing_file_polls_empty(self, tmp_path):
+        watcher = MetricsWatcher(str(tmp_path / "nope.jsonl"))
+        assert watcher.poll() == []
+
+    def test_incremental_tail(self, tmp_path):
+        path = str(tmp_path / "metrics.jsonl")
+        watcher = MetricsWatcher(path)
+        with open(path, "w") as f:
+            f.write(json.dumps({"epoch": 0, "loss": 2.0}) + "\n")
+        assert watcher.poll() == [{"epoch": 0, "loss": 2.0}]
+        assert watcher.poll() == []  # nothing new
+        with open(path, "a") as f:
+            f.write(json.dumps({"epoch": 1, "loss": 1.5}) + "\n")
+            f.write(json.dumps({"epoch": 2, "loss": 1.2}) + "\n")
+        assert [r["epoch"] for r in watcher.poll()] == [1, 2]
+
+    def test_partial_line_buffered_until_complete(self, tmp_path):
+        path = str(tmp_path / "metrics.jsonl")
+        watcher = MetricsWatcher(path)
+        record = json.dumps({"epoch": 0, "loss": 2.0})
+        with open(path, "w") as f:
+            f.write(record[:10])  # writer mid-append
+        assert watcher.poll() == []
+        with open(path, "a") as f:
+            f.write(record[10:] + "\n")
+        assert watcher.poll() == [{"epoch": 0, "loss": 2.0}]
+
+    def test_reads_metrics_logger_output(self, tmp_path):
+        """The writer (training callback) and watcher agree end-to-end."""
+        path = str(tmp_path / "logs" / "metrics.jsonl")
+        logger = MetricsLogger(path)
+        logger.on_train_begin()
+        watcher = get_metrics_watcher_from_path(path)
+        logger.on_epoch_end(0, {"loss": 3.0, "accuracy": 0.1})
+        records = watcher.poll()
+        assert len(records) == 1
+        assert records[0]["loss"] == 3.0
+        logger.on_epoch_end(1, {"loss": 2.0, "accuracy": 0.4})
+        records = watcher.poll()
+        assert len(records) == 1
+        assert records[0]["epoch"] == 1
+
+    def test_blank_lines_skipped(self, tmp_path):
+        path = str(tmp_path / "metrics.jsonl")
+        with open(path, "w") as f:
+            f.write("\n" + json.dumps({"epoch": 0}) + "\n\n")
+        assert MetricsWatcher(path).poll() == [{"epoch": 0}]

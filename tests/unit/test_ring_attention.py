@@ -168,3 +168,26 @@ class TestRingInTransformer:
                 ring_grads, ref_grads)
         finally:
             runtime.reset()
+
+
+class TestRingTensorParallelComposition:
+    def test_heads_sharded_on_tp(self):
+        """Ring (sp) composes with tp-sharded heads on a dp x tp x sp
+        mesh: heads stay resident per tp group, results match."""
+        devices = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+        q, k, v = _rand_qkv(batch=2, seq=16, heads=4, head_dim=8)
+        with Mesh(devices, ("dp", "tp", "sp")) as mesh:
+            out = sequence_parallel_attention(q, k, v, mesh=mesh,
+                                              causal=True)
+        expected = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_explicit_bad_head_axis_raises(self):
+        devices = np.array(jax.devices()[:4]).reshape(2, 2)
+        q, k, v = _rand_qkv(batch=2, seq=16, heads=3, head_dim=8)
+        with Mesh(devices, ("dp", "tp")) as mesh:
+            with pytest.raises(ValueError, match="not divisible"):
+                sequence_parallel_attention(q, k, v, mesh=mesh, axis="dp",
+                                            batch_axis=None,
+                                            head_axis="tp")
