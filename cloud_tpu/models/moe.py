@@ -1,0 +1,113 @@
+"""Mixture-of-Experts MLP with expert parallelism.
+
+Expert parallelism is another axis the reference never had (SURVEY §2.3
+lists EP among the explicitly-absent strategies). The design is the
+GShard/Switch dense-dispatch pattern, which is the XLA-friendly way to
+do MoE on TPU: routing is expressed as dense one-hot dispatch/combine
+einsums (static shapes, MXU-tiled), expert weights carry a leading
+[num_experts] dim sharded over the mesh's "ep" axis, and XLA inserts the
+all-to-alls when the dispatch einsum crosses the expert axis — no manual
+collectives, the compiler schedules them on ICI.
+
+Capacity-based top-1 (Switch) routing: each expert processes at most
+`capacity = capacity_factor * tokens / num_experts` tokens; overflow
+tokens are dropped (contribute zero, standard Switch behavior) and the
+load-balancing auxiliary loss pushes the router toward uniform load.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class MoEMLP(nn.Module):
+    """Switch-routing MoE feed-forward block, drop-in for a dense MLP.
+
+    Call returns (output, aux_loss); add `aux_loss * aux_weight` to the
+    training loss to balance expert load.
+    """
+
+    num_experts: int = 8
+    d_ff: int = 2048
+    capacity_factor: float = 1.25
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    router_noise: float = 0.0  # jitter std during training (0 = off)
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        """x: [batch, seq, d_model] -> ([batch, seq, d_model], scalar)."""
+        batch, seq, d_model = x.shape
+        tokens = batch * seq
+        capacity = max(
+            1, int(self.capacity_factor * tokens / self.num_experts))
+
+        # --- Router (always f32: tiny matmul, precision matters) ---
+        router_kernel = self.param(
+            "router", nn.initializers.lecun_normal(),
+            (d_model, self.num_experts), jnp.float32)
+        logits = jnp.asarray(x, jnp.float32).reshape(
+            tokens, d_model) @ router_kernel          # [T, E]
+        if self.router_noise and not deterministic:
+            rng = self.make_rng("router")
+            logits = logits + self.router_noise * jax.random.normal(
+                rng, logits.shape)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert_index = jnp.argmax(probs, axis=-1)     # [T]
+        expert_gate = jnp.max(probs, axis=-1)         # [T]
+
+        # --- Load-balancing aux loss (Switch eq. 4-6) ---
+        one_hot = jax.nn.one_hot(expert_index, self.num_experts,
+                                 dtype=jnp.float32)   # [T, E]
+        fraction_routed = one_hot.mean(axis=0)
+        fraction_prob = probs.mean(axis=0)
+        aux_loss = self.num_experts * jnp.sum(
+            fraction_routed * fraction_prob)
+
+        # --- Capacity assignment: position of each token within its
+        # expert's queue; tokens past capacity are dropped ---
+        position_in_expert = (jnp.cumsum(one_hot, axis=0) - 1.0) * one_hot
+        keep = (position_in_expert < capacity).astype(jnp.float32) * one_hot
+        position = jnp.sum(position_in_expert * keep,
+                           axis=-1).astype(jnp.int32)           # [T]
+        position_oh = jax.nn.one_hot(position, capacity,
+                                     dtype=jnp.float32)         # [T, C]
+
+        # dispatch[t, e, c] = 1 iff token t sits in slot c of expert e
+        dispatch = keep[:, :, None] * position_oh[:, None, :]   # [T,E,C]
+        combine = dispatch * expert_gate[:, None, None]
+
+        # --- Expert FFN: einsum over the (sharded) expert dim; XLA
+        # inserts the token all-to-all when "ep" shards E ---
+        xf = x.reshape(tokens, d_model).astype(self.compute_dtype)
+        expert_in = jnp.einsum("tec,td->ecd",
+                               dispatch.astype(self.compute_dtype), xf)
+        w_in = self.param(
+            "expert_in",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (self.num_experts, d_model, self.d_ff), jnp.float32)
+        w_out = self.param(
+            "expert_out",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (self.num_experts, self.d_ff, d_model), jnp.float32)
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       w_in.astype(self.compute_dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ecf,efd->ecd", h,
+                                w_out.astype(self.compute_dtype))
+
+        out = jnp.einsum("tec,ecd->td",
+                         combine.astype(self.compute_dtype), expert_out)
+        return (out.reshape(batch, seq, d_model).astype(x.dtype),
+                aux_loss)
+
+
+def expert_parallel_rules(ep_axis: str = "ep"):
+    """Sharding rules putting the expert dim on the "ep" mesh axis —
+    compose with `tensor_parallel_rules` in
+    `Trainer(param_sharding_rules=...)`."""
+    return [
+        (r"expert_in$", P(ep_axis, None, None)),
+        (r"expert_out$", P(ep_axis, None, None)),
+        # Router stays replicated: every token scores every expert.
+    ]

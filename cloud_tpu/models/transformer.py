@@ -66,6 +66,7 @@ class TransformerBlock(nn.Module):
     dropout_rate: float = 0.0
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
+    moe_experts: int = 0  # > 0 swaps the dense MLP for a Switch MoE
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
@@ -78,10 +79,22 @@ class TransformerBlock(nn.Module):
         x = x + y
 
         y = nn.LayerNorm(dtype=self.compute_dtype, name="ln_mlp")(x)
-        y = nn.Dense(self.d_ff, dtype=self.compute_dtype, name="mlp_in")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1], dtype=self.compute_dtype,
-                     name="mlp_out")(y)
+        if self.moe_experts:
+            from cloud_tpu.models.moe import MoEMLP
+            y, aux_loss = MoEMLP(num_experts=self.moe_experts,
+                                 d_ff=self.d_ff,
+                                 compute_dtype=self.compute_dtype,
+                                 name="moe")(y, deterministic)
+            # Surfaced via mutable=["losses"]; summed into the training
+            # loss by Trainer when present.
+            self.sow("losses", "moe_aux_loss", aux_loss,
+                     reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0)
+        else:
+            y = nn.Dense(self.d_ff, dtype=self.compute_dtype,
+                         name="mlp_in")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(x.shape[-1], dtype=self.compute_dtype,
+                         name="mlp_out")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         return x + y
@@ -99,6 +112,7 @@ class TransformerLM(nn.Module):
     dropout_rate: float = 0.0
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -116,7 +130,7 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = TransformerBlock(self.num_heads, self.d_ff,
                                  self.dropout_rate, self.compute_dtype,
-                                 self.attention_impl,
+                                 self.attention_impl, self.moe_experts,
                                  name="block_%d" % i)(
                                      x, mask, deterministic)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_final")(x)

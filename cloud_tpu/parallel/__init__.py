@@ -9,8 +9,12 @@ ICI/DCN, plus ring attention for long-context sequence parallelism
 
 from cloud_tpu.parallel import runtime
 from cloud_tpu.parallel import sharding
+# NOTE: the schedule-level `pipeline` function stays in its submodule
+# (`parallel.pipeline.pipeline`) — importing it here would shadow the
+# submodule attribute. The global-array entry point is exported.
+from cloud_tpu.parallel.pipeline import pipeline_apply
 from cloud_tpu.parallel.ring_attention import ring_attention
 from cloud_tpu.parallel.ring_attention import sequence_parallel_attention
 
-__all__ = ["runtime", "sharding", "ring_attention",
-           "sequence_parallel_attention"]
+__all__ = ["runtime", "sharding", "pipeline_apply",
+           "ring_attention", "sequence_parallel_attention"]

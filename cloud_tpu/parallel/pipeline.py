@@ -1,0 +1,163 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+Another capability absent from the reference (SURVEY §2.3: "tensor
+parallelism, pipeline parallelism ... Nothing in the tree implements or
+references them") built here as a first-class mesh axis. The design is
+the shard_map pipelining pattern from the public scaling playbook: each
+device along the "pp" axis holds ONE stage's parameters, activations hop
+stage-to-stage with `jax.lax.ppermute` (one neighbor transfer per tick,
+riding ICI), and a `lax.scan` over ticks runs the M-microbatch / n-stage
+schedule in M + n - 1 ticks — device utilization M / (M + n - 1), the
+standard GPipe bubble.
+
+Everything is lax-traceable, so `jax.grad` differentiates through the
+whole schedule (ppermute transposes to the reverse hop; the scan body is
+`jax.checkpoint`ed so backward recomputes a tick instead of storing
+every intermediate).
+
+Usage inside shard_map (see `pipeline_apply` for the global-array entry
+point):
+
+    def stage_fn(stage_params, x):          # one pipeline stage
+        ...
+    y = pipeline(stage_fn, stage_params, x_microbatches, axis_name="pp")
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline(stage_fn, stage_params, microbatches, axis_name):
+    """Runs the GPipe schedule inside `shard_map`.
+
+    Args:
+        stage_fn: `(stage_params, x) -> y` applying one stage; input and
+            output must have the same shape/dtype (the classic pipeline
+            contract — embed/head belong to stages themselves).
+        stage_params: This device's stage parameters (pytree; under
+            shard_map, shard the stacked [n_stages, ...] params on
+            `axis_name` so each device sees its own stage's slice with
+            the leading stage axis collapsed... see `pipeline_apply`).
+        microbatches: [M, mb, ...] microbatched input, resident on every
+            device (replicated over `axis_name`).
+        axis_name: The pipeline mesh axis.
+
+    Returns:
+        [M, mb, ...] outputs of the final stage, replicated over
+        `axis_name`.
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage_index = jax.lax.axis_index(axis_name)
+    num_micro = microbatches.shape[0]
+    total_ticks = num_micro + n_stages - 1
+
+    # i -> i+1 activation hop; the wrap-around edge (last -> 0) carries
+    # garbage that stage 0 always overwrites with a fresh microbatch.
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    # The scan carry must be typed device-varying over the pp axis from
+    # tick 0 (stage outputs are varying), hence the pvary casts.
+    def _pvary(v):
+        try:
+            return jax.lax.pcast(v, (axis_name,), to="varying")
+        except AttributeError:
+            try:  # jax < 0.8
+                return jax.lax.pvary(v, (axis_name,))
+            except AttributeError:  # older jax: vma typing absent anyway
+                return v
+
+    carry0 = _pvary(jnp.zeros_like(microbatches[0]))
+    outputs0 = _pvary(jnp.zeros_like(microbatches))
+
+    @jax.checkpoint
+    def tick(state, t):
+        carry, outputs = state
+        # Stage 0 ingests microbatch t (clamped; ticks >= M feed dummy
+        # work that never reaches the output buffer).
+        feed = microbatches[jnp.minimum(t, num_micro - 1)]
+        x = jnp.where(stage_index == 0, feed, carry)
+        y = stage_fn(stage_params, x)
+        # The last stage finished microbatch t - (n-1) at tick t.
+        mb_done = t - (n_stages - 1)
+        is_last = stage_index == n_stages - 1
+        outputs = jax.lax.cond(
+            jnp.logical_and(is_last, mb_done >= 0),
+            lambda o: o.at[jnp.maximum(mb_done, 0)].set(y),
+            lambda o: o,
+            outputs)
+        carry = jax.lax.ppermute(y, axis_name, perm)
+        return (carry, outputs), None
+
+    (carry, outputs), _ = jax.lax.scan(
+        tick, (carry0, outputs0), jnp.arange(total_ticks))
+    # Only the last stage holds real outputs; broadcast them to every
+    # stage so the result is replicated over the pp axis (psum of
+    # one-hot contributions — a single all-reduce at the end).
+    is_last = (stage_index == n_stages - 1).astype(outputs.dtype)
+    return jax.lax.psum(outputs * is_last, axis_name)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, num_microbatches,
+                   mesh=None, axis="pp"):
+    """Pipeline-parallel apply over global arrays.
+
+    Args:
+        stage_fn: `(stage_params, x) -> y`, one stage (same-shape in/out).
+        stacked_params: Pytree whose leaves are stacked along a leading
+            [n_stages] axis — stage i's params at index i. Sharded over
+            `axis` so each device keeps only its stage.
+        x: [B, ...] global input batch.
+        num_microbatches: M; B must divide by it.
+        mesh: Mesh override; default ambient.
+        axis: Pipeline mesh axis name.
+
+    Returns:
+        [B, ...] output of the last stage.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from cloud_tpu.parallel import runtime
+
+    mesh = mesh if mesh is not None else runtime.global_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "No mesh: pass `mesh=` or initialize the ambient runtime.")
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            "Mesh axes {} have no {!r} axis for pipeline parallelism."
+            .format(tuple(mesh.axis_names), axis))
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if num_microbatches < 1 or batch % num_microbatches:
+        raise ValueError(
+            "Batch size {} is not divisible by num_microbatches {}."
+            .format(batch, num_microbatches))
+
+    def check_leading(leaf):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                "stacked_params leaves must have leading dim n_stages={}"
+                "; got shape {}.".format(n_stages, leaf.shape))
+        return leaf
+
+    jax.tree_util.tree_map(check_leading, stacked_params)
+
+    micro = x.reshape((num_microbatches, batch // num_microbatches)
+                      + x.shape[1:])
+
+    def local_fn(stage_params, microbatches):
+        # shard_map keeps the sharded leading stage axis as size 1;
+        # collapse it so stage_fn sees this stage's params directly.
+        own = jax.tree_util.tree_map(lambda l: l[0], stage_params)
+        return pipeline(stage_fn, own, microbatches, axis_name=axis)
+
+    params_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    out = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(params_spec, P()),
+        out_specs=P())(stacked_params, micro)
+    return out.reshape((batch,) + out.shape[2:])

@@ -136,7 +136,8 @@ class Trainer:
                  train_kwargs=None,
                  eval_kwargs=None,
                  rng_keys=(),
-                 seed=0):
+                 seed=0,
+                 aux_loss_weight=0.01):
         """Constructor.
 
         Args:
@@ -159,6 +160,9 @@ class Trainer:
             rng_keys: names of per-step rngs to pass to flax apply (e.g.
                 ("dropout",)).
             seed: PRNG seed.
+            aux_loss_weight: Weight on auxiliary losses the model sows
+                into the "losses" collection (e.g. MoE load-balancing
+                loss; Switch-Transformer default 0.01).
         """
         if hasattr(model, "init") and hasattr(model, "apply"):
             self._init_fn = model.init
@@ -193,6 +197,8 @@ class Trainer:
         self.eval_kwargs = dict(eval_kwargs or {})
         self.rng_keys = tuple(rng_keys)
         self.seed = seed
+        self.aux_loss_weight = aux_loss_weight
+        self._sows_losses = False  # set by build() when the model sows
 
         self.state = None
         self._jit_train_step = None
@@ -226,6 +232,9 @@ class Trainer:
         if self._is_flax and "params" in variables:
             variables = dict(variables)
             params = variables.pop("params")
+            # "losses" is a transient per-step collection (sown aux
+            # losses, e.g. MoE load balancing), not persistent state.
+            self._sows_losses = variables.pop("losses", None) is not None
             extra_vars = variables  # e.g. {"batch_stats": ...}
         else:
             params, extra_vars = variables, {}
@@ -294,12 +303,17 @@ class Trainer:
         train_kwargs = self.train_kwargs
         rng_keys = self.rng_keys
 
+        aux_loss_weight = self.aux_loss_weight
+        sows_losses = self._sows_losses
+
         def train_step(state, batch):
             x, y = batch
             step_rng = jax.random.fold_in(state.rng, state.step)
             rngs = ({k: jax.random.fold_in(step_rng, i)
                      for i, k in enumerate(rng_keys)} or None)
             mutable = list(state.extra_vars.keys())
+            if sows_losses:
+                mutable = mutable + ["losses"]
 
             def compute_loss(params):
                 if mutable:
@@ -311,6 +325,12 @@ class Trainer:
                                           **train_kwargs)
                     new_vars = state.extra_vars
                 loss = jnp.mean(loss_fn(outputs, y))
+                new_vars = dict(new_vars)
+                sown = new_vars.pop("losses", None)
+                if sown is not None:
+                    aux = sum(jnp.sum(jnp.asarray(l).astype(loss.dtype))
+                              for l in jax.tree_util.tree_leaves(sown))
+                    loss = loss + aux_loss_weight * aux
                 return loss, (outputs, new_vars)
 
             (loss, (outputs, new_vars)), grads = jax.value_and_grad(

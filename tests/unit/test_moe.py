@@ -1,0 +1,168 @@
+"""MoE layer: routing correctness and expert-parallel execution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cloud_tpu.models.moe import MoEMLP, expert_parallel_rules
+from cloud_tpu.parallel import sharding as sharding_lib
+
+B, S, D = 2, 16, 8
+
+
+def _make(num_experts=4, capacity_factor=2.0, **kwargs):
+    model = MoEMLP(num_experts=num_experts, d_ff=16,
+                   capacity_factor=capacity_factor,
+                   compute_dtype=jnp.float32, **kwargs)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, S, D)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
+    return model, params, x
+
+
+class TestMoE:
+    def test_output_shape_and_finite(self):
+        model, params, x = _make()
+        out, aux = model.apply(params, x)
+        assert out.shape == (B, S, D)
+        assert np.isfinite(np.asarray(out)).all()
+        assert np.isfinite(float(aux))
+
+    def test_aux_loss_near_one_for_uniform_router(self):
+        """With an all-zero router kernel the gate is uniform; the
+        Switch aux loss is then exactly 1 regardless of routing."""
+        model, params, x = _make()
+        params = jax.tree_util.tree_map(jnp.zeros_like, params)
+        _, aux = model.apply(params, x)
+        assert abs(float(aux) - 1.0) < 1e-5
+
+    def test_all_tokens_kept_with_ample_capacity(self):
+        """capacity_factor covering worst-case skew: every token lands
+        in exactly one expert slot (dispatch sums to 1 per token)."""
+        model, params, x = _make(num_experts=2, capacity_factor=2.0)
+
+        # Reconstruct dispatch by comparing against a capacity-starved
+        # run: outputs differ only if tokens were dropped.
+        out_full, _ = model.apply(params, x)
+        starved = MoEMLP(num_experts=2, d_ff=16, capacity_factor=0.01,
+                         compute_dtype=jnp.float32)
+        out_starved, _ = starved.apply(params, x)
+        # Starved run drops most tokens (zero rows); full run should
+        # have strictly more nonzero outputs.
+        full_nonzero = int(np.sum(np.any(np.asarray(out_full) != 0,
+                                         axis=-1)))
+        starved_nonzero = int(np.sum(np.any(np.asarray(out_starved) != 0,
+                                            axis=-1)))
+        assert full_nonzero > starved_nonzero
+
+    def test_gradients_flow_to_router_and_experts(self):
+        model, params, x = _make()
+
+        def loss(p):
+            out, aux = model.apply(p, x)
+            return jnp.mean(out ** 2) + 0.01 * aux
+
+        grads = jax.grad(loss)(params)
+        flat = jax.tree_util.tree_flatten_with_path(grads)[0]
+        for path, g in flat:
+            name = sharding_lib.path_string(path)
+            assert np.isfinite(np.asarray(g)).all(), name
+            assert float(jnp.sum(jnp.abs(g))) > 0.0, name
+
+    def test_expert_parallel_matches_single_device(self):
+        """Sharding experts over an "ep" mesh axis is numerically
+        transparent: XLA inserts the collectives."""
+        model, params, x = _make(num_experts=4)
+        expected, aux_expected = model.apply(params, x)
+
+        devices = np.array(jax.devices()[:4])
+        with Mesh(devices, ("ep",)) as mesh:
+            rules = expert_parallel_rules("ep")
+            shardings = sharding_lib.param_sharding(params, rules,
+                                                    mesh=mesh)
+            sharded_params = jax.device_put(params, shardings)
+            out, aux = jax.jit(model.apply)(sharded_params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(float(aux), float(aux_expected),
+                                   rtol=1e-6)
+
+    def test_rules_target_expert_weights_only(self):
+        model, params, x = _make()
+        devices = np.array(jax.devices()[:4])
+        with Mesh(devices, ("ep",)) as mesh:
+            shardings = sharding_lib.param_sharding(
+                params, expert_parallel_rules("ep"), mesh=mesh)
+        flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+        for path, s in flat:
+            name = sharding_lib.path_string(path)
+            if "expert_" in name:
+                assert s.spec == P("ep", None, None), name
+            else:
+                assert s.spec == P(), name
+
+
+class TestMoETransformer:
+    def test_moe_transformer_trains_with_aux_loss(self):
+        """TransformerLM(moe_experts=4) trains through Trainer; the sown
+        load-balancing loss reaches the objective (train loss above the
+        task-only loss of an identically-seeded run with weight 0)."""
+        import optax
+        from cloud_tpu.models import TransformerLM
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.training import Trainer
+
+        runtime.reset()
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+        targets = np.roll(tokens, -1, axis=1)
+
+        def lm_loss(logits, labels):
+            import optax as _optax
+            return _optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean(axis=-1)
+
+        def make(weight):
+            model = TransformerLM(vocab_size=64, num_layers=2,
+                                  num_heads=2, d_model=32, d_ff=64,
+                                  max_seq_len=16, moe_experts=4,
+                                  compute_dtype=jnp.float32)
+            return Trainer(model, optimizer=optax.sgd(0.0),
+                           loss=lm_loss, metrics=(),
+                           aux_loss_weight=weight, seed=0)
+
+        h_with = make(1.0).fit(tokens, targets, epochs=1, batch_size=8,
+                               shuffle=False, verbose=False)
+        h_without = make(0.0).fit(tokens, targets, epochs=1,
+                                  batch_size=8, shuffle=False,
+                                  verbose=False)
+        # lr=0 so the single-step losses are directly comparable; the
+        # aux term is strictly positive, so weighted > unweighted.
+        assert h_with["loss"][0] > h_without["loss"][0]
+
+    def test_moe_transformer_loss_decreases(self):
+        import optax
+        from cloud_tpu.models import TransformerLM
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.training import Trainer
+
+        runtime.reset()
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 64, size=(16, 16)).astype(np.int32)
+        targets = np.roll(tokens, -1, axis=1)
+
+        def lm_loss(logits, labels):
+            import optax as _optax
+            return _optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean(axis=-1)
+
+        model = TransformerLM(vocab_size=64, num_layers=2, num_heads=2,
+                              d_model=32, d_ff=64, max_seq_len=16,
+                              moe_experts=4, compute_dtype=jnp.float32)
+        trainer = Trainer(model, optimizer=optax.adam(1e-2),
+                          loss=lm_loss, metrics=())
+        history = trainer.fit(tokens, targets, epochs=3, batch_size=8,
+                              verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
