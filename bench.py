@@ -24,6 +24,7 @@ BATCH = int(os.environ.get("BENCH_BATCH", 256))
 IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", 3))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", 20))
+CHUNK = int(os.environ.get("BENCH_CHUNK", 5))
 BASELINE_IMAGES_PER_SEC = 350.0  # one V100, fp16 ResNet50 (8xV100 / 8)
 
 
@@ -53,13 +54,20 @@ def main():
         state, logs = step_fn(state, batch)
     jax.block_until_ready(logs["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(TIMED_STEPS):
-        state, logs = step_fn(state, batch)
-    jax.block_until_ready(logs["loss"])
-    elapsed = time.perf_counter() - t0
+    # Median contiguous chunk: robust to one-off stalls of the shared
+    # chip tunnel (which measure the tunnel, not the step) while still
+    # reporting sustained — not peak — throughput, comparable with the
+    # sustained-average baseline.
+    chunk_times = []
+    for _ in range(max(TIMED_STEPS // CHUNK, 1)):
+        t0 = time.perf_counter()
+        for _ in range(CHUNK):
+            state, logs = step_fn(state, batch)
+        jax.block_until_ready(logs["loss"])
+        chunk_times.append(time.perf_counter() - t0)
+    median_elapsed = sorted(chunk_times)[len(chunk_times) // 2]
 
-    images_per_sec = BATCH * TIMED_STEPS / elapsed
+    images_per_sec = BATCH * CHUNK / median_elapsed
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),

@@ -98,6 +98,7 @@ def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
         "eval_kwargs": trainer.eval_kwargs,
         "rng_keys": trainer.rng_keys,
         "seed": trainer.seed,
+        "aux_loss_weight": trainer.aux_loss_weight,
     }
     storage.write_bytes(storage.join(remote_dir, SPEC_FILE),
                         pickle.dumps(spec))

@@ -47,6 +47,7 @@ def build_trainer(spec, mesh=None):
         eval_kwargs=spec.get("eval_kwargs"),
         rng_keys=spec.get("rng_keys", ()),
         seed=spec.get("seed", 0),
+        aux_loss_weight=spec.get("aux_loss_weight", 0.01),
     )
 
 

@@ -120,12 +120,9 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches,
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
-    from cloud_tpu.parallel import runtime
+    from cloud_tpu.parallel import sharding as sharding_lib
 
-    mesh = mesh if mesh is not None else runtime.global_mesh()
-    if mesh is None:
-        raise RuntimeError(
-            "No mesh: pass `mesh=` or initialize the ambient runtime.")
+    mesh = sharding_lib._resolve_mesh(mesh)
     if axis not in mesh.axis_names:
         raise ValueError(
             "Mesh axes {} have no {!r} axis for pipeline parallelism."

@@ -182,12 +182,9 @@ def sequence_parallel_attention(q, k, v, mesh=None, axis="sp", causal=True,
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
-    from cloud_tpu.parallel import runtime
+    from cloud_tpu.parallel import sharding as _sharding_resolve
 
-    mesh = mesh if mesh is not None else runtime.global_mesh()
-    if mesh is None:
-        raise RuntimeError(
-            "No mesh: pass `mesh=` or initialize the ambient runtime.")
+    mesh = _sharding_resolve._resolve_mesh(mesh)
     if axis not in mesh.axis_names:
         raise ValueError(
             "Mesh axes {} have no {!r} axis for sequence parallelism; "

@@ -411,8 +411,19 @@ class Trainer:
             validation_data=None,
             callbacks=(),
             steps_per_epoch=None,
-            verbose=True):
-        """Trains the model; returns a history dict of per-epoch logs."""
+            verbose=True,
+            resume_from=None):
+        """Trains the model; returns a history dict of per-epoch logs.
+
+        resume_from: Optional checkpoint directory (a ModelCheckpoint
+        filepath from an earlier run). When it holds a checkpoint, the
+        full train state (params, optimizer state, step, rng) is
+        restored before training — the failure-recovery path the
+        reference leaves to manual SavedModel reloads (and explicitly
+        does not support for remote tuner trials, reference
+        tuner/tuner.py:562-567). Missing/empty directories are ignored,
+        so a preemption-restart loop can always pass it.
+        """
         dataset = data_lib.as_dataset(x, y, batch_size=batch_size,
                                       shuffle=shuffle, seed=self.seed)
         # Safe to peek: as_dataset returns re-iterables only (one-shot
@@ -420,6 +431,13 @@ class Trainer:
         sample = next(iter(dataset))
         sample_x = sample[0] if isinstance(sample, tuple) else sample
         self.build(sample_x)
+        if resume_from is not None:
+            from cloud_tpu.training import checkpoint as checkpoint_lib
+            if checkpoint_lib.latest_step(resume_from) is not None:
+                self.state = checkpoint_lib.restore(resume_from,
+                                                    self.state)
+                logger.info("Resumed training from %s at step %d.",
+                            resume_from, int(self.state.step))
         if self._jit_train_step is None:
             self._jit_train_step = self._make_train_step()
 
