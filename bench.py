@@ -24,7 +24,7 @@ BATCH = int(os.environ.get("BENCH_BATCH", 256))
 IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", 3))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", 20))
-CHUNK = int(os.environ.get("BENCH_CHUNK", 5))
+CHUNK = min(int(os.environ.get("BENCH_CHUNK", 5)), TIMED_STEPS)
 BASELINE_IMAGES_PER_SEC = 350.0  # one V100, fp16 ResNet50 (8xV100 / 8)
 
 

@@ -1,0 +1,195 @@
+"""All five BASELINE.md benchmark configs, one JSON line each.
+
+The driver-facing single-metric harness stays at the repo root
+(`bench.py`, config 2 — the flagship). This suite covers the full
+BASELINE.md table for local measurement:
+
+1. MNIST Sequential-equivalent (models.MLP) via Trainer.fit
+2. ResNet50 single-chip train step (same as bench.py)
+3. Multi-device data-parallel LM step (pod-shape simulated on the
+   available devices; real pods use the same code over jax.distributed)
+4. Tuner trial loop (CloudTuner against an in-process oracle fake)
+5. Custom-training-loop (user-managed jit step, the CTL escape hatch)
+
+Usage: python benchmarks/run_all.py [config_numbers...]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench_loop(step, state, batch, steps=20, warmup=3):
+    import jax
+    for _ in range(warmup):
+        state, out = step(state, batch)
+    jax.block_until_ready(out)
+    chunks = []
+    for _ in range(max(steps // 5, 1)):
+        t0 = time.perf_counter()
+        for _ in range(5):
+            state, out = step(state, batch)
+        jax.block_until_ready(out)
+        chunks.append((time.perf_counter() - t0) / 5)
+    return sorted(chunks)[len(chunks) // 2]
+
+
+def config1_mnist():
+    import optax
+
+    from cloud_tpu.models import MLP
+    from cloud_tpu.training import Trainer
+
+    B = 512
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, size=B).astype(np.int32)
+    tr = Trainer(MLP(), optimizer=optax.adam(1e-3),
+                 loss="sparse_categorical_crossentropy", metrics=())
+    tr.build(x)
+    step = tr._make_train_step()
+    sec = _bench_loop(lambda s, b: step(s, b), tr.state,
+                      tr._feed((x, y)))
+    return {"metric": "mnist_mlp_steps_per_sec", "value": round(1 / sec, 2),
+            "unit": "steps/sec", "batch": B}
+
+
+def config2_resnet50():
+    import optax
+
+    from cloud_tpu.models import ResNet50
+    from cloud_tpu.training import Trainer
+
+    B = 256
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(B, 224, 224, 3)).astype(np.float32)
+    y = rng.integers(0, 1000, size=B).astype(np.int32)
+    tr = Trainer(ResNet50(num_classes=1000),
+                 optimizer=optax.sgd(0.1, momentum=0.9),
+                 train_kwargs={"train": True},
+                 eval_kwargs={"train": False}, metrics=())
+    tr.build(x)
+    step = tr._make_train_step()
+    sec = _bench_loop(lambda s, b: step(s, b), tr.state, tr._feed((x, y)))
+    return {"metric": "resnet50_train_images_per_sec", "value":
+            round(B / sec, 2), "unit": "images/sec", "batch": B}
+
+
+def config3_dp_pod_shape():
+    import jax
+    import optax
+
+    from cloud_tpu.models import TransformerLM
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.training import Trainer
+
+    runtime.reset()
+    runtime.initialize(strategy="tpu_slice", axis_names=("dp",))
+    n = len(jax.devices())
+    B = 8 * n
+    model = TransformerLM(vocab_size=8192, num_layers=4, num_heads=8,
+                          d_model=256, d_ff=1024, max_seq_len=256)
+    import optax as _o
+
+    def lm_loss(logits, labels):
+        return _o.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean(axis=-1)
+
+    tr = Trainer(model, optimizer=optax.adam(1e-3), loss=lm_loss,
+                 metrics=())
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 8192, size=(B, 256)).astype(np.int32)
+    tr.build(toks)
+    step = tr._make_train_step()
+    sec = _bench_loop(lambda s, b: step(s, b), tr.state,
+                      tr._feed((toks, np.roll(toks, -1, 1))))
+    runtime.reset()
+    return {"metric": "lm_dp%d_tokens_per_sec" % n,
+            "value": round(B * 256 / sec, 2), "unit": "tokens/sec",
+            "devices": n}
+
+
+def config4_tuner_loop():
+    import optax
+
+    from cloud_tpu.models import MLP
+    from cloud_tpu.training import Trainer
+    from cloud_tpu.tuner import CloudTuner, HyperParameters
+
+    sys.path.insert(0, "examples")
+    from tuner_search import FakeVizier
+
+    hps = HyperParameters()
+    hps.Float("learning_rate", 1e-4, 1e-2, sampling="log")
+
+    def build(hp):
+        return Trainer(MLP(hidden=128),
+                       optimizer=optax.adam(hp.get("learning_rate")),
+                       loss="sparse_categorical_crossentropy", metrics=())
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(512, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, size=512).astype(np.int32)
+    import tempfile
+    t0 = time.perf_counter()
+    tuner = CloudTuner(build, directory=tempfile.mkdtemp(),
+                       project_id="bench", region="us-central1",
+                       objective="accuracy", hyperparameters=hps,
+                       max_trials=3, study_id="bench",
+                       client=FakeVizier(hps))
+    tuner.search(x=x, y=y, epochs=1, batch_size=128, verbose=False)
+    elapsed = time.perf_counter() - t0
+    return {"metric": "tuner_trials_per_min",
+            "value": round(3 / (elapsed / 60), 2), "unit": "trials/min"}
+
+
+def config5_ctl():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from cloud_tpu.models import MLP
+
+    B = 512
+    model = MLP()
+    optimizer = optax.adam(1e-3)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, 28, 28)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=B), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x[:1])
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(carry, batch):
+        params, opt_state = carry
+        bx, by = batch
+
+        def loss_fn(p):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                model.apply(p, bx), by).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    sec = _bench_loop(step, (params, opt_state), (x, y))
+    return {"metric": "ctl_mnist_steps_per_sec",
+            "value": round(1 / sec, 2), "unit": "steps/sec", "batch": B}
+
+
+CONFIGS = {1: config1_mnist, 2: config2_resnet50, 3: config3_dp_pod_shape,
+           4: config4_tuner_loop, 5: config5_ctl}
+
+
+def main(argv):
+    wanted = [int(a) for a in argv] or sorted(CONFIGS)
+    for i in wanted:
+        result = CONFIGS[i]()
+        result["config"] = i
+        print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -5,6 +5,7 @@ whitelisted, env-gated, 10s-periodic exporter of runtime metrics to
 Cloud Monitoring, rebuilt against this framework's own registry.
 """
 
+from cloud_tpu.monitoring import profiler
 from cloud_tpu.monitoring.native import (config_debug_string,
                                          counter_increment, export_count,
                                          flush, gauge_set,

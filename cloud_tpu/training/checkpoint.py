@@ -13,26 +13,33 @@ import os
 import jax
 import orbax.checkpoint as ocp
 
+from cloud_tpu.utils import storage
+
 
 def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _normalize(directory):
+    """Local paths become absolute (orbax requires it); gs:// URIs pass
+    through untouched — tensorstore reads/writes them directly."""
+    if storage.is_gcs_path(directory):
+        return str(directory).rstrip("/")
+    return os.path.abspath(directory)
+
+
 def save(directory, state, step=0, force=True):
     """Saves a pytree `state` under `<directory>/<step>`."""
-    directory = os.path.abspath(directory)
-    path = os.path.join(directory, str(step))
+    path = storage.join(_normalize(directory), str(step))
     with _checkpointer() as checkpointer:
         checkpointer.save(path, state, force=force)
     return path
 
 
 def latest_step(directory):
-    """Largest step number checkpointed under `directory`, or None."""
-    directory = os.path.abspath(directory)
-    if not os.path.isdir(directory):
-        return None
-    steps = [int(name) for name in os.listdir(directory)
+    """Largest step number checkpointed under `directory` (local or
+    gs://), or None."""
+    steps = [int(name) for name in storage.listdir(_normalize(directory))
              if name.isdigit()]
     return max(steps) if steps else None
 
@@ -41,18 +48,18 @@ def restore(directory, target, step=None):
     """Restores a pytree congruent with `target` from `<directory>/<step>`.
 
     Args:
-        directory: Checkpoint root.
+        directory: Checkpoint root (local or gs://).
         target: A pytree of arrays (or ShapeDtypeStructs) matching the
             saved structure; its shardings are respected on restore.
         step: Step to restore; default latest.
     """
-    directory = os.path.abspath(directory)
+    directory = _normalize(directory)
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(
                 "No checkpoints found under {}.".format(directory))
-    path = os.path.join(directory, str(step))
+    path = storage.join(directory, str(step))
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                       target)
     with _checkpointer() as checkpointer:

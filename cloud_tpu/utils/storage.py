@@ -60,6 +60,27 @@ def exists(path):
     return os.path.exists(path)
 
 
+def listdir(path):
+    """Immediate child names under a directory (local or gs:// prefix).
+
+    Missing directories list as empty (callers treat "nothing there yet"
+    uniformly — e.g. checkpoint discovery on first run).
+    """
+    if is_gcs_path(path):
+        bucket_name, prefix = _split_gcs(path)
+        prefix = prefix.rstrip("/") + "/"
+        names = set()
+        for blob in _client().bucket(bucket_name).list_blobs(
+                prefix=prefix):
+            rest = blob.name[len(prefix):]
+            if rest:
+                names.add(rest.split("/", 1)[0])
+        return sorted(names)
+    if not os.path.isdir(path):
+        return []
+    return sorted(os.listdir(path))
+
+
 def join(base, *parts):
     if is_gcs_path(base):
         return "/".join([str(base).rstrip("/")] +
