@@ -15,10 +15,14 @@ Usage: python benchmarks/run_all.py [config_numbers...]
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
 
 
 def _bench_loop(step, state, batch, steps=20, warmup=3):
@@ -118,7 +122,7 @@ def config4_tuner_loop():
     from cloud_tpu.training import Trainer
     from cloud_tpu.tuner import CloudTuner, HyperParameters
 
-    sys.path.insert(0, "examples")
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "examples"))
     from tuner_search import FakeVizier
 
     hps = HyperParameters()

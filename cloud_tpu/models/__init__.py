@@ -4,3 +4,4 @@ from cloud_tpu.models.resnet import (ResNet, ResNet18, ResNet50, ResNet101,
 from cloud_tpu.models.moe import MoEMLP, expert_parallel_rules
 from cloud_tpu.models.transformer import (TransformerLM,
                                           tensor_parallel_rules)
+from cloud_tpu.models.vit import ViT, ViT_B16, ViT_L16, ViT_S16

@@ -1,0 +1,138 @@
+"""Vision Transformer (ViT) family.
+
+A second image-model family beyond ResNet (reference users bring
+arbitrary Keras models to `run()`; ViT-B/16-style encoders are the
+modern default). TPU-first choices: patchify as a single strided conv
+(one big MXU matmul), bidirectional attention through the same
+`cloud_tpu.ops.attention` dispatcher the LM uses (Pallas flash kernel on
+TPU with causal=False), bfloat16 compute / float32 params, static
+shapes throughout.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class EncoderBlock(nn.Module):
+    """Pre-norm transformer encoder block (bidirectional attention)."""
+
+    num_heads: int
+    d_ff: int
+    dropout_rate: float = 0.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        from cloud_tpu import ops
+
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+
+        y = nn.LayerNorm(dtype=self.compute_dtype, name="ln_attn")(x)
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, dtype=self.compute_dtype, name=name)
+        q = dense((self.num_heads, head_dim), "query")(y)
+        k = dense((self.num_heads, head_dim), "key")(y)
+        v = dense((self.num_heads, head_dim), "value")(y)
+        y = ops.attention(q, k, v, causal=False,
+                          impl=self.attention_impl)
+        y = nn.DenseGeneral(d_model, axis=(-2, -1),
+                            dtype=self.compute_dtype, name="out")(
+                                y.astype(self.compute_dtype))
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        x = x + y
+
+        y = nn.LayerNorm(dtype=self.compute_dtype, name="ln_mlp")(x)
+        y = nn.Dense(self.d_ff, dtype=self.compute_dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d_model, dtype=self.compute_dtype, name="mlp_out")(y)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        return x + y
+
+
+class ViT(nn.Module):
+    """Vision Transformer classifier.
+
+    Input [B, H, W, C] images; H and W must divide by `patch_size`.
+    """
+
+    num_classes: int = 1000
+    patch_size: int = 16
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dropout_rate: float = 0.0
+    pool: str = "cls"  # "cls" token or "mean" pooling
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        batch, height, width, _ = x.shape
+        if height % self.patch_size or width % self.patch_size:
+            raise ValueError(
+                "Image size {}x{} must divide by patch_size {}.".format(
+                    height, width, self.patch_size))
+        deterministic = not train
+
+        # Patchify: one strided conv == per-patch linear projection.
+        x = nn.Conv(self.d_model,
+                    (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    dtype=self.compute_dtype, name="patch_embed")(
+                        x.astype(self.compute_dtype))
+        x = x.reshape(batch, -1, self.d_model)  # [B, N, D]
+
+        if self.pool == "cls":
+            cls = self.param("cls_token", nn.initializers.zeros,
+                             (1, 1, self.d_model), jnp.float32)
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (batch, 1, self.d_model)
+                                  ).astype(x.dtype), x], axis=1)
+
+        num_tokens = x.shape[1]
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, num_tokens, self.d_model), jnp.float32)
+        x = x + pos.astype(x.dtype)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+
+        for i in range(self.num_layers):
+            x = EncoderBlock(self.num_heads, self.d_ff,
+                             self.dropout_rate, self.compute_dtype,
+                             self.attention_impl,
+                             name="block_%d" % i)(x, deterministic)
+        x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_final")(x)
+
+        if self.pool == "cls":
+            x = x[:, 0]
+        elif self.pool == "mean":
+            x = jnp.mean(x, axis=1)
+        else:
+            raise ValueError("pool must be 'cls' or 'mean', got {!r}"
+                             .format(self.pool))
+        logits = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                          name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+def ViT_S16(**kwargs):
+    return ViT(num_layers=12, num_heads=6, d_model=384, d_ff=1536,
+               **kwargs)
+
+
+def ViT_B16(**kwargs):
+    return ViT(num_layers=12, num_heads=12, d_model=768, d_ff=3072,
+               **kwargs)
+
+
+def ViT_L16(**kwargs):
+    return ViT(num_layers=24, num_heads=16, d_model=1024, d_ff=4096,
+               **kwargs)

@@ -5,7 +5,6 @@ whitelisted, env-gated, 10s-periodic exporter of runtime metrics to
 Cloud Monitoring, rebuilt against this framework's own registry.
 """
 
-from cloud_tpu.monitoring import profiler
 from cloud_tpu.monitoring.native import (config_debug_string,
                                          counter_increment, export_count,
                                          flush, gauge_set,
@@ -21,3 +20,12 @@ TRAINING_EXAMPLES = "/cloud_tpu/training/examples"
 STEP_TIME_HISTOGRAM = "/cloud_tpu/training/step_time_usecs_histogram"
 
 STEP_TIME_BOUNDS = [1e3, 1e4, 1e5, 1e6, 1e7, 1e8]
+
+
+def __getattr__(name):
+    # Lazy: profiler pulls in jax + the training stack, which metric-only
+    # consumers of this package should not pay for.
+    if name == "profiler":
+        import importlib
+        return importlib.import_module("cloud_tpu.monitoring.profiler")
+    raise AttributeError(name)

@@ -19,6 +19,11 @@ Env contract (set by the deployer on every remote process):
     CLOUD_TPU_NUM_PROCESSES        total process count
     CLOUD_TPU_PROCESS_ID           this process's index
     CLOUD_TPU_RUNNING_REMOTELY     guard consumed by `run.remote()`
+    CLOUD_TPU_MESH                 optional mesh layout, e.g.
+                                   "dp:-1,tp:2" (-1 = infer from device
+                                   count); lets a launched job request
+                                   tensor/sequence/expert axes without
+                                   code changes
 """
 
 import logging
@@ -77,7 +82,7 @@ def _wait_for_devices(min_devices=1, retries=40, retry_interval_secs=10.0):
 
 
 def initialize(strategy="tpu_slice",
-               axis_names=("dp",),
+               axis_names=None,
                mesh_shape=None,
                coordinator_address=None,
                num_processes=None,
@@ -91,9 +96,10 @@ def initialize(strategy="tpu_slice",
         strategy: One of `STRATEGIES`. Multi-process strategies
             ("multi_worker", "tpu_pod") run `jax.distributed.initialize`
             first, using the env contract when args are not given.
-        axis_names: Mesh axis names. Default is a pure data-parallel 1D
-            mesh ("dp",); pass e.g. ("dp", "tp") with `mesh_shape` for
-            hybrid layouts.
+        axis_names: Mesh axis names. Default (None) is the CLOUD_TPU_MESH
+            env layout when set, else a pure data-parallel 1D mesh
+            ("dp",); pass e.g. ("dp", "tp") with `mesh_shape` for hybrid
+            layouts (explicit args always beat the env).
         mesh_shape: Optional tuple of ints matching `axis_names`. Default:
             all devices on the first axis.
         coordinator_address / num_processes / process_id: Multi-process
@@ -112,6 +118,14 @@ def initialize(strategy="tpu_slice",
             "Unknown strategy {!r}. Expected one of {}.".format(
                 strategy, STRATEGIES))
 
+    # Launch-time mesh layout via env contract: only when the caller did
+    # not pass an explicit layout (generated runners pass neither).
+    env_mesh = os.environ.get("CLOUD_TPU_MESH")
+    if axis_names is None and mesh_shape is None and env_mesh:
+        axis_names, mesh_shape = _parse_mesh_env(env_mesh)
+    elif axis_names is None:
+        axis_names = ("dp",)
+
     if strategy in ("multi_worker", "tpu_pod"):
         _maybe_init_distributed(coordinator_address, num_processes,
                                 process_id)
@@ -127,6 +141,18 @@ def initialize(strategy="tpu_slice",
             devices = _wait_for_devices(1, retries, retry_interval_secs)
 
     device_array = np.asarray(devices)
+    if mesh_shape is not None and -1 in mesh_shape:
+        known = 1
+        for dim in mesh_shape:
+            if dim != -1:
+                known *= dim
+        if (known <= 0 or mesh_shape.count(-1) != 1
+                or device_array.size % known):
+            raise ValueError(
+                "Cannot infer mesh_shape {} for {} devices.".format(
+                    mesh_shape, device_array.size))
+        mesh_shape = tuple(device_array.size // known if d == -1 else d
+                           for d in mesh_shape)
     if mesh_shape is not None:
         if len(mesh_shape) != len(axis_names):
             raise ValueError(
@@ -164,6 +190,22 @@ def _maybe_init_distributed(coordinator_address, num_processes, process_id):
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id)
+
+
+def _parse_mesh_env(value):
+    """"dp:-1,tp:2" -> (("dp", "tp"), (-1, 2)). Shapeless entries
+    ("dp,tp:2") default to -1 (inferred)."""
+    names, shape = [], []
+    for part in value.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, dim = part.partition(":")
+        names.append(name.strip())
+        shape.append(int(dim) if dim else -1)
+    if not names:
+        raise ValueError("Empty CLOUD_TPU_MESH value: {!r}".format(value))
+    return tuple(names), tuple(shape)
 
 
 def _env_int(name):

@@ -447,6 +447,21 @@ class Trainer:
             cb.set_trainer(self)
             cb.on_train_begin()
 
+        try:
+            self._fit_epochs(dataset, epochs, steps_per_epoch,
+                             validation_data, batch_size, callbacks,
+                             history, verbose)
+        finally:
+            # Guaranteed even when a train step raises (OOM, interrupt):
+            # callbacks holding external resources (profiler traces,
+            # open files) rely on on_train_end for cleanup.
+            for cb in callbacks:
+                cb.on_train_end(history)
+        return history
+
+    def _fit_epochs(self, dataset, epochs, steps_per_epoch,
+                    validation_data, batch_size, callbacks, history,
+                    verbose):
         for epoch in range(epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
@@ -494,10 +509,6 @@ class Trainer:
                 cb.on_epoch_end(epoch, logs)
             if self.stop_training:
                 break
-
-        for cb in callbacks:
-            cb.on_train_end(history)
-        return history
 
     def evaluate(self, x, y=None, batch_size=32, verbose=True):
         """Returns mean loss/metrics over the dataset.

@@ -68,7 +68,8 @@ def listdir(path):
     """
     if is_gcs_path(path):
         bucket_name, prefix = _split_gcs(path)
-        prefix = prefix.rstrip("/") + "/"
+        prefix = prefix.rstrip("/")
+        prefix = prefix + "/" if prefix else ""  # "" = bucket root
         names = set()
         for blob in _client().bucket(bucket_name).list_blobs(
                 prefix=prefix):

@@ -79,3 +79,63 @@ class TestMeshIsUsable:
             return jnp.sum(v)
 
         np.testing.assert_allclose(total(sharded), x.sum())
+
+
+class TestMeshEnvContract:
+    def test_cloud_tpu_mesh_env_layout(self, monkeypatch):
+        import jax
+
+        from cloud_tpu.parallel import runtime
+
+        runtime.reset()
+        monkeypatch.setenv("CLOUD_TPU_MESH", "dp:-1,tp:2")
+        try:
+            ctx = runtime.initialize(strategy="tpu_slice",
+                                     devices=jax.devices()[:8])
+            assert dict(ctx.mesh.shape) == {"dp": 4, "tp": 2}
+        finally:
+            runtime.reset()
+
+    def test_shapeless_entries_inferred(self, monkeypatch):
+        import jax
+
+        from cloud_tpu.parallel import runtime
+
+        runtime.reset()
+        monkeypatch.setenv("CLOUD_TPU_MESH", "dp,sp:4")
+        try:
+            ctx = runtime.initialize(strategy="tpu_slice",
+                                     devices=jax.devices()[:8])
+            assert dict(ctx.mesh.shape) == {"dp": 2, "sp": 4}
+        finally:
+            runtime.reset()
+
+    def test_explicit_args_beat_env(self, monkeypatch):
+        import jax
+
+        from cloud_tpu.parallel import runtime
+
+        runtime.reset()
+        monkeypatch.setenv("CLOUD_TPU_MESH", "dp:-1,tp:2")
+        try:
+            ctx = runtime.initialize(strategy="tpu_slice",
+                                     axis_names=("dp", "sp"),
+                                     mesh_shape=(2, 2),
+                                     devices=jax.devices()[:4])
+            assert dict(ctx.mesh.shape) == {"dp": 2, "sp": 2}
+        finally:
+            runtime.reset()
+
+    def test_bad_inference_raises(self, monkeypatch):
+        import jax
+
+        from cloud_tpu.parallel import runtime
+
+        runtime.reset()
+        monkeypatch.setenv("CLOUD_TPU_MESH", "dp:-1,tp:3")
+        try:
+            with pytest.raises(ValueError, match="infer"):
+                runtime.initialize(strategy="tpu_slice",
+                                   devices=jax.devices()[:8])
+        finally:
+            runtime.reset()

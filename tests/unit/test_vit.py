@@ -1,0 +1,73 @@
+"""ViT family: shapes, pooling modes, training, flash-path parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloud_tpu.models.vit import ViT, ViT_S16
+
+
+def _images(batch=2, size=32):
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.normal(size=(batch, size, size, 3)),
+                       jnp.float32)
+
+
+def _tiny(**kwargs):
+    base = dict(num_classes=10, patch_size=8, num_layers=2, num_heads=2,
+                d_model=32, d_ff=64, compute_dtype=jnp.float32)
+    base.update(kwargs)
+    return ViT(**base)
+
+
+class TestViT:
+    @pytest.mark.parametrize("pool", ["cls", "mean"])
+    def test_forward_shape(self, pool):
+        model = _tiny(pool=pool)
+        x = _images()
+        params = model.init(jax.random.PRNGKey(0), x)
+        logits = model.apply(params, x)
+        assert logits.shape == (2, 10)
+        assert logits.dtype == jnp.float32
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_rejects_indivisible_image(self):
+        model = _tiny()
+        x = _images(size=30)
+        with pytest.raises(ValueError, match="divide"):
+            model.init(jax.random.PRNGKey(0), x)
+
+    def test_flash_and_reference_impls_agree(self):
+        x = _images()
+        ref_model = _tiny(attention_impl="reference")
+        flash_model = _tiny(attention_impl="flash")
+        params = ref_model.init(jax.random.PRNGKey(0), x)
+        ref = ref_model.apply(params, x)
+        flash = flash_model.apply(params, x)
+        np.testing.assert_allclose(np.asarray(flash), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_trains_with_trainer(self):
+        import optax
+
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.training import Trainer
+
+        runtime.reset()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 10, size=16).astype(np.int32)
+        trainer = Trainer(_tiny(), optimizer=optax.adam(1e-3),
+                          loss="sparse_categorical_crossentropy",
+                          metrics=(), train_kwargs={"train": True},
+                          eval_kwargs={"train": False})
+        history = trainer.fit(x, y, epochs=3, batch_size=8,
+                              verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
+
+    def test_preset_builders(self):
+        model = ViT_S16(num_classes=10, patch_size=8)
+        x = _images()
+        params = model.init(jax.random.PRNGKey(0), x)
+        assert model.apply(params, x).shape == (2, 10)
