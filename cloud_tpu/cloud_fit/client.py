@@ -99,6 +99,8 @@ def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
         "rng_keys": trainer.rng_keys,
         "seed": trainer.seed,
         "aux_loss_weight": trainer.aux_loss_weight,
+        "gradient_accumulation_steps": trainer.gradient_accumulation_steps,
+        "remat": trainer.remat,
     }
     storage.write_bytes(storage.join(remote_dir, SPEC_FILE),
                         pickle.dumps(spec))

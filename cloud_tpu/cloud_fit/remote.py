@@ -48,6 +48,9 @@ def build_trainer(spec, mesh=None):
         rng_keys=spec.get("rng_keys", ()),
         seed=spec.get("seed", 0),
         aux_loss_weight=spec.get("aux_loss_weight", 0.01),
+        gradient_accumulation_steps=spec.get(
+            "gradient_accumulation_steps", 1),
+        remat=spec.get("remat", False),
     )
 
 

@@ -137,7 +137,9 @@ class Trainer:
                  eval_kwargs=None,
                  rng_keys=(),
                  seed=0,
-                 aux_loss_weight=0.01):
+                 aux_loss_weight=0.01,
+                 gradient_accumulation_steps=1,
+                 remat=False):
         """Constructor.
 
         Args:
@@ -163,6 +165,14 @@ class Trainer:
             aux_loss_weight: Weight on auxiliary losses the model sows
                 into the "losses" collection (e.g. MoE load-balancing
                 loss; Switch-Transformer default 0.01).
+            gradient_accumulation_steps: Accumulate gradients over N
+                steps before applying the update (`optax.MultiSteps`) —
+                N small device batches emulate one N-x-larger global
+                batch when HBM cannot hold it.
+            remat: Rematerialize the forward pass in backward
+                (`jax.checkpoint`): trades recompute FLOPs for
+                activation memory — the standard lever for long
+                sequences / deep models on HBM-bound chips.
         """
         if hasattr(model, "init") and hasattr(model, "apply"):
             self._init_fn = model.init
@@ -181,7 +191,12 @@ class Trainer:
 
         if isinstance(optimizer, str):
             optimizer = OPTIMIZERS[optimizer]()
+        self.gradient_accumulation_steps = int(gradient_accumulation_steps)
+        if self.gradient_accumulation_steps > 1:
+            optimizer = optax.MultiSteps(
+                optimizer, every_k_schedule=self.gradient_accumulation_steps)
         self.optimizer = optimizer
+        self.remat = bool(remat)
 
         self.loss_fn = LOSSES[loss] if isinstance(loss, str) else loss
         self.metric_fns = {}
@@ -333,8 +348,14 @@ class Trainer:
                     loss = loss + aux_loss_weight * aux
                 return loss, (outputs, new_vars)
 
+            if self.remat:
+                # Recompute the forward in backward instead of keeping
+                # activations: HBM for FLOPs.
+                compute = jax.checkpoint(compute_loss)
+            else:
+                compute = compute_loss
             (loss, (outputs, new_vars)), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(state.params)
+                compute, has_aux=True)(state.params)
             updates, new_opt_state = optimizer.update(
                 grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)

@@ -340,3 +340,73 @@ class TestResume:
         trainer.fit(x, y, epochs=1, batch_size=32, verbose=False,
                     resume_from=str(tmp_path / "missing"))
         assert int(trainer.state.step) == 1
+
+
+class TestAccumulationAndRemat:
+    def _data(self):
+        import jax  # noqa: F401 (used by tests below)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8, 8)).astype(np.float32)
+        y = rng.integers(0, 10, size=64).astype(np.int32)
+        return x, y
+
+    def test_gradient_accumulation_matches_large_batch(self):
+        """SGD with N accumulation steps over batch B == one step over
+        batch N*B (identical data, mean losses)."""
+        import jax.numpy as jnp
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.training import Trainer
+
+        runtime.reset()
+        x, y = self._data()
+
+        def make(accum):
+            return Trainer(MLP(hidden=16, compute_dtype=jnp.float32),
+                           optimizer=optax.sgd(0.1),
+                           loss="sparse_categorical_crossentropy",
+                           metrics=(), seed=0,
+                           gradient_accumulation_steps=accum)
+
+        import jax
+
+        accum = make(2)
+        accum.fit(x, y, epochs=1, batch_size=32, shuffle=False,
+                  verbose=False)
+        big = make(1)
+        big.fit(x, y, epochs=1, batch_size=64, shuffle=False,
+                verbose=False)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5),
+            accum.state.params, big.state.params)
+
+    def test_remat_matches_plain(self):
+        import jax.numpy as jnp
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.training import Trainer
+
+        runtime.reset()
+        x, y = self._data()
+
+        def make(remat):
+            return Trainer(MLP(hidden=16, compute_dtype=jnp.float32),
+                           optimizer=optax.sgd(0.1),
+                           loss="sparse_categorical_crossentropy",
+                           metrics=(), seed=0, remat=remat)
+
+        import jax
+
+        a = make(True)
+        a.fit(x, y, epochs=1, batch_size=32, shuffle=False, verbose=False)
+        b = make(False)
+        b.fit(x, y, epochs=1, batch_size=32, shuffle=False, verbose=False)
+        jax.tree_util.tree_map(
+            lambda p, q: np.testing.assert_allclose(
+                np.asarray(p), np.asarray(q), atol=1e-5, rtol=1e-5),
+            a.state.params, b.state.params)
