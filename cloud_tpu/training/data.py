@@ -134,3 +134,62 @@ def as_dataset(data, y=None, batch_size=32, **kwargs):
     if hasattr(data, "__iter__"):
         return data
     return ArrayDataset(data, y, batch_size=batch_size, **kwargs)
+
+
+class GeneratorDataset:
+    """Streaming dataset from an iterator factory.
+
+    For data too large for memory: `factory` must return a fresh
+    iterator of batches (numpy arrays or (x, y) tuples, fixed shapes
+    for XLA) each time it is called — once per epoch, plus once for the
+    Trainer's build-time sample peek, so keep it side-effect free.
+    `steps_per_epoch` bounds each epoch for non-terminating streams
+    (Trainer.fit picks it up when its own steps_per_epoch is unset).
+
+    Note: cloud_fit ships arrays (np.savez), not factories — materialize
+    a representative array set for remote training.
+    """
+
+    def __init__(self, factory, steps_per_epoch=None):
+        if not callable(factory):
+            raise TypeError("factory must be callable, got {!r}"
+                            .format(type(factory)))
+        self.factory = factory
+        self.steps_per_epoch = steps_per_epoch
+
+    def __iter__(self):
+        return iter(self.factory())
+
+
+def prefetch_to_device(iterator, size=2, sharding=None):
+    """Wraps a host batch iterator, keeping `size` batches in flight on
+    device.
+
+    JAX async dispatch already overlaps host batching with device
+    compute; explicit prefetch additionally overlaps the host->HBM copy
+    of batch i+1 with step i, which matters when batches are large
+    (images) relative to step time.
+    """
+    import collections
+
+    queue = collections.deque()
+
+    def _put(batch):
+        if sharding is None:
+            return jax.tree_util.tree_map(jax.device_put, batch)
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sharding), batch)
+
+    it = iter(iterator)
+    try:
+        for _ in range(size):
+            queue.append(_put(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(_put(next(it)))
+        except StopIteration:
+            pass
+        yield out

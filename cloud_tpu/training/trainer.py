@@ -447,6 +447,10 @@ class Trainer:
         """
         dataset = data_lib.as_dataset(x, y, batch_size=batch_size,
                                       shuffle=shuffle, seed=self.seed)
+        if steps_per_epoch is None:
+            # Dataset-level cap (e.g. GeneratorDataset over an unbounded
+            # stream) applies when the caller sets none.
+            steps_per_epoch = getattr(dataset, "steps_per_epoch", None)
         # Safe to peek: as_dataset returns re-iterables only (one-shot
         # iterators were materialized into a list).
         sample = next(iter(dataset))
@@ -530,6 +534,27 @@ class Trainer:
                 cb.on_epoch_end(epoch, logs)
             if self.stop_training:
                 break
+
+    def save_checkpoint(self, directory):
+        """Saves the full train state under `<directory>/<step>` (local
+        or gs://). Keras `model.save` parity at the state level; pair
+        with `restore_checkpoint` or `fit(resume_from=...)`."""
+        from cloud_tpu.training import checkpoint as checkpoint_lib
+
+        if self.state is None:
+            raise RuntimeError("Model is not built; nothing to save.")
+        return checkpoint_lib.save(directory, self.state,
+                                   step=int(self.state.step))
+
+    def restore_checkpoint(self, directory, sample_x, step=None):
+        """Builds congruent state from `sample_x`, then restores the
+        checkpoint into it (shardings respected)."""
+        from cloud_tpu.training import checkpoint as checkpoint_lib
+
+        self.build(sample_x)
+        self.state = checkpoint_lib.restore(directory, self.state,
+                                            step=step)
+        return self.state
 
     def evaluate(self, x, y=None, batch_size=32, verbose=True):
         """Returns mean loss/metrics over the dataset.

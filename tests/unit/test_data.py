@@ -1,0 +1,146 @@
+"""GeneratorDataset streaming + device prefetch + save/restore API."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cloud_tpu.training.data import (ArrayDataset, GeneratorDataset,
+                                     as_dataset, prefetch_to_device)
+
+
+class TestGeneratorDataset:
+    def test_fresh_iterator_per_epoch(self):
+        def factory():
+            for i in range(3):
+                yield np.full((4, 2), i, np.float32)
+
+        ds = GeneratorDataset(factory)
+        first = [b[0, 0] for b in ds]
+        second = [b[0, 0] for b in ds]
+        assert first == second == [0.0, 1.0, 2.0]
+
+    def test_rejects_non_callable(self):
+        with pytest.raises(TypeError, match="callable"):
+            GeneratorDataset([1, 2, 3])
+
+    def test_as_dataset_passthrough(self):
+        ds = GeneratorDataset(lambda: iter([np.zeros((2, 2))]))
+        assert as_dataset(ds) is ds
+
+    def test_trains_with_trainer(self):
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.training import Trainer
+
+        runtime.reset()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8, 8)).astype(np.float32)
+        y = rng.integers(0, 10, size=64).astype(np.int32)
+
+        def factory():
+            for i in range(0, 64, 32):
+                yield x[i:i + 32], y[i:i + 32]
+
+        trainer = Trainer(MLP(hidden=16, compute_dtype=jnp.float32),
+                          optimizer=optax.adam(1e-2),
+                          loss="sparse_categorical_crossentropy",
+                          metrics=())
+        history = trainer.fit(GeneratorDataset(factory), epochs=3,
+                              verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
+
+
+class TestPrefetch:
+    def test_yields_all_batches_in_order(self):
+        batches = [np.full((2,), i, np.float32) for i in range(5)]
+        out = list(prefetch_to_device(batches, size=2))
+        assert len(out) == 5
+        for i, b in enumerate(out):
+            assert float(b[0]) == i
+            assert isinstance(b, jax.Array)
+
+    def test_short_iterator(self):
+        batches = [np.zeros((2,))]
+        assert len(list(prefetch_to_device(batches, size=4))) == 1
+
+    def test_empty_iterator(self):
+        assert list(prefetch_to_device([], size=2)) == []
+
+
+class TestSaveRestoreAPI:
+    def test_round_trip(self, tmp_path):
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.training import Trainer
+
+        runtime.reset()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8, 8)).astype(np.float32)
+        y = rng.integers(0, 10, size=32).astype(np.int32)
+
+        def make():
+            return Trainer(MLP(hidden=16, compute_dtype=jnp.float32),
+                           optimizer=optax.adam(1e-3),
+                           loss="sparse_categorical_crossentropy",
+                           metrics=(), seed=0)
+
+        a = make()
+        a.fit(x, y, epochs=1, batch_size=16, verbose=False)
+        path = str(tmp_path / "ckpt")
+        a.save_checkpoint(path)
+
+        b = make()
+        b.restore_checkpoint(path, x)
+        assert int(b.state.step) == int(a.state.step)
+        jax.tree_util.tree_map(
+            lambda p, q: np.testing.assert_array_equal(
+                np.asarray(p), np.asarray(q)),
+            a.state.params, b.state.params)
+
+    def test_save_unbuilt_raises(self):
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import Trainer
+
+        trainer = Trainer(MLP(), optimizer=optax.adam(1e-3),
+                          loss="sparse_categorical_crossentropy")
+        with pytest.raises(RuntimeError, match="not built"):
+            trainer.save_checkpoint("/tmp/nope")
+
+
+class TestUnboundedStream:
+    def test_dataset_steps_per_epoch_caps_fit(self):
+        """An infinite generator trains when the dataset carries the
+        per-epoch cap."""
+        import itertools
+
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.training import Trainer
+
+        runtime.reset()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8, 8)).astype(np.float32)
+        y = rng.integers(0, 10, size=32).astype(np.int32)
+
+        def factory():
+            for i in itertools.count():
+                j = (i * 16) % 32
+                yield x[j:j + 16], y[j:j + 16]
+
+        ds = GeneratorDataset(factory, steps_per_epoch=4)
+        trainer = Trainer(MLP(hidden=16, compute_dtype=jnp.float32),
+                          optimizer=optax.adam(1e-2),
+                          loss="sparse_categorical_crossentropy",
+                          metrics=())
+        history = trainer.fit(ds, epochs=2, verbose=False)
+        assert len(history["loss"]) == 2
+        assert int(trainer.state.step) == 8  # 2 epochs x 4 capped steps
