@@ -48,12 +48,20 @@ class BottleneckBlock(nn.Module):
 
 
 class ResNet(nn.Module):
-    """ResNet v1.5 with bottleneck blocks."""
+    """ResNet v1.5 with bottleneck blocks.
+
+    conv0_space_to_depth: fold 2x2 input blocks into channels
+    ([H, W, C] -> [H/2, W/2, 4C]) and run the stem as a 4x4/s1 conv —
+    the MLPerf TPU trick that turns the memory-bound 7x7/s2 stem into an
+    MXU-friendly matmul over 12 input channels. Same receptive-field
+    class, not weight-compatible with the standard stem.
+    """
 
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     num_filters: int = 64
     compute_dtype: jnp.dtype = jnp.bfloat16
+    conv0_space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train=True):
@@ -63,8 +71,20 @@ class ResNet(nn.Module):
                        dtype=self.compute_dtype)
 
         x = x.astype(self.compute_dtype)
-        x = conv(self.num_filters, (7, 7), strides=(2, 2), use_bias=False,
-                 name="conv_init")(x)
+        if self.conv0_space_to_depth:
+            b, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    "space-to-depth needs even spatial dims; got "
+                    "{}x{}.".format(h, w))
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                b, h // 2, w // 2, 4 * c)
+            x = conv(self.num_filters, (4, 4), use_bias=False,
+                     name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                     use_bias=False, name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
