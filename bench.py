@@ -6,16 +6,28 @@ v5e-8 matching 8xV100 wall-clock. The reference publishes no numbers
 baseline is the 8xV100 side of the driver's target: ResNet50 mixed
 precision at ~2800 images/sec across 8 V100s = 350 images/sec per
 V100-equivalent. This harness measures our per-chip ResNet50 train-step
-throughput (bf16, NHWC, batch 256) through the framework's own jitted
-Trainer step; vs_baseline > 1.0 means one v5e chip beats one V100, i.e.
-v5e-8 beats 8xV100 wall-clock for config 2.
+throughput (bf16, NHWC) through the framework's own jitted Trainer
+step; vs_baseline > 1.0 means one v5e chip beats one V100, i.e. v5e-8
+beats 8xV100 wall-clock for config 2.
+
+Structure: the top-level process never touches the accelerator backend
+directly — the TPU on this host sits behind an experimental tunnel
+whose init can hang indefinitely, so (1) backend health is probed in a
+bounded subprocess, (2) the measurement itself runs in a bounded
+subprocess, (3) both are retried, and (4) persistent failure produces a
+diagnostic JSON line instead of a traceback or a hang.
 
 Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
+     "method": "median_chunk", ...}
+or, when the backend is unreachable after all retries:
+    {"metric": ..., "value": 0.0, ..., "error": "<diagnosis>"}
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -27,9 +39,129 @@ TIMED_STEPS = int(os.environ.get("BENCH_STEPS", 20))
 CHUNK = min(int(os.environ.get("BENCH_CHUNK", 5)), TIMED_STEPS)
 BASELINE_IMAGES_PER_SEC = 350.0  # one V100, fp16 ResNet50 (8xV100 / 8)
 
+ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", 3))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+RETRY_DELAY_S = float(os.environ.get("BENCH_RETRY_DELAY", 20))
+# Overall wall-clock budget: whatever happens, the JSON line appears
+# within roughly this many seconds, so an outer `timeout` on the driver
+# side never fires first and the result is always recorded. The
+# per-attempt worker timeout is additionally clamped to the remaining
+# deadline — raise BENCH_DEADLINE together with BENCH_TIMEOUT for a
+# slow-but-healthy backend.
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE", 600))
+WORKER_TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", 480))
+
+METRIC = "resnet50_train_images_per_sec_per_chip"
+
+
+def _metric_name():
+    # The s2d stem is an architecture variant: suffix it so recorded
+    # numbers (including failed runs) stay apples-to-apples per series.
+    if os.environ.get("BENCH_S2D", "0") == "1":
+        return METRIC + "_s2d"
+    return METRIC
+
+
+def _probe_backend(timeout=None):
+    """Compile-and-run a trivial jit in a fresh bounded process.
+
+    Returns (ok, diagnosis). A healthy backend answers in a few seconds
+    (first-compile overhead aside); a stalled tunnel hits the timeout
+    without ever returning — which must not take the harness down with
+    it, hence the subprocess.
+    """
+    timeout = PROBE_TIMEOUT_S if timeout is None else timeout
+    # A site hook can pin JAX_PLATFORMS to the tunnel, so the CPU
+    # override (used by CI to test this harness end-to-end) must be an
+    # explicit config update, not an env var.
+    code = ("import os, jax; "
+            "os.environ.get('BENCH_FORCE_CPU') == '1' and "
+            "jax.config.update('jax_platforms', 'cpu'); "
+            "x = jax.jit(lambda v: v + 1)(1.0); x.block_until_ready(); "
+            "print('PROBE_OK', jax.default_backend(), len(jax.devices()))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout, cwd=os.path.dirname(__file__) or ".")
+    except subprocess.TimeoutExpired:
+        return False, "backend probe hung past {:.0f}s".format(timeout)
+    except OSError as e:
+        return False, "backend probe failed to launch: {}".format(e)
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            return True, line.strip()
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return False, "backend init failed: {}".format(tail[-1] if tail else
+                                                   "rc={}".format(proc.returncode))
+
+
+def _run_worker(timeout=None):
+    """Run the measurement in a bounded subprocess; returns (record, err)."""
+    timeout = WORKER_TIMEOUT_S if timeout is None else timeout
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(__file__) or ".")
+    except subprocess.TimeoutExpired:
+        return None, "measurement hung past {:.0f}s".format(timeout)
+    except OSError as e:
+        return None, "measurement failed to launch: {}".format(e)
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                break
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return None, "measurement died: {}".format(tail[-1] if tail else
+                                               "rc={}".format(proc.returncode))
+
 
 def main():
+    start = time.monotonic()
+
+    def remaining():
+        return DEADLINE_S - (time.monotonic() - start)
+
+    last_err = "no attempts made"
+    attempt = 0
+    while attempt < ATTEMPTS and remaining() > 10:
+        if attempt:
+            time.sleep(min(RETRY_DELAY_S, max(remaining() - 10, 0)))
+        attempt += 1
+        ok, diag = _probe_backend(timeout=min(PROBE_TIMEOUT_S, remaining()))
+        print("# probe attempt {}: {}".format(attempt, diag),
+              file=sys.stderr)
+        if not ok:
+            last_err = diag
+            continue
+        if remaining() < 30:
+            last_err = "backend healthy but <30s of budget left for " \
+                       "measurement"
+            break
+        record, err = _run_worker(timeout=min(WORKER_TIMEOUT_S, remaining()))
+        if record is not None:
+            print(json.dumps(record))
+            return
+        last_err = err
+        print("# measurement attempt {} failed: {}".format(attempt, err),
+              file=sys.stderr)
+    print(json.dumps({
+        "metric": _metric_name(),
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+        "error": last_err,
+        "attempts": attempt,
+    }))
+
+
+def worker():
     import jax
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
     import optax
 
     from cloud_tpu.models import ResNet50
@@ -70,18 +202,24 @@ def main():
 
     images_per_sec = BATCH * CHUNK / median_elapsed
     record = {
-        "metric": "resnet50_train_images_per_sec_per_chip",
+        "metric": _metric_name(),
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
+        "method": "median_chunk",
+        "chunk": CHUNK,
+        "steps": max(TIMED_STEPS // CHUNK, 1) * CHUNK,
+        "batch": BATCH,
+        "image": IMAGE,
+        "platform": jax.default_backend(),
     }
     if s2d:
-        # Architecture variant: mark it so recorded numbers stay
-        # apples-to-apples with the standard stem.
-        record["metric"] += "_s2d"
         record["stem"] = "space_to_depth"
     print(json.dumps(record))
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv[1:]:
+        worker()
+    else:
+        main()
