@@ -98,11 +98,12 @@ def _save_outputs(remote_dir, trainer, history):
     from cloud_tpu.training import checkpoint as checkpoint_lib
 
     output_dir = storage.join(remote_dir, OUTPUT_DIR)
-    if not storage.is_gcs_path(output_dir):
-        # orbax owns the multi-process write protocol; the JSON history
-        # is chief-written only.
-        checkpoint_lib.save(output_dir, trainer.state,
-                            step=int(trainer.state.step))
+    # The trained state is the job's product: always save it, local or
+    # gs:// (orbax/tensorstore writes both; the reference likewise always
+    # saves, remote.py:130-145). orbax owns the multi-process write
+    # protocol; the JSON history is chief-written only.
+    checkpoint_lib.save(output_dir, trainer.state,
+                        step=int(trainer.state.step))
     if jax.process_index() == 0:
         storage.write_bytes(
             storage.join(remote_dir, OUTPUT_DIR, HISTORY_FILE),

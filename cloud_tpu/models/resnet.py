@@ -47,8 +47,35 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+class BasicBlock(nn.Module):
+    """3x3(stride) -> 3x3 basic block (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,
+                                                     self.strides),
+                      use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 use_bias=False, name="shortcut")(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y)
+
+
 class ResNet(nn.Module):
-    """ResNet v1.5 with bottleneck blocks.
+    """ResNet v1.5 with bottleneck (50+) or basic (18/34) blocks.
 
     conv0_space_to_depth: fold 2x2 input blocks into channels
     ([H, W, C] -> [H/2, W/2, 4C]) and run the stem as a 4x4/s1 conv —
@@ -62,6 +89,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     compute_dtype: jnp.dtype = jnp.bfloat16
     conv0_space_to_depth: bool = False
+    block: ModuleDef = BottleneckBlock
 
     @nn.compact
     def __call__(self, x, train=True):
@@ -92,9 +120,9 @@ class ResNet(nn.Module):
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = BottleneckBlock(self.num_filters * 2 ** i,
-                                    strides=strides, conv=conv,
-                                    norm=norm)(x)
+                x = self.block(self.num_filters * 2 ** i,
+                               strides=strides, conv=conv,
+                               norm=norm)(x)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.compute_dtype)(x)
@@ -102,9 +130,11 @@ class ResNet(nn.Module):
 
 
 def ResNet18(**kwargs):
-    # 18/34 use basic blocks classically; bottleneck keeps the code one
-    # path and XLA-friendly — depth tag kept for familiarity.
-    return ResNet(stage_sizes=(2, 2, 2, 2), **kwargs)
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock, **kwargs)
+
+
+def ResNet34(**kwargs):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock, **kwargs)
 
 
 def ResNet50(**kwargs):

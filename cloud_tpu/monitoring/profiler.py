@@ -11,6 +11,7 @@ spans in the trace.
 """
 
 import contextlib
+import logging
 
 import jax
 
@@ -69,9 +70,26 @@ class ProfilerCallback(Callback):
         self.log_dir = log_dir
         self.epochs = set(epochs)
         self._active = False
+        self._run_epochs = self.epochs
+
+    def on_train_begin(self):
+        # Per-run view: never mutate the configured epochs, so a reused
+        # callback instance re-evaluates the fallback for each fit().
+        self._run_epochs = self.epochs
+        planned = getattr(self.trainer, "planned_epochs", None)
+        if planned is not None and not any(e < planned
+                                           for e in self.epochs):
+            # E.g. the default epochs=(1,) with fit(epochs=1): only
+            # epoch 0 runs. Trace it rather than silently producing
+            # nothing.
+            logging.getLogger("cloud_tpu").warning(
+                "ProfilerCallback: none of the requested epochs %s will "
+                "run (fit epochs=%d); profiling epoch 0 instead.",
+                sorted(self.epochs), planned)
+            self._run_epochs = {0}
 
     def on_epoch_begin(self, epoch):
-        if epoch in self.epochs and jax.process_index() == 0:
+        if epoch in self._run_epochs and jax.process_index() == 0:
             options = jax.profiler.ProfileOptions()
             jax.profiler.start_trace(self.log_dir,
                                      profiler_options=options)

@@ -149,17 +149,16 @@ class MetricsLogger(Callback):
     read back by DistributingCloudTuner (replacing event-file parsing,
     reference tuner/tuner.py:532-560).
 
-    Local and `gs://` paths both work (GCS has no append, so the full
-    stream is rewritten each epoch through the storage seam)."""
+    Local and `gs://` paths both work; each epoch appends one record
+    (GCS objects are extended via compose — linear bytes over a run,
+    however long)."""
 
     def __init__(self, path):
         self.path = path
-        self._records = []
 
     def on_train_begin(self):
         from cloud_tpu.utils import storage
 
-        self._records = []
         if jax.process_index() != 0:
             return
         # Truncate any previous run's stream.
@@ -172,9 +171,8 @@ class MetricsLogger(Callback):
             return
         record = {"epoch": epoch}
         record.update({k: float(v) for k, v in logs.items()})
-        self._records.append(record)
-        payload = "".join(json.dumps(r) + "\n" for r in self._records)
-        storage.write_bytes(self.path, payload.encode("utf-8"))
+        storage.append_bytes(self.path,
+                             (json.dumps(record) + "\n").encode("utf-8"))
 
 
 def read_metrics_log(path):

@@ -61,10 +61,13 @@ LOSSES = {
 
 
 def _accuracy(outputs, labels):
+    """Per-example correctness (float). Mean-reduced by the train step;
+    kept per-example so evaluate() can mask padded tail examples for
+    exact example-weighted metrics."""
     preds = jnp.argmax(outputs, axis=-1)
     if labels.ndim == preds.ndim + 1:  # one-hot
         labels = jnp.argmax(labels, axis=-1)
-    return jnp.mean((preds == labels).astype(jnp.float32))
+    return (preds == labels).astype(jnp.float32)
 
 
 METRICS = {
@@ -363,7 +366,9 @@ class Trainer:
                                    new_opt_state, state.rng, new_vars)
             logs = {"loss": loss}
             for name, fn in metric_fns.items():
-                logs[name] = fn(outputs, y)
+                # Mean-reduce: metric fns may return per-example values
+                # (built-ins do) or a scalar; train logs are batch means.
+                logs[name] = jnp.mean(fn(outputs, y))
             return new_state, logs
 
         if self._mesh is None:
@@ -381,14 +386,35 @@ class Trainer:
         loss_fn = self.loss_fn
         eval_kwargs = self.eval_kwargs
 
+        def _per_example(v, batch_dim):
+            # Collapse any non-batch dims (e.g. per-token losses) to one
+            # value per example so the valid-mask applies cleanly.
+            v = jnp.asarray(v)
+            if v.ndim > 1:
+                return jnp.mean(v.reshape(batch_dim, -1), axis=1)
+            return v
+
         def eval_step(state, batch):
-            x, y = batch
+            # mask flags real examples; padded tail duplicates (wrapped
+            # by ArrayDataset for static shapes) carry zero weight, so
+            # metrics are exact example-weighted means.
+            x, y, mask = batch
             outputs = self._apply(state.params, x,
                                   extra_vars=state.extra_vars,
                                   **eval_kwargs)
-            logs = {"loss": jnp.mean(loss_fn(outputs, y))}
+            n = jnp.maximum(jnp.sum(mask), 1.0)
+            per_ex = _per_example(loss_fn(outputs, y), mask.shape[0])
+            logs = {"loss": jnp.sum(per_ex * mask) / n}
             for name, fn in metric_fns.items():
-                logs[name] = fn(outputs, y)
+                v = jnp.asarray(fn(outputs, y))
+                if v.ndim >= 1:
+                    v = _per_example(v, mask.shape[0])
+                    logs[name] = jnp.sum(v * mask) / n
+                else:
+                    # Scalar custom metric: no per-example view to mask;
+                    # batch mean (includes padded duplicates) is the
+                    # best available estimate.
+                    logs[name] = v
             return logs
 
         if self._mesh is None:
@@ -397,7 +423,8 @@ class Trainer:
         return jax.jit(
             eval_step,
             in_shardings=(self._state_sharding,
-                          (batch_sharding, batch_sharding)))
+                          (batch_sharding, batch_sharding,
+                           batch_sharding)))
 
     # -- feeding --------------------------------------------------------
 
@@ -468,6 +495,9 @@ class Trainer:
 
         history = {}
         self.stop_training = False
+        # Visible to callbacks at on_train_begin (e.g. ProfilerCallback
+        # checks its target epochs will actually run).
+        self.planned_epochs = epochs
         for cb in callbacks:
             cb.set_trainer(self)
             cb.on_train_begin()
@@ -556,12 +586,19 @@ class Trainer:
                                             step=step)
         return self.state
 
-    def evaluate(self, x, y=None, batch_size=32, verbose=True):
-        """Returns mean loss/metrics over the dataset.
+    def evaluate(self, x, y=None, batch_size=32, verbose=True,
+                 steps=None):
+        """Returns exact example-weighted mean loss/metrics.
 
-        Tail batches are padded by wrapping (never dropped), so datasets
-        smaller than `batch_size` still evaluate; padded duplicates add a
-        small weight to early examples.
+        Tail batches are padded by wrapping (never dropped) so shapes
+        stay static for XLA, but padded duplicates are masked out inside
+        the eval step and each batch is weighted by its real example
+        count — metrics match a hand-computed mean over the dataset
+        (Keras-exact), regardless of tail padding.
+
+        `steps` caps the batch loop; when unset, a dataset-level
+        `steps_per_epoch` (e.g. GeneratorDataset over an unbounded
+        stream) applies, mirroring fit().
         """
         if self.state is None:
             raise RuntimeError("Model is not built; call fit() first or "
@@ -570,16 +607,42 @@ class Trainer:
             self._jit_eval_step = self._make_eval_step()
         dataset = data_lib.as_dataset(x, y, batch_size=batch_size,
                                       drop_remainder=False)
-        totals, count = {}, 0
-        for batch in self._epoch_batches(dataset):
-            batch = self._feed(batch)
-            logs = self._jit_eval_step(self.state, batch)
-            count += 1
+        if steps is None:
+            steps = getattr(dataset, "steps_per_epoch", None)
+        num_examples = getattr(dataset, "num_examples", None)
+        global_bs = getattr(dataset, "batch_size", None)
+        process_count = jax.process_count()
+        process_index = jax.process_index()
+        totals, weight = {}, 0.0
+        for i, batch in enumerate(self._epoch_batches(dataset)):
+            if steps is not None and i >= steps:
+                break
+            # Same unpacking the train step applies: any 2-sequence is
+            # (x, y); anything else is unlabeled input.
+            if isinstance(batch, (tuple, list)) and len(batch) == 2:
+                xb, yb = batch
+            else:
+                xb, yb = batch, None
+            local_b = jax.tree_util.tree_leaves(xb)[0].shape[0]
+            if num_examples is not None and global_bs is not None:
+                # ArrayDataset pads the tail by wrapping: only the first
+                # `real` rows of the global batch are fresh examples.
+                real = min(global_bs, num_examples - i * global_bs)
+            else:
+                # Arbitrary iterables yield their own (unpadded) batches.
+                real = local_b * process_count
+            # This process holds global rows [offset, offset + local_b).
+            offset = process_index * local_b if process_count > 1 else 0
+            mask = ((np.arange(local_b) + offset) < real).astype(
+                np.float32)
+            fed = self._feed((xb, yb, mask))
+            logs = self._jit_eval_step(self.state, fed)
+            weight += real
             for k, v in logs.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
-        if count == 0:
+                totals[k] = totals.get(k, 0.0) + float(v) * real
+        if weight == 0.0:
             raise ValueError("evaluate() received an empty dataset.")
-        logs = {k: v / count for k, v in totals.items()}
+        logs = {k: v / weight for k, v in totals.items()}
         if verbose and jax.process_index() == 0:
             logger.info("evaluate: %s", {
                 k: round(v, 4) for k, v in logs.items()})

@@ -1,0 +1,60 @@
+"""Worker process for the two-process tpu_pod correctness test.
+
+Launched by test_multiprocess.py with the CLOUD_TPU_* env contract set
+(the analogue of the reference's fabricated-TF_CONFIG fake-cluster trick,
+reference cloud_fit/tests/unit/remote_test.py:80-127 — but with real
+processes and a real jax.distributed handshake, not a mocked cluster).
+
+Runs a deterministic 2-epoch fit on the pod mesh and prints one JSON
+line with the per-epoch losses.
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+# Each process contributes 4 virtual CPU devices -> 8-device global mesh.
+# The site hook pins JAX_PLATFORMS to the TPU tunnel, so the CPU switch
+# must be a config update, not an env var.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main():
+    import numpy as np
+    import optax
+
+    from cloud_tpu.models import MLP
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.training import Trainer
+
+    # runtime.initialize picks up CLOUD_TPU_COORDINATOR_ADDRESS /
+    # CLOUD_TPU_NUM_PROCESSES / CLOUD_TPU_PROCESS_ID from the env.
+    runtime.initialize(strategy="tpu_pod")
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4))
+    y = np.argmax(x @ w, axis=-1).astype(np.int32)
+
+    import jax.numpy as jnp
+    trainer = Trainer(MLP(hidden=16, num_classes=4,
+                          compute_dtype=jnp.float32),
+                      optimizer=optax.sgd(0.1))
+    history = trainer.fit(x, y, epochs=2, batch_size=32, shuffle=False,
+                          verbose=False)
+    print(json.dumps({
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "num_devices": len(jax.devices()),
+        "loss": history["loss"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

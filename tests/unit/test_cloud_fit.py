@@ -152,6 +152,58 @@ class TestRemoteRun:
             storage.join(out, remote.HISTORY_FILE)))
         assert saved_history["loss"] == history["loss"]
 
+    def test_state_round_trips_through_output_dir(self, tmp_path):
+        """The saved checkpoint must restore into a fresh trainer —
+        the remote worker's product is the trained state, not just
+        history.json."""
+        import jax
+
+        from cloud_tpu.training import checkpoint as checkpoint_lib
+
+        x, y = _toy_data(n=64)
+        remote_dir = str(tmp_path / "job")
+        client.serialize_assets(remote_dir, _trainer(), x, y, epochs=1,
+                                batch_size=32)
+        remote.run(remote_dir, "tpu_slice")
+
+        fresh = _trainer()
+        fresh.build(x)
+        restored = checkpoint_lib.restore(
+            storage.join(remote_dir, remote.OUTPUT_DIR), fresh.state)
+        assert int(restored.step) == 2  # 1 epoch x 2 steps
+        for leaf in jax.tree_util.tree_leaves(restored.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_gcs_output_dir_still_saves_state(self, monkeypatch):
+        """Regression: the production path (remote worker writing to a
+        bucket) must save the model state, not only history.json.
+        Reference always saves (remote.py:130-145); orbax/tensorstore
+        handles gs:// natively, so there is no reason to skip."""
+        import jax
+
+        from cloud_tpu.training import checkpoint as checkpoint_lib
+
+        saved = {}
+        monkeypatch.setattr(
+            checkpoint_lib, "save",
+            lambda directory, state, step=0, **kw: saved.update(
+                {"dir": directory, "step": step}))
+        written = {}
+        monkeypatch.setattr(
+            storage, "write_bytes",
+            lambda path, data: written.update({"path": path}))
+
+        state = mock.MagicMock()
+        state.step = 7
+        trainer = mock.MagicMock()
+        trainer.state = state
+        remote._save_outputs("gs://bucket/job", trainer, {"loss": [1.0]})
+
+        assert saved["dir"] == "gs://bucket/job/output"
+        assert saved["step"] == 7
+        if jax.process_index() == 0:
+            assert written["path"] == "gs://bucket/job/output/history.json"
+
     def test_main_flags(self, tmp_path):
         x, y = _toy_data(n=32)
         remote_dir = str(tmp_path / "job")
@@ -181,3 +233,63 @@ class TestStorage:
         monkeypatch.setattr(storage, "gcs", None)
         with pytest.raises(RuntimeError, match="google-cloud-storage"):
             storage.read_bytes("gs://bucket/blob")
+
+    def test_append_bytes_local(self, tmp_path):
+        path = str(tmp_path / "log.jsonl")
+        storage.append_bytes(path, b"a\n")
+        storage.append_bytes(path, b"b\n")
+        assert storage.read_bytes(path) == b"a\nb\n"
+
+    def test_append_bytes_gcs_composes(self, monkeypatch):
+        """GCS appends must extend the object server-side (compose), not
+        re-upload the accumulated stream — O(total) bytes per run."""
+        bucket = mock.MagicMock()
+        dest = mock.MagicMock()
+        part = mock.MagicMock()
+        dest.exists.return_value = True
+        part_names = []
+
+        def _blob(name):
+            if ".part." in name:
+                part_names.append(name)
+                return part
+            return dest
+
+        bucket.blob.side_effect = _blob
+        fake_client = mock.MagicMock()
+        fake_client.bucket.return_value = bucket
+        monkeypatch.setattr(storage, "_client", lambda: fake_client)
+
+        storage.append_bytes("gs://b/log.jsonl", b"line\n")
+
+        part.upload_from_string.assert_called_once_with(b"line\n")
+        # Unique staging name per append (no cross-writer clobbering).
+        assert len(part_names) == 1
+        assert part_names[0].startswith("log.jsonl.part.")
+        # Compose guarded by a generation precondition.
+        dest.compose.assert_called_once_with(
+            [dest, part], if_generation_match=dest.generation)
+        part.delete.assert_called_once()
+        dest.upload_from_string.assert_not_called()
+
+    def test_gcs_listdir_uses_delimiter(self, monkeypatch):
+        """listdir must aggregate children server-side (delimiter='/'),
+        not enumerate every blob under the prefix — an orbax checkpoint
+        tree holds thousands of shard files."""
+
+        class FakeListing(list):
+            prefixes = {"ckpt/0/", "ckpt/1/"}
+
+        blob = mock.MagicMock()
+        blob.name = "ckpt/manifest.json"
+        listing = FakeListing([blob])
+        bucket = mock.MagicMock()
+        bucket.list_blobs.return_value = listing
+        fake_client = mock.MagicMock()
+        fake_client.bucket.return_value = bucket
+        monkeypatch.setattr(storage, "_client", lambda: fake_client)
+
+        names = storage.listdir("gs://b/ckpt")
+
+        assert names == ["0", "1", "manifest.json"]
+        assert bucket.list_blobs.call_args.kwargs["delimiter"] == "/"

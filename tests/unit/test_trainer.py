@@ -64,6 +64,78 @@ class TestFit:
         preds = trainer.predict(x[:100], batch_size=64)
         assert preds.shape == (100, 4)
 
+    def test_evaluate_exact_example_weighted(self):
+        """A dataset of batch_size+1 examples: the wrapped tail padding
+        must not shift metrics — evaluate matches the hand-computed
+        example mean exactly."""
+        import jax
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=33)
+        # f32 compute: the check is weighting exactness, not bf16 noise
+        # between the jitted eval step and the unjitted predict pass.
+        trainer = Trainer(MLP(hidden=16, num_classes=4,
+                              compute_dtype=jnp.float32))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        logs = trainer.evaluate(x, y, batch_size=32, verbose=False)
+
+        logits = trainer.predict(x, batch_size=32)
+        per_ex_loss = np.asarray(
+            optax.softmax_cross_entropy_with_integer_labels(
+                jnp.asarray(logits), jnp.asarray(y)))
+        expected_loss = float(per_ex_loss.mean())
+        expected_acc = float(
+            (np.argmax(logits, axis=-1) == y).mean())
+        assert logs["loss"] == pytest.approx(expected_loss, rel=1e-5)
+        assert logs["accuracy"] == pytest.approx(expected_acc, rel=1e-6)
+        del jax
+
+    def test_evaluate_exact_on_mesh(self):
+        """Same exactness through the sharded eval step (mask rides the
+        batch sharding)."""
+        import jax.numpy as jnp
+
+        runtime.initialize(strategy="tpu_slice")
+        x, y = _toy_classification(n=40)
+        trainer = Trainer(MLP(hidden=16, num_classes=4,
+                              compute_dtype=jnp.float32))
+        trainer.fit(x, y, epochs=1, batch_size=16, verbose=False)
+        logs = trainer.evaluate(x, y, batch_size=16, verbose=False)
+        logits = trainer.predict(x, batch_size=16)
+        per_ex_loss = np.asarray(
+            optax.softmax_cross_entropy_with_integer_labels(
+                jnp.asarray(logits), jnp.asarray(y)))
+        assert logs["loss"] == pytest.approx(float(per_ex_loss.mean()),
+                                             rel=1e-5)
+
+    def test_evaluate_list_shaped_batches(self):
+        """Re-iterables may yield [x, y] lists; evaluate must unpack
+        them like the train step does, not treat them as unlabeled."""
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        batches = [[x[:32], y[:32]], [x[32:], y[32:]]]
+        logs = trainer.evaluate(batches, verbose=False)
+        assert np.isfinite(logs["loss"])
+
+    def test_evaluate_caps_streaming_dataset(self):
+        """evaluate() must honor a dataset-level steps_per_epoch the way
+        fit() does — otherwise an unbounded GeneratorDataset loops
+        forever."""
+        from cloud_tpu.training.data import GeneratorDataset
+
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+
+        def unbounded():
+            while True:
+                yield x[:32], y[:32]
+
+        dataset = GeneratorDataset(unbounded, steps_per_epoch=3)
+        logs = trainer.evaluate(dataset, verbose=False)
+        assert np.isfinite(logs["loss"])
+
     def test_validation_data(self):
         x, y = _toy_classification()
         trainer = Trainer(MLP(hidden=16, num_classes=4))
@@ -100,6 +172,26 @@ class TestBatchNormModels:
         stats = trainer.state.extra_vars["batch_stats"]
         mean = np.asarray(stats["bn_init"]["mean"])
         assert np.abs(mean).sum() > 0
+
+
+class TestResNetVariants:
+
+    def test_resnet18_is_basic_block(self):
+        """ResNet18 must match the canonical basic-block architecture
+        (11,689,512 params at 1000 classes), not a bottleneck stand-in."""
+        import jax
+        import jax.numpy as jnp
+
+        from cloud_tpu.models import ResNet18
+
+        model = ResNet18(num_classes=1000)
+        shapes = jax.eval_shape(
+            lambda k: model.init(k, jnp.ones((1, 224, 224, 3)),
+                                 train=False),
+            jax.random.PRNGKey(0))
+        n = sum(int(np.prod(p.shape))
+                for p in jax.tree_util.tree_leaves(shapes["params"]))
+        assert n == 11_689_512
 
 
 class TestTensorParallel:
