@@ -16,6 +16,10 @@ import threading
 _LIB_ENV = "CLOUD_TPU_MONITORING_LIB"
 _LIB_NAME = "libcloud_tpu_monitoring.so"
 
+# C-ABI transport signature: int (*)(const char* method, const char* json).
+_TRANSPORT_CFUNC = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                                    ctypes.c_char_p)
+
 
 def _candidate_paths():
     env = os.environ.get(_LIB_ENV)
@@ -48,6 +52,8 @@ def _load():
             lib.cloud_tpu_exporter_start.argtypes = [ctypes.c_int64]
             lib.cloud_tpu_exporter_start.restype = ctypes.c_int
             lib.cloud_tpu_exporter_export_count.restype = ctypes.c_int64
+            lib.cloud_tpu_set_transport.argtypes = [_TRANSPORT_CFUNC]
+            lib.cloud_tpu_http_transport_available.restype = ctypes.c_int
             return lib
         except (OSError, AttributeError):
             # Unloadable or stale .so (missing symbols): keep looking,
@@ -201,6 +207,87 @@ def export_count():
 def stop_exporter():
     if _lib is not None:
         _lib.cloud_tpu_exporter_stop()
+
+
+# Keepalive for every thunk ever registered: an in-flight native send
+# may still hold a pointer loaded before a swap, so old trampolines are
+# never freed (a few dozen bytes per set_transport call, by design).
+_transport_keepalive = []
+
+
+def set_transport(fn):
+    """Routes native exporter sends through a Python callable.
+
+    `fn(method: str, json: str) -> bool` with method one of
+    "CreateTimeSeries" / "CreateMetricDescriptor". The C++ exporter
+    keeps owning collection/filtering/request synthesis; only the final
+    send crosses back into Python (e.g. to reuse an authenticated
+    google-api client). Pass None to restore the env-selected transport
+    (file, or http when CLOUD_TPU_MONITORING_TRANSPORT=http).
+    """
+    if _lib is None:
+        return False
+    if fn is None:
+        _lib.cloud_tpu_set_transport(_TRANSPORT_CFUNC())
+        return True
+
+    def _bridge(method, payload):
+        try:
+            return 1 if fn(method.decode(), payload.decode()) else 0
+        except Exception:  # never let an exception cross the C boundary
+            return 0
+
+    thunk = _TRANSPORT_CFUNC(_bridge)
+    _transport_keepalive.append(thunk)
+    _lib.cloud_tpu_set_transport(thunk)
+    return True
+
+
+def http_transport_available():
+    """True when the native library can reach libcurl for real sends."""
+    if _lib is None:
+        return False
+    return bool(_lib.cloud_tpu_http_transport_available())
+
+
+def google_auth_transport(session=None):
+    """Transport callable that POSTs via an authenticated google client.
+
+    The Python-side default-credentials path (reference
+    stackdriver_client.cc:56-58): pair with `set_transport`. `session`
+    defaults to `google.auth` application-default credentials wrapped in
+    an AuthorizedSession; inject a fake for tests.
+    """
+    import json as json_lib
+
+    if session is None:
+        import google.auth
+        from google.auth.transport.requests import AuthorizedSession
+
+        credentials, project = google.auth.default(
+            scopes=["https://www.googleapis.com/auth/monitoring.write"])
+        session = AuthorizedSession(credentials)
+
+    endpoint = os.environ.get("CLOUD_TPU_MONITORING_ENDPOINT",
+                              "https://monitoring.googleapis.com")
+
+    def _send(method, payload):
+        # The builders emit gRPC-shaped wrappers; the REST bindings put
+        # the project in the URL and take the bare payload as body
+        # (metricDescriptors.create: a MetricDescriptor;
+        # timeSeries.create: {"timeSeries": [...]}).
+        body = json_lib.loads(payload)
+        project_path = body.pop("name", "")
+        if method == "CreateMetricDescriptor":
+            path = "metricDescriptors"
+            body = body.get("metricDescriptor", body)
+        else:
+            path = "timeSeries"
+        url = "{}/v3/{}/{}".format(endpoint, project_path, path)
+        response = session.post(url, json=body, timeout=15)
+        return 200 <= response.status_code < 300
+
+    return _send
 
 
 def reset_for_testing():

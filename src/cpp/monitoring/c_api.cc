@@ -9,6 +9,7 @@
 
 #include "config.h"
 #include "exporter.h"
+#include "http_transport.h"
 #include "metrics_registry.h"
 #include "stackdriver_client.h"
 
@@ -97,6 +98,18 @@ int64_t cloud_tpu_exporter_export_count() {
 void cloud_tpu_exporter_stop() {
   std::lock_guard<std::mutex> lock(g_exporter_mu);
   if (g_exporter != nullptr) g_exporter->Stop();
+}
+
+// Registers a host-process transport (e.g. a Python callback holding an
+// authenticated google client). NULL restores env-selected transports.
+void cloud_tpu_set_transport(
+    int (*callback)(const char* method, const char* json)) {
+  cloud_tpu::monitoring::SetTransportCallback(callback);
+}
+
+// 1 when the libcurl REST sender can be used on this host.
+int cloud_tpu_http_transport_available() {
+  return cloud_tpu::monitoring::HttpTransportAvailable() ? 1 : 0;
 }
 
 void cloud_tpu_registry_reset() {

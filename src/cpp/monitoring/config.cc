@@ -11,6 +11,8 @@ const char kEnabledEnvVar[] = "CLOUD_TPU_MONITORING_ENABLED";
 const char kProjectIdEnvVar[] = "CLOUD_TPU_MONITORING_PROJECT_ID";
 const char kWhitelistEnvVar[] = "CLOUD_TPU_MONITORING_METRICS_WHITELIST";
 const char kExportPathEnvVar[] = "CLOUD_TPU_MONITORING_EXPORT_PATH";
+const char kTransportEnvVar[] = "CLOUD_TPU_MONITORING_TRANSPORT";
+const char kEndpointEnvVar[] = "CLOUD_TPU_MONITORING_ENDPOINT";
 
 namespace {
 
@@ -38,6 +40,12 @@ Config::Config() {
   if (project != nullptr) project_id_ = project;
   const char* path = std::getenv(kExportPathEnvVar);
   if (path != nullptr) export_path_ = path;
+  const char* transport = std::getenv(kTransportEnvVar);
+  if (transport != nullptr && transport[0] != '\0') {
+    transport_ = transport;
+  }
+  const char* endpoint = std::getenv(kEndpointEnvVar);
+  if (endpoint != nullptr && endpoint[0] != '\0') endpoint_ = endpoint;
 
   const char* raw = std::getenv(kWhitelistEnvVar);
   if (raw == nullptr || std::string(raw).empty()) {
@@ -72,7 +80,8 @@ bool Config::IsWhitelisted(const std::string& metric_name) const {
 std::string Config::DebugString() const {
   std::stringstream out;
   out << "enabled=" << (enabled_ ? "true" : "false")
-      << " project_id=" << project_id_ << " whitelist=[";
+      << " project_id=" << project_id_
+      << " transport=" << transport_ << " whitelist=[";
   bool first = true;
   for (const auto& name : whitelist_) {
     if (!first) out << ",";

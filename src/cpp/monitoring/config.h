@@ -21,6 +21,8 @@ extern const char kEnabledEnvVar[];      // CLOUD_TPU_MONITORING_ENABLED
 extern const char kProjectIdEnvVar[];    // CLOUD_TPU_MONITORING_PROJECT_ID
 extern const char kWhitelistEnvVar[];    // CLOUD_TPU_MONITORING_METRICS_WHITELIST
 extern const char kExportPathEnvVar[];   // CLOUD_TPU_MONITORING_EXPORT_PATH
+extern const char kTransportEnvVar[];    // CLOUD_TPU_MONITORING_TRANSPORT
+extern const char kEndpointEnvVar[];     // CLOUD_TPU_MONITORING_ENDPOINT
 
 class Config {
  public:
@@ -35,6 +37,10 @@ class Config {
   bool enabled() const { return enabled_; }
   const std::string& project_id() const { return project_id_; }
   const std::string& export_path() const { return export_path_; }
+  // "file" (default) or "http" (real Cloud Monitoring REST sends).
+  const std::string& transport() const { return transport_; }
+  // REST endpoint base, overridable for tests/emulators.
+  const std::string& endpoint() const { return endpoint_; }
   std::string DebugString() const;
 
  private:
@@ -43,6 +49,8 @@ class Config {
   bool enabled_ = false;
   std::string project_id_;
   std::string export_path_;
+  std::string transport_ = "file";
+  std::string endpoint_ = "https://monitoring.googleapis.com";
   std::set<std::string> whitelist_;
 };
 

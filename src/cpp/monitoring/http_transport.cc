@@ -1,0 +1,243 @@
+#include "http_transport.h"
+
+#include <dlfcn.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <string>
+
+#include "config.h"
+
+namespace cloud_tpu {
+namespace monitoring {
+
+namespace {
+
+// Minimal libcurl C ABI surface, resolved at runtime. The option values
+// are part of curl's stable public ABI (curl/curl.h).
+typedef void CURL;
+struct curl_slist;
+
+constexpr int kCurloptUrl = 10002;
+constexpr int kCurloptHttpHeader = 10023;
+constexpr int kCurloptPostFields = 10015;
+constexpr int kCurloptWriteFunction = 20011;
+constexpr int kCurloptWriteData = 10001;
+constexpr int kCurloptTimeout = 13;
+constexpr int kCurloptNoSignal = 99;
+constexpr int kCurloptPost = 47;
+constexpr int kCurlinfoResponseCode = 0x200002;
+
+constexpr long kCurlGlobalAll = 3;
+
+struct CurlApi {
+  CURL* (*easy_init)() = nullptr;
+  int (*easy_setopt)(CURL*, int, ...) = nullptr;
+  int (*easy_perform)(CURL*) = nullptr;
+  void (*easy_cleanup)(CURL*) = nullptr;
+  int (*easy_getinfo)(CURL*, int, ...) = nullptr;
+  curl_slist* (*slist_append)(curl_slist*, const char*) = nullptr;
+  void (*slist_free_all)(curl_slist*) = nullptr;
+  int (*global_init)(long) = nullptr;
+
+  bool ok() const {
+    return easy_init && easy_setopt && easy_perform && easy_cleanup &&
+           easy_getinfo && slist_append && slist_free_all;
+  }
+};
+
+const CurlApi* GetCurl() {
+  static CurlApi* api = [] {
+    const char* names[] = {"libcurl.so.4", "libcurl-gnutls.so.4",
+                           "libcurl.so"};
+    void* handle = nullptr;
+    for (const char* name : names) {
+      handle = dlopen(name, RTLD_NOW | RTLD_LOCAL);
+      if (handle != nullptr) break;
+    }
+    if (handle == nullptr) return static_cast<CurlApi*>(nullptr);
+    auto* out = new CurlApi();
+    out->easy_init = reinterpret_cast<CURL* (*)()>(
+        dlsym(handle, "curl_easy_init"));
+    out->easy_setopt = reinterpret_cast<int (*)(CURL*, int, ...)>(
+        dlsym(handle, "curl_easy_setopt"));
+    out->easy_perform = reinterpret_cast<int (*)(CURL*)>(
+        dlsym(handle, "curl_easy_perform"));
+    out->easy_cleanup = reinterpret_cast<void (*)(CURL*)>(
+        dlsym(handle, "curl_easy_cleanup"));
+    out->easy_getinfo = reinterpret_cast<int (*)(CURL*, int, ...)>(
+        dlsym(handle, "curl_easy_getinfo"));
+    out->slist_append = reinterpret_cast<curl_slist* (*)(
+        curl_slist*, const char*)>(dlsym(handle, "curl_slist_append"));
+    out->slist_free_all = reinterpret_cast<void (*)(curl_slist*)>(
+        dlsym(handle, "curl_slist_free_all"));
+    out->global_init = reinterpret_cast<int (*)(long)>(
+        dlsym(handle, "curl_global_init"));
+    if (!out->ok()) {
+      delete out;
+      return static_cast<CurlApi*>(nullptr);
+    }
+    // Implicit global init from curl_easy_init is not thread-safe;
+    // the exporter thread and a main-thread flush() can race first
+    // use. Init once here, under this static's own init lock.
+    if (out->global_init != nullptr) out->global_init(kCurlGlobalAll);
+    return out;
+  }();
+  return api;
+}
+
+size_t AppendToString(char* data, size_t size, size_t nmemb,
+                      void* userdata) {
+  static_cast<std::string*>(userdata)->append(data, size * nmemb);
+  return size * nmemb;
+}
+
+// One bounded HTTP round trip. GET when body is nullptr.
+bool Perform(const std::string& url, const std::string* body,
+             curl_slist* headers, std::string* response) {
+  const CurlApi* curl = GetCurl();
+  if (curl == nullptr) return false;
+  CURL* handle = curl->easy_init();
+  if (handle == nullptr) return false;
+  curl->easy_setopt(handle, kCurloptUrl, url.c_str());
+  curl->easy_setopt(handle, kCurloptNoSignal, 1L);
+  curl->easy_setopt(handle, kCurloptTimeout, 15L);
+  if (body != nullptr) {
+    curl->easy_setopt(handle, kCurloptPost, 1L);
+    curl->easy_setopt(handle, kCurloptPostFields, body->c_str());
+  }
+  if (headers != nullptr) {
+    curl->easy_setopt(handle, kCurloptHttpHeader, headers);
+  }
+  curl->easy_setopt(handle, kCurloptWriteFunction, AppendToString);
+  curl->easy_setopt(handle, kCurloptWriteData,
+                    static_cast<void*>(response));
+  int rc = curl->easy_perform(handle);
+  long status = 0;
+  if (rc == 0) curl->easy_getinfo(handle, kCurlinfoResponseCode, &status);
+  curl->easy_cleanup(handle);
+  return rc == 0 && status >= 200 && status < 300;
+}
+
+// Crude but dependency-free: pull "access_token":"..." out of the
+// metadata server's JSON reply.
+std::string ParseAccessToken(const std::string& json) {
+  const std::string key = "\"access_token\"";
+  size_t pos = json.find(key);
+  if (pos == std::string::npos) return "";
+  pos = json.find('"', json.find(':', pos + key.size()));
+  if (pos == std::string::npos) return "";
+  size_t end = json.find('"', pos + 1);
+  if (end == std::string::npos) return "";
+  return json.substr(pos + 1, end - pos - 1);
+}
+
+std::mutex g_token_mu;
+std::string g_cached_token;
+std::chrono::steady_clock::time_point g_token_expiry;
+
+std::string AccessToken() {
+  // Explicit token beats the metadata server (tests, off-GCP runs).
+  const char* env_token = std::getenv("CLOUD_TPU_MONITORING_TOKEN");
+  if (env_token != nullptr && env_token[0] != '\0') return env_token;
+
+  std::lock_guard<std::mutex> lock(g_token_mu);
+  auto now = std::chrono::steady_clock::now();
+  // May be empty: failures are negatively cached so an off-GCP host
+  // doesn't block every export tick on a metadata round trip.
+  if (now < g_token_expiry) return g_cached_token;
+  // Default-credentials path on GCE/TPU-VM (the REST analogue of the
+  // reference's GoogleDefaultCredentials, stackdriver_client.cc:56-58).
+  const CurlApi* curl = GetCurl();
+  if (curl == nullptr) return "";
+  curl_slist* headers =
+      curl->slist_append(nullptr, "Metadata-Flavor: Google");
+  std::string response;
+  bool ok = Perform(
+      "http://metadata.google.internal/computeMetadata/v1/instance/"
+      "service-accounts/default/token",
+      nullptr, headers, &response);
+  curl->slist_free_all(headers);
+  if (!ok) {
+    g_cached_token.clear();
+    g_token_expiry = now + std::chrono::seconds(30);
+    return "";
+  }
+  g_cached_token = ParseAccessToken(response);
+  // Tokens last ~1h; refresh well before that.
+  g_token_expiry = now + std::chrono::minutes(5);
+  return g_cached_token;
+}
+
+}  // namespace
+
+// The request builders synthesize gRPC-shaped wrappers
+// ({"name":"projects/p","metricDescriptor"/"timeSeries":...}) — the
+// canonical form the golden tests and FileTransport record. The REST
+// bindings put the project in the URL instead: metricDescriptors.create
+// takes the bare MetricDescriptor as its body, timeSeries.create takes
+// {"timeSeries":[...]}. Re-shape here (the wrappers are our own output,
+// so positional extraction is safe — no JSON parser needed).
+std::string RestBody(const std::string& method, const std::string& json) {
+  if (method == "CreateMetricDescriptor") {
+    const std::string key = "\"metricDescriptor\":";
+    size_t pos = json.find(key);
+    if (pos != std::string::npos && !json.empty() &&
+        json.back() == '}') {
+      size_t start = pos + key.size();
+      return json.substr(start, json.size() - start - 1);
+    }
+  } else {
+    const std::string key = "\"timeSeries\":";
+    size_t pos = json.find(key);
+    if (pos != std::string::npos) {
+      return "{" + json.substr(pos);
+    }
+  }
+  return json;
+}
+
+bool HttpTransportAvailable() { return GetCurl() != nullptr; }
+
+bool HttpSend(const std::string& endpoint, const std::string& project_id,
+              const std::string& method, const std::string& json) {
+  const CurlApi* curl = GetCurl();
+  if (curl == nullptr) {
+    static bool warned = [] {
+      std::fprintf(stderr,
+                   "cloud_tpu_monitoring: http transport requested but "
+                   "libcurl is not loadable; dropping metrics.\n");
+      return true;
+    }();
+    (void)warned;
+    return false;
+  }
+  std::string path = (method == "CreateMetricDescriptor")
+                         ? "/metricDescriptors"
+                         : "/timeSeries";
+  std::string url =
+      endpoint + "/v3/projects/" + project_id + path;
+  curl_slist* headers =
+      curl->slist_append(nullptr, "Content-Type: application/json");
+  std::string token = AccessToken();
+  if (!token.empty()) {
+    headers = curl->slist_append(
+        headers, ("Authorization: Bearer " + token).c_str());
+  }
+  std::string body = RestBody(method, json);
+  std::string response;
+  bool ok = Perform(url, &body, headers, &response);
+  curl->slist_free_all(headers);
+  if (!ok) {
+    std::fprintf(stderr,
+                 "cloud_tpu_monitoring: %s POST to %s failed%s%s\n",
+                 method.c_str(), url.c_str(),
+                 response.empty() ? "" : ": ", response.c_str());
+  }
+  return ok;
+}
+
+}  // namespace monitoring
+}  // namespace cloud_tpu

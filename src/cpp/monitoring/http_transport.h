@@ -1,0 +1,41 @@
+// Production network transport: HTTPS POST to the Cloud Monitoring REST
+// API via libcurl (loaded at runtime with dlopen, so the library builds
+// and tests without curl development headers installed).
+//
+// Reference parity: src/cpp/monitoring/stackdriver_client.cc:45-61 — the
+// reference opens a gRPC channel to monitoring.googleapis.com with
+// GoogleDefaultCredentials. The equivalent here speaks the same API's
+// canonical JSON/REST surface:
+//   POST {endpoint}/v3/projects/{project}/timeSeries
+//   POST {endpoint}/v3/projects/{project}/metricDescriptors
+// with a Bearer token from CLOUD_TPU_MONITORING_TOKEN or (the on-GCP
+// default-credentials path) the GCE/TPU-VM metadata server.
+
+#ifndef CLOUD_TPU_MONITORING_HTTP_TRANSPORT_H_
+#define CLOUD_TPU_MONITORING_HTTP_TRANSPORT_H_
+
+#include <string>
+
+#include "stackdriver_client.h"
+
+namespace cloud_tpu {
+namespace monitoring {
+
+// True when libcurl could be loaded on this host.
+bool HttpTransportAvailable();
+
+// Sends one request; returns true on HTTP 2xx. `endpoint` has no
+// trailing slash (default "https://monitoring.googleapis.com"). The
+// Bearer token comes from CLOUD_TPU_MONITORING_TOKEN when set, else
+// from the metadata server (cached; failures negatively cached).
+bool HttpSend(const std::string& endpoint, const std::string& project_id,
+              const std::string& method, const std::string& json);
+
+// Re-shapes a builder wrapper into the REST request body (bare
+// MetricDescriptor / {"timeSeries":[...]}). Exposed for tests.
+std::string RestBody(const std::string& method, const std::string& json);
+
+}  // namespace monitoring
+}  // namespace cloud_tpu
+
+#endif  // CLOUD_TPU_MONITORING_HTTP_TRANSPORT_H_

@@ -11,6 +11,7 @@
 
 #include "config.h"
 #include "exporter.h"
+#include "http_transport.h"
 #include "metrics_registry.h"
 #include "stackdriver_client.h"
 
@@ -160,6 +161,77 @@ void TestPeriodicGate() {
   Config::ResetForTesting();
 }
 
+std::vector<std::pair<std::string, std::string>>* g_callback_sent =
+    nullptr;
+
+int CapturingCallback(const char* method, const char* json) {
+  g_callback_sent->emplace_back(method, json);
+  return 1;
+}
+
+void TestTransportDispatch() {
+  using cloud_tpu::monitoring::DispatchTransport;
+  using cloud_tpu::monitoring::SetTransportCallback;
+
+  // A registered callback wins over env selection.
+  std::vector<std::pair<std::string, std::string>> sent;
+  g_callback_sent = &sent;
+  SetTransportCallback(&CapturingCallback);
+  auto transport = DispatchTransport();
+  assert(transport("CreateTimeSeries", "{\"k\":1}"));
+  assert(sent.size() == 1);
+  assert(sent[0].first == "CreateTimeSeries");
+  assert(sent[0].second == "{\"k\":1}");
+
+  // Clearing it restores the env-selected (file) transport.
+  SetTransportCallback(nullptr);
+  Config::ResetForTesting();
+  const char* path = "/tmp/cloud_tpu_monitoring_dispatch_test.jsonl";
+  std::remove(path);
+  setenv(cloud_tpu::monitoring::kExportPathEnvVar, path, 1);
+  unsetenv(cloud_tpu::monitoring::kTransportEnvVar);
+  assert(transport("CreateTimeSeries", "{\"k\":2}"));
+  std::FILE* f = std::fopen(path, "r");
+  assert(f != nullptr);
+  char buf[256] = {0};
+  assert(std::fgets(buf, sizeof(buf), f) != nullptr);
+  std::fclose(f);
+  CHECK_CONTAINS(std::string(buf), "\"k\":2");
+  std::remove(path);
+  unsetenv(cloud_tpu::monitoring::kExportPathEnvVar);
+  Config::ResetForTesting();
+}
+
+void TestRestBodyShapes() {
+  using cloud_tpu::monitoring::RestBody;
+  // metricDescriptors.create takes the bare MetricDescriptor; the
+  // project rides in the URL.
+  std::string descriptor_wrapper =
+      "{\"name\":\"projects/p\",\"metricDescriptor\":{\"type\":\"t\","
+      "\"metricKind\":\"CUMULATIVE\"}}";
+  assert(RestBody("CreateMetricDescriptor", descriptor_wrapper) ==
+         "{\"type\":\"t\",\"metricKind\":\"CUMULATIVE\"}");
+  // timeSeries.create takes {"timeSeries": [...]}.
+  std::string series_wrapper =
+      "{\"name\":\"projects/p\",\"timeSeries\":[{\"metric\":1}]}";
+  assert(RestBody("CreateTimeSeries", series_wrapper) ==
+         "{\"timeSeries\":[{\"metric\":1}]}");
+}
+
+void TestHttpSendFailsFastWhenUnreachable() {
+  if (!cloud_tpu::monitoring::HttpTransportAvailable()) {
+    std::printf("(libcurl not loadable; http transport test skipped)\n");
+    return;
+  }
+  // Explicit token: keeps the test off the metadata-server path.
+  setenv("CLOUD_TPU_MONITORING_TOKEN", "test-token", 1);
+  // Port 9 (discard) refuses connections: a clean false, no crash/hang.
+  bool ok = cloud_tpu::monitoring::HttpSend(
+      "http://127.0.0.1:9", "proj", "CreateTimeSeries", "{}");
+  assert(!ok);
+  unsetenv("CLOUD_TPU_MONITORING_TOKEN");
+}
+
 }  // namespace
 
 int main() {
@@ -169,6 +241,9 @@ int main() {
   TestWhitelistAndGate();
   TestExporterFiltersAndDedups();
   TestPeriodicGate();
+  TestTransportDispatch();
+  TestRestBodyShapes();
+  TestHttpSendFailsFastWhenUnreachable();
   std::printf("ALL MONITORING TESTS PASSED\n");
   return 0;
 }

@@ -1,9 +1,11 @@
 #include "stackdriver_client.h"
 
+#include <atomic>
 #include <cstdio>
 #include <sstream>
 
 #include "config.h"
+#include "http_transport.h"
 
 namespace cloud_tpu {
 namespace monitoring {
@@ -151,11 +153,44 @@ StackdriverClient::StackdriverClient(std::string project_id,
     : project_id_(std::move(project_id)),
       transport_(std::move(transport)) {}
 
+namespace {
+
+// Host-process override: a C-ABI callback registered through the C API
+// (cloud_tpu_set_transport). Lets an embedding Python process send with
+// its own authenticated client while the C++ exporter keeps owning
+// collection, filtering, and request synthesis.
+std::atomic<TransportCallback> g_transport_callback{nullptr};
+
+}  // namespace
+
+void SetTransportCallback(TransportCallback callback) {
+  g_transport_callback.store(callback);
+}
+
+Transport DispatchTransport() {
+  // Resolved per send (not per process): respects a callback registered
+  // after startup and Config::ResetForTesting re-reads of the env.
+  return [](const std::string& method, const std::string& json) {
+    TransportCallback callback = g_transport_callback.load();
+    if (callback != nullptr) {
+      return callback(method.c_str(), json.c_str()) != 0;
+    }
+    const Config* config = Config::Get();
+    if (config->transport() == "http") {
+      // Real Cloud Monitoring REST sends (the reference's gRPC channel
+      // equivalent, stackdriver_client.cc:45-61).
+      return HttpSend(config->endpoint(), config->project_id(), method,
+                      json);
+    }
+    return FileTransport(config->export_path())(method, json);
+  };
+}
+
 StackdriverClient* StackdriverClient::Get() {
   static StackdriverClient* client = [] {
     const Config* config = Config::Get();
     return new StackdriverClient(config->project_id(),
-                                 FileTransport(config->export_path()));
+                                 DispatchTransport());
   }();
   return client;
 }

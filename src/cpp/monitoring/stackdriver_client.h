@@ -30,6 +30,17 @@ namespace monitoring {
 using Transport =
     std::function<bool(const std::string& method, const std::string& json)>;
 
+// C-ABI transport override (registered via the C API so an embedding
+// process — e.g. Python with an authenticated google client — does the
+// send). Non-zero return = success.
+using TransportCallback = int (*)(const char* method, const char* json);
+void SetTransportCallback(TransportCallback callback);
+
+// The default transport used by the singleton: a registered callback
+// wins; else CLOUD_TPU_MONITORING_TRANSPORT=http selects the libcurl
+// REST sender; else FileTransport (tests/offline).
+Transport DispatchTransport();
+
 class StackdriverClient {
  public:
   // Singleton wired to the env-configured project and the default

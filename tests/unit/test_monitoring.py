@@ -79,6 +79,139 @@ class TestRegistryBinding:
         assert monitoring.start_exporter() is False
 
 
+class TestTransports:
+
+    @pytest.mark.skipif(not NATIVE, reason="native library not built")
+    def test_python_callback_transport_receives_sends(self):
+        """The production Python path: a registered callback (standing in
+        for an authenticated google client) receives the wire-correct
+        requests the C++ exporter synthesizes."""
+        received = []
+        assert native.set_transport(
+            lambda method, payload: received.append(
+                (method, json.loads(payload))) or True)
+        try:
+            native.counter_increment("/cloud_tpu/training/steps", 4)
+            native.flush()
+        finally:
+            native.set_transport(None)
+        methods = [m for m, _ in received]
+        assert "CreateTimeSeries" in methods
+        series_body = dict(received)[("CreateTimeSeries")]
+        series = series_body["timeSeries"][0]
+        assert series["metric"]["type"] == \
+            "custom.googleapis.com/cloud_tpu/training/steps"
+        assert series["points"][0]["value"]["int64Value"] == 4
+
+    @pytest.mark.skipif(not NATIVE, reason="native library not built")
+    def test_http_transport_real_send_to_local_server(self, monkeypatch):
+        """End-to-end network send: the libcurl REST transport POSTs to
+        a live (localhost) HTTP server with auth + JSON body — the
+        production code path that actually sends, minus only TLS and
+        the real endpoint."""
+        if not native.http_transport_available():
+            pytest.skip("libcurl not loadable on this host")
+        import http.server
+        import threading
+
+        received = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                received.append({
+                    "path": self.path,
+                    "auth": self.headers.get("Authorization"),
+                    "content_type": self.headers.get("Content-Type"),
+                    "body": json.loads(self.rfile.read(length)),
+                })
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *args):
+                pass
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                 Handler)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever,
+                                  daemon=True)
+        thread.start()
+        try:
+            # Fresh process: transport/endpoint env is read at library
+            # config init, exactly as deployment would.
+            code = (
+                "from cloud_tpu.monitoring import native\n"
+                "native.counter_increment("
+                "'/cloud_tpu/training/steps', 7)\n"
+                "native.flush()\n")
+            env = dict(
+                os.environ,
+                PYTHONPATH=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))),
+                CLOUD_TPU_MONITORING_TRANSPORT="http",
+                CLOUD_TPU_MONITORING_ENDPOINT="http://127.0.0.1:{}"
+                .format(port),
+                CLOUD_TPU_MONITORING_PROJECT_ID="test-proj",
+                CLOUD_TPU_MONITORING_TOKEN="test-token",
+            )
+            result = subprocess.run(["python", "-c", code],
+                                    capture_output=True, text=True,
+                                    env=env, timeout=120)
+            assert result.returncode == 0, result.stderr
+        finally:
+            server.shutdown()
+            thread.join()
+
+        paths = [r["path"] for r in received]
+        assert "/v3/projects/test-proj/metricDescriptors" in paths
+        assert "/v3/projects/test-proj/timeSeries" in paths
+        for r in received:
+            assert r["auth"] == "Bearer test-token"
+            assert r["content_type"] == "application/json"
+        series_req = next(r for r in received
+                          if r["path"].endswith("timeSeries"))
+        # REST shapes: no "name" in the body (project is in the URL);
+        # descriptor body is the bare MetricDescriptor.
+        assert "name" not in series_req["body"]
+        point = series_req["body"]["timeSeries"][0]["points"][0]
+        assert point["value"]["int64Value"] == 7
+        descriptor_req = next(r for r in received
+                              if r["path"].endswith("metricDescriptors"))
+        assert descriptor_req["body"]["type"] == \
+            "custom.googleapis.com/cloud_tpu/training/steps"
+
+    def test_google_auth_transport_posts_via_session(self):
+        """The Python authed-client sender: wire-correct URL + body."""
+        from unittest import mock
+
+        session = mock.MagicMock()
+        session.post.return_value.status_code = 200
+        send = native.google_auth_transport(session=session)
+
+        body = {"name": "projects/p",
+                "timeSeries": [{"metric": {"type": "t"}}]}
+        assert send("CreateTimeSeries", json.dumps(body))
+        url = session.post.call_args.args[0]
+        assert url == "https://monitoring.googleapis.com/v3/projects/p/" \
+                      "timeSeries"
+        # REST body: project in the URL only, series under "timeSeries".
+        assert session.post.call_args.kwargs["json"] == {
+            "timeSeries": [{"metric": {"type": "t"}}]}
+
+        assert send("CreateMetricDescriptor", json.dumps(
+            {"name": "projects/p", "metricDescriptor": {"type": "t"}}))
+        url = session.post.call_args.args[0]
+        assert url.endswith("/v3/projects/p/metricDescriptors")
+        # REST body: the bare MetricDescriptor.
+        assert session.post.call_args.kwargs["json"] == {"type": "t"}
+
+        session.post.return_value.status_code = 403
+        assert not send("CreateTimeSeries", json.dumps(body))
+
+
 class TestTrainingIntegration:
 
     def test_fit_emits_runtime_metrics(self):
