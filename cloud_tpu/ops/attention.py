@@ -46,6 +46,8 @@ class _Config(NamedTuple):
     block_q: int
     block_k: int
     kv_len: int  # true (unpadded) sequence length
+    heads: int   # folded into the grid's leading batch*heads dim
+    has_mask: bool  # per-example key mask streamed as [B, S_pad] blocks
     interpret: bool
 
 
@@ -75,11 +77,28 @@ def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, config, num_k):
+def _block_mask(config, qi, ki, mask_ref):
+    """Combined validity mask for one (block_q, block_k) tile: global
+    kv padding, causal structure, and (when present) the per-example
+    key mask block."""
+    block_q, block_k = config.block_q, config.block_k
+    col = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = col < config.kv_len
+    if config.causal:
+        row = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = mask & (col <= row)
+    if mask_ref is not None:
+        valid = mask_ref[...] != 0  # [1, block_k]
+        mask = mask & jnp.broadcast_to(valid, (block_q, block_k))
+    return mask
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, config, num_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
-    block_q, block_k = config.block_q, config.block_k
 
     @pl.when(ki == 0)
     def _init():
@@ -94,13 +113,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * config.sm_scale
-        col = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = col < config.kv_len
-        if config.causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            mask = mask & (col <= row)
+        mask = _block_mask(config, qi, ki, mask_ref)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]
@@ -108,7 +121,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_curr = jnp.max(s, axis=-1, keepdims=True)
         m_next = jnp.maximum(m_prev, m_curr)
         alpha = jnp.exp(m_prev - m_next)
-        p = jnp.exp(s - m_next)
+        # Explicit zero where masked: exp(s - m) underflows to 0 for
+        # normal rows, but a fully-masked row has m == s == -inf and
+        # exp(0) == 1 would leak mass (such rows output 0 instead).
+        p = jnp.where(mask, jnp.exp(s - m_next), 0.0)
         l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -118,7 +134,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     if config.causal:
         # Blocks strictly above the diagonal contribute nothing: skip.
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        @pl.when(ki * config.block_k <= qi * config.block_q
+                 + config.block_q - 1)
         def _masked_step():
             _step()
     else:
@@ -133,24 +150,52 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
 
 
-def _flash_forward(config, q, k, v):
-    """q/k/v: [BH, S_pad, D] -> (out [BH, S_pad, D], lse [BH, S_pad, 128])."""
+def _mask_spec(config, transposed=False):
+    """BlockSpec for the [B, S_pad] key-mask: one (1, block_k) strip per
+    k-block, indexed by the example this (batch*head) program serves."""
+    heads = config.heads
+    if transposed:  # dk/dv grid order: (b, j, i)
+        return pl.BlockSpec((1, config.block_k),
+                            lambda b, j, i: (b // heads, j))
+    return pl.BlockSpec((1, config.block_k),
+                        lambda b, i, j: (b // heads, j))
+
+
+def _maybe_mask(config, kernel):
+    """Adapts a mask-taking kernel body to the unmasked arg list."""
+    if config.has_mask:
+        return kernel
+
+    def adapted(q_ref, k_ref, v_ref, *rest):
+        return kernel(q_ref, k_ref, v_ref, None, *rest)
+    return adapted
+
+
+def _flash_forward(config, q, k, v, kmask):
+    """q/k/v: [BH, S_pad, D]; kmask: [B, S_pad] int32 or None ->
+    (out [BH, S_pad, D], lse [BH, S_pad, 128])."""
     bh, seq, head_dim = q.shape
     num_q = seq // config.block_q
     num_k = seq // config.block_k
     grid = (bh, num_q, num_k)
-    kernel = functools.partial(_fwd_kernel, config=config, num_k=num_k)
+    kernel = _maybe_mask(
+        config, functools.partial(_fwd_kernel, config=config, num_k=num_k))
+    in_specs = [
+        pl.BlockSpec((1, config.block_q, head_dim),
+                     lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, config.block_k, head_dim),
+                     lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, config.block_k, head_dim),
+                     lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [q, k, v]
+    if config.has_mask:
+        in_specs.append(_mask_spec(config))
+        inputs.append(kmask)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, config.block_q, head_dim),
-                         lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, config.block_k, head_dim),
-                         lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, config.block_k, head_dim),
-                         lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, config.block_q, head_dim),
                          lambda b, i, j: (b, i, 0)),
@@ -167,7 +212,7 @@ def _flash_forward(config, q, k, v):
             pltpu.VMEM((config.block_q, _LANES), jnp.float32),
         ],
         interpret=config.interpret,
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -176,25 +221,20 @@ def _flash_forward(config, q, k, v):
 # ---------------------------------------------------------------------------
 
 
-def _attn_probs(config, qi, ki, q, k, lse_col):
+def _attn_probs(config, qi, ki, q, k, lse_col, mask_ref):
     """Recomputes the (block_q, block_k) probability block."""
-    block_q, block_k = config.block_q, config.block_k
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * config.sm_scale
-    col = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    mask = col < config.kv_len
-    if config.causal:
-        row = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        mask = mask & (col <= row)
-    s = jnp.where(mask, s, _NEG_INF)
-    return jnp.exp(s - lse_col)
+    mask = _block_mask(config, qi, ki, mask_ref)
+    # Explicit zero (not just -inf logits): a fully-masked row carries
+    # lse == -inf and exp(-inf - -inf) == 1 would fabricate mass.
+    return jnp.where(mask, jnp.exp(jnp.where(mask, s, _NEG_INF) - lse_col),
+                     0.0)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, config, num_k):
+def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, config, num_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -207,7 +247,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        p = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1])
+        p = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1], mask_ref)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -229,7 +269,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, dk_acc, dv_acc, *, config, num_q):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -244,7 +284,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        p = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1])
+        p = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1], mask_ref)
         # dV += P^T dO   (contract over the q rows)
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -272,7 +312,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(config, q, k, v, out, lse, g):
+def _flash_backward(config, q, k, v, kmask, out, lse, g):
     bh, seq, head_dim = q.shape
     num_q = seq // config.block_q
     num_k = seq // config.block_k
@@ -287,16 +327,23 @@ def _flash_backward(config, q, k, v, out, lse, g):
     k_spec = pl.BlockSpec((1, config.block_k, head_dim),
                           lambda b, i, j: (b, j, 0))
 
+    in_specs = [q_spec, k_spec, k_spec]
+    inputs = [q, k, v]
+    if config.has_mask:
+        in_specs.append(_mask_spec(config))
+        inputs.append(kmask)
+
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, config=config, num_k=num_k),
+        _maybe_mask(config, functools.partial(
+            _dq_kernel, config=config, num_k=num_k)),
         grid=(bh, num_q, num_k),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs + [q_spec, row_spec, row_spec],
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
         scratch_shapes=[
             pltpu.VMEM((config.block_q, head_dim), jnp.float32)],
         interpret=config.interpret,
-    )(q, k, v, g, lse, delta)[0]
+    )(*inputs, g, lse, delta)[0]
 
     # dk/dv: k-blocks are the outer (parallel) dim, q-blocks innermost.
     qT_spec = pl.BlockSpec((1, config.block_q, head_dim),
@@ -305,10 +352,14 @@ def _flash_backward(config, q, k, v, out, lse, g):
                              lambda b, j, i: (b, i, 0))
     kT_spec = pl.BlockSpec((1, config.block_k, head_dim),
                            lambda b, j, i: (b, j, 0))
+    inT_specs = [qT_spec, kT_spec, kT_spec]
+    if config.has_mask:
+        inT_specs.append(_mask_spec(config, transposed=True))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkdv_kernel, config=config, num_q=num_q),
+        _maybe_mask(config, functools.partial(
+            _dkdv_kernel, config=config, num_q=num_q)),
         grid=(bh, num_k, num_q),
-        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
+        in_specs=inT_specs + [qT_spec, rowT_spec, rowT_spec],
         out_specs=[kT_spec, kT_spec],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -319,27 +370,51 @@ def _flash_backward(config, q, k, v, out, lse, g):
             pltpu.VMEM((config.block_k, head_dim), jnp.float32),
         ],
         interpret=config.interpret,
-    )(q, k, v, g, lse, delta)
+    )(*inputs, g, lse, delta)
     return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _flash_attention(config, q, k, v):
-    out, _ = _flash_forward(config, q, k, v)
+    out, _ = _flash_forward(config, q, k, v, None)
     return out
 
 
 def _flash_attention_fwd(config, q, k, v):
-    out, lse = _flash_forward(config, q, k, v)
+    out, lse = _flash_forward(config, q, k, v, None)
     return out, (q, k, v, out, lse)
 
 
 def _flash_attention_bwd(config, residuals, g):
     q, k, v, out, lse = residuals
-    return _flash_backward(config, q, k, v, out, lse, g)
+    return _flash_backward(config, q, k, v, None, out, lse, g)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_attention_masked(config, q, k, v, kmask):
+    out, _ = _flash_forward(config, q, k, v, kmask)
+    return out
+
+
+def _flash_attention_masked_fwd(config, q, k, v, kmask):
+    out, lse = _flash_forward(config, q, k, v, kmask)
+    return out, (q, k, v, kmask, out, lse)
+
+
+def _flash_attention_masked_bwd(config, residuals, g):
+    import numpy as np
+
+    q, k, v, kmask, out, lse = residuals
+    dq, dk, dv = _flash_backward(config, q, k, v, kmask, out, lse, g)
+    # Integer mask: the cotangent is the symbolic zero, float0.
+    return dq, dk, dv, np.zeros(kmask.shape, jax.dtypes.float0)
+
+
+_flash_attention_masked.defvjp(_flash_attention_masked_fwd,
+                               _flash_attention_masked_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -347,7 +422,7 @@ _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 # ---------------------------------------------------------------------------
 
 
-def flash_attention(q, k, v, causal=True, sm_scale=None,
+def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
                     block_q=128, block_k=128,
                     interpret: Optional[bool] = None):
     """Blockwise flash attention, layout [batch, seq, heads, head_dim].
@@ -357,6 +432,12 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
             the MXU, output in the input dtype).
         causal: Apply a causal (autoregressive) mask.
         sm_scale: Softmax temperature; default 1/sqrt(D).
+        mask: Optional [B, S] boolean key mask (True = attend). The
+            padded-batch fast path: masked keys are excluded inside the
+            kernel, so Keras-parity workloads with per-example padding
+            never leave the flash path. Any pattern is supported, not
+            just contiguous prefixes. Rows whose keys are ALL masked
+            output zeros (the reference would return a uniform average).
         block_q / block_k: Kernel tile sizes along the sequence. S is
             padded up to a multiple internally.
         interpret: Force Pallas interpret mode. Default: interpret
@@ -382,6 +463,7 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
 
     config = _Config(causal=bool(causal), sm_scale=float(sm_scale),
                      block_q=block_q, block_k=block_k, kv_len=seq,
+                     heads=heads, has_mask=mask is not None,
                      interpret=bool(interpret))
 
     def fold(x):
@@ -391,7 +473,18 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
             x = jnp.pad(x, ((0, 0), (0, seq_pad - seq), (0, 0)))
         return x
 
-    out = _flash_attention(config, fold(q), fold(k), fold(v))
+    if mask is None:
+        out = _flash_attention(config, fold(q), fold(k), fold(v))
+    else:
+        if mask.shape != (batch, seq):
+            raise ValueError(
+                "mask must be [batch, seq] = {}; got {}.".format(
+                    (batch, seq), mask.shape))
+        kmask = mask.astype(jnp.int32)
+        if seq_pad != seq:
+            kmask = jnp.pad(kmask, ((0, 0), (0, seq_pad - seq)))
+        out = _flash_attention_masked(config, fold(q), fold(k), fold(v),
+                                      kmask)
     out = out[:, :seq].reshape(batch, heads, seq, head_dim)
     return jnp.transpose(out, (0, 2, 1, 3))
 
@@ -399,21 +492,20 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
 def attention(q, k, v, causal=True, sm_scale=None, mask=None, impl="auto"):
     """Dispatching attention: pallas flash kernel or jnp reference.
 
-    impl: "auto" picks the flash kernel on TPU for mask-free shapes,
-    the jnp reference elsewhere; "flash"/"reference" force a path.
+    impl: "auto" picks the flash kernel on TPU (with or without a key
+    mask — padded batches stay on the fast path), the jnp reference
+    elsewhere; "flash"/"reference" force a path.
     """
     if impl == "flash":
-        if mask is not None:
-            raise NotImplementedError(
-                "flash path does not take a padding mask; use "
-                "impl='reference'.")
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               mask=mask)
     if impl == "reference":
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              mask=mask)
     if impl != "auto":
         raise ValueError("Unknown attention impl: {!r}".format(impl))
-    if mask is None and jax.default_backend() == "tpu":
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if jax.default_backend() == "tpu":
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               mask=mask)
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                          mask=mask)
