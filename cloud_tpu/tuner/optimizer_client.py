@@ -12,7 +12,9 @@ googleapiclient service against the regional endpoint).
 
 import datetime
 import http
+import json
 import logging
+import os
 import time
 
 try:
@@ -207,18 +209,70 @@ class OptimizerClient:
                                                  self.region)
 
 
+#: Bundled subset of the Vizier REST surface (the reference ships a full
+#: pinned discovery document, tuner/constants.py:20-22 +
+#: optimizer_client.py:404-411; ours is hand-authored and covers exactly
+#: the methods OptimizerClient calls).
+PINNED_DISCOVERY_PATH = os.path.join(
+    os.path.dirname(__file__), "api", "vizier_v1_discovery.json")
+
+
+def _discovery_fallback_errors():
+    """Transport-shaped failures that justify the offline fallback.
+
+    Credential misconfiguration or client bugs must fail loudly at
+    build time instead of resurfacing mid-tuning-run, so only network
+    and HTTP errors trigger the pinned document.
+    """
+    errs = (OSError,)
+    if errors is not None:
+        errs = errs + (errors.HttpError,)
+    return errs
+
+
+def load_pinned_discovery_doc(endpoint):
+    """Loads the bundled discovery doc, pointed at a regional endpoint.
+
+    The document is endpoint-agnostic on disk; rootUrl/baseUrl are
+    patched here so one file serves every region.
+    """
+    with open(PINNED_DISCOVERY_PATH) as f:
+        doc = json.load(f)
+    root = endpoint.rstrip("/") + "/"
+    doc["rootUrl"] = root
+    doc["baseUrl"] = root
+    return doc
+
+
 def build_service_client(region):
     """Builds a googleapiclient service against the regional Vizier
-    endpoint (the reference ships a pinned discovery document,
-    optimizer_client.py:404-411; building from the live regional
-    endpoint avoids the stale-document problem)."""
+    endpoint.
+
+    Live discovery first (avoids the stale-document problem), falling
+    back to the bundled pinned document when discovery is unreachable —
+    air-gapped workers and flaky egress still get a working client, the
+    same guarantee the reference's bundled document provides
+    (tuner/constants.py:20-22). Set CLOUD_TPU_PINNED_DISCOVERY=1 to skip
+    the live attempt entirely.
+    """
     if discovery is None:
         raise RuntimeError(
             "google-api-python-client is required for the Vizier tuner.")
     endpoint = constants.OPTIMIZER_API_ENDPOINT.format(region=region)
-    return discovery.build(
-        "ml", "v1", cache_discovery=False,
-        discoveryServiceUrl="{}/$discovery/rest?version=v1".format(endpoint),
+    if os.environ.get("CLOUD_TPU_PINNED_DISCOVERY", "") != "1":
+        try:
+            return discovery.build(
+                "ml", "v1", cache_discovery=False,
+                discoveryServiceUrl=(
+                    "{}/$discovery/rest?version=v1".format(endpoint)),
+                requestBuilder=google_api_client.CloudTpuHttpRequest)
+        except _discovery_fallback_errors() as e:
+            logger.warning(
+                "Live Vizier discovery against %s failed (%s); "
+                "falling back to the pinned discovery document.",
+                endpoint, e)
+    return discovery.build_from_document(
+        load_pinned_discovery_doc(endpoint),
         requestBuilder=google_api_client.CloudTpuHttpRequest)
 
 

@@ -2,9 +2,10 @@
 
 Parity with the reference's packaging (reference src/python/setup.py:
 33-68): same single-package layout and dependency split, with the
-TPU-native stack in place of TF, and no bundled discovery JSON — the
-Vizier client builds its REST surface programmatically
-(cloud_tpu/tuner/optimizer_client.py)."""
+TPU-native stack in place of TF. Like the reference, a pinned Vizier
+discovery document ships inside the package (reference
+tuner/constants.py:20-22) as the offline fallback for
+cloud_tpu/tuner/optimizer_client.py:build_service_client."""
 
 import os
 
@@ -30,6 +31,7 @@ setup(
     long_description=open("README.md").read(),
     long_description_content_type="text/markdown",
     packages=find_packages(include=["cloud_tpu", "cloud_tpu.*"]),
+    package_data={"cloud_tpu.tuner": ["api/*.json"]},
     python_requires=">=3.9",
     install_requires=dependencies.make_required_install_packages(),
     extras_require=dependencies.make_required_extra_packages(),

@@ -384,3 +384,92 @@ class TestDistributingCloudTuner:
         trial = tuner.oracle.trials["1"]
         trainer = tuner.load_trainer(trial, x[:1])
         assert int(trainer.state.step) == 4  # 2 epochs x 2 steps
+
+
+class TestPinnedDiscovery:
+    """Offline fallback parity with the reference's bundled discovery
+    document (reference tuner/constants.py:20-22,
+    optimizer_client.py:404-411)."""
+
+    def _methods(self, doc):
+        """Flattens resource tree -> {'studies.create': method, ...}."""
+        flat = {}
+
+        def walk(resources, prefix):
+            for name, res in resources.items():
+                for mname, meth in res.get("methods", {}).items():
+                    flat[prefix + name + "." + mname] = meth
+                walk(res.get("resources", {}), prefix + name + ".")
+
+        walk(doc["resources"], "")
+        return flat
+
+    def test_doc_covers_every_client_method(self):
+        doc = optimizer_client.load_pinned_discovery_doc(
+            "https://us-central1-ml.googleapis.com")
+        flat = self._methods(doc)
+        base = "projects.locations.studies."
+        needed = [
+            base + m for m in ("create", "get", "list", "delete")
+        ] + [
+            base + "trials." + m
+            for m in ("suggest", "addMeasurement", "complete",
+                      "checkEarlyStoppingState", "stop", "get", "list")
+        ] + ["projects.locations.operations.get"]
+        for method in needed:
+            assert method in flat, method
+        # POST methods that the client passes a body to must declare a
+        # request schema (googleapiclient rejects unexpected `body`).
+        for m in ("suggest", "addMeasurement", "complete"):
+            meth = flat[base + "trials." + m]
+            assert meth["httpMethod"] == "POST"
+            assert "request" in meth
+        assert "create" in flat[base + "create"]["id"]
+        # Schemas referenced by methods must exist.
+        for meth in flat.values():
+            for key in ("request", "response"):
+                if key in meth:
+                    assert meth[key]["$ref"] in doc["schemas"]
+
+    def test_load_patches_regional_endpoint(self):
+        doc = optimizer_client.load_pinned_discovery_doc(
+            "https://europe-west4-ml.googleapis.com")
+        assert doc["rootUrl"] == "https://europe-west4-ml.googleapis.com/"
+        assert doc["baseUrl"] == doc["rootUrl"]
+
+    def test_build_falls_back_to_pinned_doc(self, monkeypatch):
+        captured = {}
+
+        class FakeDiscovery:
+            @staticmethod
+            def build(*a, **k):
+                captured["live_tried"] = True
+                raise OSError("no egress")
+
+            @staticmethod
+            def build_from_document(doc, requestBuilder=None):
+                captured["doc"] = doc
+                return "offline-service"
+
+        monkeypatch.setattr(optimizer_client, "discovery", FakeDiscovery)
+        monkeypatch.delenv("CLOUD_TPU_PINNED_DISCOVERY", raising=False)
+        svc = optimizer_client.build_service_client("us-central1")
+        assert svc == "offline-service"
+        assert captured["live_tried"]
+        assert captured["doc"]["rootUrl"] == (
+            "https://us-central1-ml.googleapis.com/")
+
+    def test_env_var_skips_live_discovery(self, monkeypatch):
+        class FakeDiscovery:
+            @staticmethod
+            def build(*a, **k):
+                raise AssertionError("live discovery must not be tried")
+
+            @staticmethod
+            def build_from_document(doc, requestBuilder=None):
+                return "offline-service"
+
+        monkeypatch.setattr(optimizer_client, "discovery", FakeDiscovery)
+        monkeypatch.setenv("CLOUD_TPU_PINNED_DISCOVERY", "1")
+        assert optimizer_client.build_service_client(
+            "us-central1") == "offline-service"
