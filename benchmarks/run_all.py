@@ -1,4 +1,4 @@
-"""All five BASELINE.md benchmark configs, one JSON line each.
+"""The BASELINE.md benchmark configs plus kernel benches, one JSON line each.
 
 The driver-facing single-metric harness stays at the repo root
 (`bench.py`, config 2 — the flagship). This suite covers the full
@@ -10,6 +10,8 @@ BASELINE.md table for local measurement:
    available devices; real pods use the same code over jax.distributed)
 4. Tuner trial loop (CloudTuner against an in-process oracle fake)
 5. Custom-training-loop (user-managed jit step, the CTL escape hatch)
+6. Pallas flash-attention kernel vs jnp reference (incl. masked path)
+7. Ring attention (sp-sharded) vs single-device reference
 
 Usage: python benchmarks/run_all.py [config_numbers...]
 """
@@ -25,17 +27,41 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
 
-def _bench_loop(step, state, batch, steps=20, warmup=3):
+def _sync(out):
+    """True barrier: fetch one output leaf's VALUE to host.
+
+    The tunneled TPU backend on this host acks block_until_ready()
+    before execution finishes, so only a device->host fetch is an honest
+    sync point (same rationale as bench.py's sync()).
+    """
     import jax
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0]))
+
+
+def _timed(fn, *args, reps=10):
+    """Median-free simple timing: jit, warm once, time `reps` calls
+    ending on one honest `_sync` barrier."""
+    import jax
+    f = jax.jit(fn)
+    out = f(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def _bench_loop(step, state, batch, steps=20, warmup=3):
     for _ in range(warmup):
         state, out = step(state, batch)
-    jax.block_until_ready(out)
+    _sync(out)
     chunks = []
     for _ in range(max(steps // 5, 1)):
         t0 = time.perf_counter()
         for _ in range(5):
             state, out = step(state, batch)
-        jax.block_until_ready(out)
+        _sync(out)
         chunks.append((time.perf_counter() - t0) / 5)
     return sorted(chunks)[len(chunks) // 2]
 
@@ -183,8 +209,89 @@ def config5_ctl():
             "value": round(1 / sec, 2), "unit": "steps/sec", "batch": B}
 
 
+def config6_flash_attention():
+    """Pallas flash kernel vs jnp reference wall-clock (VERDICT r1 §6:
+    a recorded TPU timing for the compiled kernel, incl. the masked
+    fast path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.ops import attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    # Interpret-mode pallas on CPU is orders of magnitude slower than
+    # compiled; keep CPU shapes tiny so the harness stays runnable
+    # everywhere while TPU measures the real operating point.
+    B, H, S, D = (8, 16, 2048, 64) if on_tpu else (1, 2, 256, 32)
+    rng = np.random.default_rng(0)
+    # Framework layout: [batch, seq, heads, head_dim].
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+               for _ in range(3))
+    # Padded batch: last quarter of the keys invalid for half the
+    # examples — exercises the per-example key-mask fast path.
+    mask = np.ones((B, S), np.int32)
+    mask[: B // 2, (3 * S) // 4:] = 0
+    mask = jnp.asarray(mask)
+
+    flash = _timed(lambda q, k, v: attention(q, k, v, causal=True,
+                                             impl="flash"), q, k, v)
+    ref = _timed(lambda q, k, v: attention(q, k, v, causal=True,
+                                           impl="reference"), q, k, v)
+    flash_masked = _timed(
+        lambda q, k, v, m: attention(q, k, v, causal=True, mask=m,
+                                     impl="flash"), q, k, v, mask)
+    return {"metric": "flash_attention_speedup_vs_reference",
+            "value": round(ref / flash, 2), "unit": "x",
+            "flash_ms": round(flash * 1e3, 2),
+            "flash_masked_ms": round(flash_masked * 1e3, 2),
+            "reference_ms": round(ref * 1e3, 2),
+            "shape": [B, H, S, D]}
+
+
+def config7_ring_attention():
+    """Ring attention (sequence parallelism over the sp axis) vs the
+    single-device reference on the same global shape — records the
+    memory-for-collectives trade VERDICT r1 flagged as unmeasured.
+
+    On the virtual CPU mesh the collectives are memcpys, so the
+    speedup column is only meaningful on real ICI; the recorded value
+    is primarily the wall-clock of the sp-sharded path itself.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.ops.attention import mha_reference
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.parallel.ring_attention import (
+        sequence_parallel_attention)
+
+    runtime.reset()
+    n = len(jax.devices())
+    sp = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    runtime.initialize(strategy="tpu_slice",
+                       axis_names=("dp", "sp"),
+                       mesh_shape=(n // sp, sp))
+    on_tpu = jax.default_backend() == "tpu"
+    B, H, S, D = (2, 8, 8192, 64) if on_tpu else (2, 4, 1024, 32)
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+               for _ in range(3))
+
+    ring = _timed(lambda q, k, v: sequence_parallel_attention(
+        q, k, v, causal=True), q, k, v)
+    # mha_reference takes the same [B, S, H, D] layout.
+    ref = _timed(lambda q, k, v: mha_reference(q, k, v, causal=True),
+                 q, k, v)
+    runtime.reset()
+    return {"metric": "ring_attention_sp%d_ms" % sp,
+            "value": round(ring * 1e3, 2), "unit": "ms",
+            "single_device_reference_ms": round(ref * 1e3, 2),
+            "shape": [B, H, S, D], "sp": sp}
+
+
 CONFIGS = {1: config1_mnist, 2: config2_resnet50, 3: config3_dp_pod_shape,
-           4: config4_tuner_loop, 5: config5_ctl}
+           4: config4_tuner_loop, 5: config5_ctl,
+           6: config6_flash_attention, 7: config7_ring_attention}
 
 
 def main(argv):
