@@ -101,6 +101,7 @@ def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
         "aux_loss_weight": trainer.aux_loss_weight,
         "gradient_accumulation_steps": trainer.gradient_accumulation_steps,
         "remat": trainer.remat,
+        "zero1": trainer.zero1,
     }
     storage.write_bytes(storage.join(remote_dir, SPEC_FILE),
                         pickle.dumps(spec))

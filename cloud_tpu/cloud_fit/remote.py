@@ -51,6 +51,7 @@ def build_trainer(spec, mesh=None):
         gradient_accumulation_steps=spec.get(
             "gradient_accumulation_steps", 1),
         remat=spec.get("remat", False),
+        zero1=spec.get("zero1", False),
     )
 
 

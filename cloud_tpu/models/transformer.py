@@ -13,6 +13,7 @@ makes TP/SP natural extension points). Design is MXU/ICI-first:
   sequence-parallel path for long context.
 """
 
+import functools
 from typing import Optional
 
 import flax.linen as nn
@@ -25,6 +26,8 @@ class CausalSelfAttention(nn.Module):
     num_heads: int
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"  # auto | flash | reference | ring
+    decode: bool = False  # autoregressive KV-cache mode
+    cache_len: int = 0  # cache size (tokens); set by TransformerLM
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -40,7 +43,13 @@ class CausalSelfAttention(nn.Module):
         k = dense((self.num_heads, head_dim), "key")(x)
         v = dense((self.num_heads, head_dim), "value")(x)
 
-        if self.attention_impl == "ring":
+        if self.decode:
+            if mask is not None:
+                raise NotImplementedError(
+                    "decode mode does not take a padding mask; left-pad "
+                    "prompts or decode per example.")
+            out = self._decode_attention(q, k, v)
+        elif self.attention_impl == "ring":
             # Sequence-parallel long-context path: the sequence dim is
             # sharded over the ambient mesh's "sp" axis and K/V rotate
             # around the ring (cloud_tpu/parallel/ring_attention.py).
@@ -59,6 +68,51 @@ class CausalSelfAttention(nn.Module):
         return nn.DenseGeneral(d_model, axis=(-2, -1),
                                dtype=self.compute_dtype, name="out")(out)
 
+    def _decode_attention(self, q, k, v):
+        """KV-cache attention: append this call's K/V to the cache, then
+        attend q against everything cached so far.
+
+        One code path serves both phases of generation: prefill (the
+        whole prompt in one call, cache index 0) and single-token decode
+        steps (S=1). The position mask `key <= query position` is the
+        causal mask within the incoming block and the "only attend to
+        the past" mask against the cache simultaneously. O(cache_len)
+        work per step — the standard autoregressive trade.
+        """
+        import jax.lax as lax
+
+        batch, seq, heads, head_dim = q.shape
+        if not self.cache_len:
+            raise ValueError("decode=True needs cache_len > 0.")
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (batch, self.cache_len, heads, head_dim), self.compute_dtype)
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (batch, self.cache_len, heads, head_dim), self.compute_dtype)
+        index = self.variable(
+            "cache", "cache_index",
+            lambda: jnp.zeros((), jnp.int32))
+
+        idx = index.value
+        cached_k.value = lax.dynamic_update_slice(
+            cached_k.value, k.astype(self.compute_dtype), (0, idx, 0, 0))
+        cached_v.value = lax.dynamic_update_slice(
+            cached_v.value, v.astype(self.compute_dtype), (0, idx, 0, 0))
+        index.value = idx + seq
+
+        positions = idx + jnp.arange(seq)  # query positions
+        key_positions = jnp.arange(self.cache_len)
+        allowed = key_positions[None, :] <= positions[:, None]  # [S, L]
+        scale = 1.0 / np.sqrt(head_dim)
+        # f32 MXU accumulation, like every training attention path —
+        # bf16 logits would round before the argmax/softmax.
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, cached_k.value,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(allowed[None, None], logits, -1e30)
+        weights = nn.softmax(logits, axis=-1).astype(self.compute_dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, cached_v.value)
+
 
 class TransformerBlock(nn.Module):
     num_heads: int
@@ -67,12 +121,16 @@ class TransformerBlock(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
     moe_experts: int = 0  # > 0 swaps the dense MLP for a Switch MoE
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
         y = nn.LayerNorm(dtype=self.compute_dtype, name="ln_attn")(x)
         y = CausalSelfAttention(self.num_heads, self.compute_dtype,
                                 self.attention_impl,
+                                decode=self.decode,
+                                cache_len=self.cache_len,
                                 name="attention")(y, mask)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
@@ -113,6 +171,7 @@ class TransformerLM(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
     moe_experts: int = 0
+    decode: bool = False  # autoregressive KV-cache mode (see generate())
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -123,14 +182,24 @@ class TransformerLM(nn.Module):
                     seq, self.max_seq_len))
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
+        if self.decode:
+            # Positions continue from where the cache left off.
+            pos_index = self.variable("cache", "pos_index",
+                                      lambda: jnp.zeros((), jnp.int32))
+            positions = pos_index.value + jnp.arange(seq)
+            pos_index.value = pos_index.value + seq
+        else:
+            positions = jnp.arange(seq)
         pos = nn.Embed(self.max_seq_len, self.d_model,
                        dtype=self.compute_dtype,
-                       name="pos_embed")(jnp.arange(seq)[None, :])
+                       name="pos_embed")(positions[None, :])
         x = x + pos
         for i in range(self.num_layers):
             x = TransformerBlock(self.num_heads, self.d_ff,
                                  self.dropout_rate, self.compute_dtype,
                                  self.attention_impl, self.moe_experts,
+                                 decode=self.decode,
+                                 cache_len=self.max_seq_len,
                                  name="block_%d" % i)(
                                      x, mask, deterministic)
         x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_final")(x)
@@ -138,6 +207,135 @@ class TransformerLM(nn.Module):
         logits = nn.Dense(self.vocab_size, use_bias=False,
                           dtype=self.compute_dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)
+
+
+def generate(model,
+             params,
+             prompt,
+             max_new_tokens,
+             rng=None,
+             temperature=1.0,
+             top_k=None,
+             eos_token=None):
+    """Autoregressive sampling with a KV cache.
+
+    The inference counterpart of Trainer.fit for `TransformerLM` (no
+    reference equivalent — the reference delegates inference to Keras).
+    XLA-friendly by construction: one jitted prefill call over the
+    whole prompt, then a `lax.scan` of single-token steps over a
+    static-size cache, so the whole generation compiles to two
+    executables regardless of length.
+
+    Args:
+        model: A `TransformerLM` (decode=False training instance; a
+            decode clone is derived internally).
+        params: The trained "params" pytree.
+        prompt: [B, S] int32 prompt tokens (S >= 1).
+        max_new_tokens: How many tokens to sample beyond the prompt.
+        rng: PRNGKey for sampling; required unless temperature == 0.
+        temperature: 0 = greedy argmax; otherwise softmax temperature.
+        top_k: Optional truncation to the k highest-probability tokens.
+        eos_token: Optional stop token: positions after a sampled eos
+            are filled with eos_token.
+
+    Returns:
+        [B, S + max_new_tokens] int32: prompt + generated continuation.
+    """
+    import jax
+
+    if model.attention_impl == "ring":
+        raise NotImplementedError(
+            "generate() decodes on a single mesh shard; use a non-ring "
+            "attention_impl for inference.")
+    batch, prompt_len = prompt.shape
+    if max_new_tokens < 0:
+        raise ValueError("max_new_tokens must be >= 0; got {}.".format(
+            max_new_tokens))
+    if max_new_tokens == 0:
+        return prompt
+    total = prompt_len + max_new_tokens
+    if total > model.max_seq_len:
+        raise ValueError(
+            "prompt ({}) + max_new_tokens ({}) exceeds max_seq_len {}."
+            .format(prompt_len, max_new_tokens, model.max_seq_len))
+    if temperature and rng is None:
+        raise ValueError("Sampling (temperature > 0) needs `rng`.")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    decoder = model.clone(decode=True, dropout_rate=0.0)
+    # Cache entries are all zero-initialized; build them from the
+    # abstract init (no second params copy is ever materialized).
+    cache_shapes = jax.eval_shape(
+        lambda: decoder.init(jax.random.PRNGKey(0),
+                             jnp.zeros((batch, 1), jnp.int32)))["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+    prefill, decode_steps = _decode_fns(
+        decoder, float(temperature),
+        None if top_k is None else int(top_k),
+        None if eos_token is None else int(eos_token))
+
+    rng, prefill_rng = jax.random.split(rng)
+    cache, first = prefill(params, cache, prompt, prefill_rng)
+    out = [first[:, None]]
+    if max_new_tokens > 1:
+        toks = decode_steps(params, cache, first,
+                            jax.random.split(rng, max_new_tokens - 1))
+        out.append(jnp.transpose(toks, (1, 0)))
+    return jnp.concatenate([prompt] + out, axis=1)
+
+
+@functools.lru_cache(maxsize=64)
+def _decode_fns(decoder, temperature, top_k, eos_token):
+    """Jitted (prefill, decode_steps) for one decoder/sampling config.
+
+    Cached so repeated generate() calls reuse the compiled executables
+    (jit keys on function identity; a fresh closure per call would
+    re-trace every time). params/cache/tokens are arguments, not
+    captured constants, so one compilation serves any weights of the
+    same shapes; distinct prompt lengths or scan lengths still compile
+    their own specializations, as they must under static shapes.
+    """
+    import jax
+
+    def sample(logits, rng):
+        logits = logits.astype(jnp.float32)
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if not temperature:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits / temperature, axis=-1).astype(jnp.int32)
+
+    @jax.jit
+    def prefill(params, cache, prompt, rng):
+        logits, vars_ = decoder.apply({"params": params, "cache": cache},
+                                      prompt, mutable=["cache"])
+        return vars_["cache"], sample(logits[:, -1], rng)
+
+    @jax.jit
+    def decode_steps(params, cache, first_token, step_rngs):
+        def step(carry, step_rng):
+            cache, tok, done = carry
+            logits, vars_ = decoder.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"])
+            nxt = sample(logits[:, 0], step_rng)
+            if eos_token is not None:
+                nxt = jnp.where(done, eos_token, nxt)
+                done = done | (nxt == eos_token)
+            return (vars_["cache"], nxt, done), nxt
+
+        done = (first_token == eos_token) if eos_token is not None \
+            else jnp.zeros(first_token.shape, bool)
+        (_, _, _), toks = jax.lax.scan(
+            step, (cache, first_token, done), step_rngs)
+        return toks  # [T-1, B]
+
+    return prefill, decode_steps
 
 
 def tensor_parallel_rules(tp_axis: str = "tp"):
