@@ -102,6 +102,7 @@ def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
         "gradient_accumulation_steps": trainer.gradient_accumulation_steps,
         "remat": trainer.remat,
         "zero1": trainer.zero1,
+        "fsdp": trainer.fsdp,
     }
     storage.write_bytes(storage.join(remote_dir, SPEC_FILE),
                         pickle.dumps(spec))

@@ -52,6 +52,7 @@ def build_trainer(spec, mesh=None):
             "gradient_accumulation_steps", 1),
         remat=spec.get("remat", False),
         zero1=spec.get("zero1", False),
+        fsdp=spec.get("fsdp", False),
     )
 
 

@@ -260,6 +260,10 @@ def generate(model,
             .format(prompt_len, max_new_tokens, model.max_seq_len))
     if temperature and rng is None:
         raise ValueError("Sampling (temperature > 0) needs `rng`.")
+    if top_k is not None and not 1 <= top_k <= model.vocab_size:
+        raise ValueError(
+            "top_k must be in [1, vocab_size={}]; got {}.".format(
+                model.vocab_size, top_k))
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
@@ -303,7 +307,8 @@ def _decode_fns(decoder, temperature, top_k, eos_token):
     def sample(logits, rng):
         logits = logits.astype(jnp.float32)
         if top_k is not None:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            # O(V log k), not a full vocab sort per decode step.
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
         if not temperature:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)

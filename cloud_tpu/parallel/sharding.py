@@ -95,25 +95,20 @@ def param_sharding(params, rules=None, mesh=None):
     return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
-def zero1_opt_sharding(params, param_shardings, mesh=None, axis=DATA_AXIS):
-    """ZeRO-1 layout for params-shaped optimizer subtrees (moments).
+def add_axis_sharding(params, shardings, mesh=None, axis=DATA_AXIS):
+    """Adds `axis` to each leaf's spec on the first eligible dimension.
 
-    Each leaf's spec is its parameter's spec with the data axis added on
-    the first dimension that is (a) not already sharded and (b)
-    divisible by the axis size; leaves with no such dimension keep the
-    parameter layout. Under pjit this makes XLA compute the optimizer
-    update on 1/|dp| shards and all-gather the updates — optimizer
-    memory drops to O(1/|dp|) per device (the ZeRO-1 trade: one
-    all-gather per step for an |dp|-fold moment-memory saving) while
-    parameters themselves stay in their data-parallel (replicated or
-    tp-sharded) layout.
+    Eligible = not already sharded and divisible by the axis size;
+    leaves already sharded on `axis` (anywhere) or with no eligible
+    dimension keep their layout. The generic building block for
+    weight/moment sharding over the data axis (ZeRO / FSDP layouts).
     """
     mesh = _resolve_mesh(mesh)
     if axis not in mesh.axis_names:
-        return param_shardings
+        return shardings
     n = mesh.shape[axis]
     if n <= 1:
-        return param_shardings
+        return shardings
 
     def _mentions(spec_entry, name):
         if spec_entry is None:
@@ -132,7 +127,36 @@ def zero1_opt_sharding(params, param_shardings, mesh=None, axis=DATA_AXIS):
                 return NamedSharding(mesh, P(*spec))
         return s
 
-    return jax.tree_util.tree_map(leaf, params, param_shardings)
+    return jax.tree_util.tree_map(leaf, params, shardings)
+
+
+def zero1_opt_sharding(params, param_shardings, mesh=None, axis=DATA_AXIS):
+    """ZeRO-1 layout for params-shaped optimizer subtrees (moments).
+
+    Each leaf's spec is its parameter's spec with the data axis added
+    (see `add_axis_sharding`). Under pjit this makes XLA compute the
+    optimizer update on 1/|dp| shards and all-gather the updates —
+    optimizer memory drops to O(1/|dp|) per device (the ZeRO-1 trade:
+    one all-gather per step for an |dp|-fold moment-memory saving)
+    while parameters themselves stay in their data-parallel (replicated
+    or tp-sharded) layout.
+    """
+    return add_axis_sharding(params, param_shardings, mesh, axis)
+
+
+def fsdp_sharding(params, mesh=None, axis=DATA_AXIS, rules=None):
+    """Fully-sharded (ZeRO-3 style) parameter layout.
+
+    Every parameter is sharded over the data axis on its first eligible
+    dimension, on top of any model-parallel `rules` (tp rules apply
+    first; dp lands on a free dimension). XLA's SPMD partitioner then
+    all-gathers weights where layers consume them and reduce-scatters
+    gradients — per-device weight+grad+moment memory drops to
+    O(1/|dp|), the pjit form of FSDP (How-to-Scale-Your-Model recipe:
+    annotate shardings, let XLA insert the collectives).
+    """
+    base = param_sharding(params, rules=rules, mesh=mesh)
+    return add_axis_sharding(params, base, mesh, axis)
 
 
 def path_string(path):

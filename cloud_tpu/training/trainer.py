@@ -143,7 +143,8 @@ class Trainer:
                  aux_loss_weight=0.01,
                  gradient_accumulation_steps=1,
                  remat=False,
-                 zero1=False):
+                 zero1=False,
+                 fsdp=False):
         """Constructor.
 
         Args:
@@ -182,6 +183,11 @@ class Trainer:
                 O(1/|dp|) per device for one all-gather of the updates
                 per step; parameters keep their layout. No-op without a
                 mesh or a >1-sized "dp" axis.
+            fsdp: Fully-shard parameters themselves over the data axis
+                (ZeRO-3 style), on top of any param_sharding_rules; XLA
+                all-gathers weights at use and reduce-scatters grads.
+                Implies the zero1 moment layout (moments follow their
+                params). No-op without a mesh or a >1-sized "dp" axis.
         """
         if hasattr(model, "init") and hasattr(model, "apply"):
             self._init_fn = model.init
@@ -207,6 +213,7 @@ class Trainer:
         self.optimizer = optimizer
         self.remat = bool(remat)
         self.zero1 = bool(zero1)
+        self.fsdp = bool(fsdp)
 
         self.loss_fn = LOSSES[loss] if isinstance(loss, str) else loss
         self.metric_fns = {}
@@ -264,8 +271,12 @@ class Trainer:
         else:
             params, extra_vars = variables, {}
         if self._mesh is not None:
-            param_sharding = sharding_lib.param_sharding(
-                params, self.param_sharding_rules, self._mesh)
+            if self.fsdp:
+                param_sharding = sharding_lib.fsdp_sharding(
+                    params, self._mesh, rules=self.param_sharding_rules)
+            else:
+                param_sharding = sharding_lib.param_sharding(
+                    params, self.param_sharding_rules, self._mesh)
             params = jax.tree_util.tree_map(
                 lambda a, s: jax.device_put(a, s), params, param_sharding)
             # Optimizer-state layout: optax states embed params-shaped
@@ -276,8 +287,11 @@ class Trainer:
             # params, so jit sharding propagation cannot infer this.
             abstract_opt = jax.eval_shape(self.optimizer.init, params)
             param_struct = jax.tree_util.tree_structure(params)
+            # fsdp params are already dp-sharded, so moments inheriting
+            # the param layout are ZeRO-sharded for free; zero1 adds the
+            # dp moment layout without touching the params.
             moment_sharding = param_sharding
-            if self.zero1:
+            if self.zero1 and not self.fsdp:
                 moment_sharding = sharding_lib.zero1_opt_sharding(
                     params, param_sharding, self._mesh)
 

@@ -143,3 +143,13 @@ class TestGenerate:
         generate(model, params, prompt, max_new_tokens=3, temperature=0)
         info = tf_mod._decode_fns.cache_info()
         assert info.hits >= 1 and info.misses == 1, info
+
+    def test_top_k_validated(self):
+        model = _model()
+        prompt = _prompt()
+        params = _params(model, prompt)
+        for bad in (0, -3, 65):
+            with pytest.raises(ValueError, match="top_k"):
+                generate(model, params, prompt, max_new_tokens=2,
+                         rng=jax.random.PRNGKey(0), temperature=1.0,
+                         top_k=bad)
