@@ -146,6 +146,21 @@ class FakeVizier:
         return wrapped
 
 
+def _toy_xy(n=64, d=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    return x, y
+
+
+def _mlp_hypermodel(hp):
+    from cloud_tpu.models import MLP
+    from cloud_tpu.training import Trainer
+
+    return Trainer(MLP(hidden=hp.get("units"), num_classes=4),
+                   optimizer="adam")
+
+
 def _search_space():
     hps = HyperParameters()
     hps.Int("units", 16, 64, step=16)
@@ -280,16 +295,8 @@ class TestCloudOracle:
 class TestCloudTunerSearch:
 
     def test_local_search_trains_real_models(self, tmp_path):
-        from cloud_tpu.models import MLP
-        from cloud_tpu.training import Trainer
-
-        rng = np.random.default_rng(0)
-        x = rng.normal(size=(64, 8)).astype(np.float32)
-        y = rng.integers(0, 4, size=64).astype(np.int32)
-
-        def hypermodel(hp):
-            return Trainer(MLP(hidden=hp.get("units"), num_classes=4),
-                           optimizer="adam")
+        x, y = _toy_xy()
+        hypermodel = _mlp_hypermodel
 
         fake = FakeVizier(max_suggestions=2)
         tuner = CloudTuner(
@@ -473,3 +480,71 @@ class TestPinnedDiscovery:
         monkeypatch.setenv("CLOUD_TPU_PINNED_DISCOVERY", "1")
         assert optimizer_client.build_service_client(
             "us-central1") == "offline-service"
+
+
+class TestSharedStudy:
+    """Concurrent-tuner semantics: one Vizier study shared by several
+    workers (the reference exercises this with multiprocessing.Pool(4)
+    sharing one study id, tuner_integration_test.py:283-296; hermetic
+    analogue here — two tuner processes' worth of clients against one
+    fake service)."""
+
+    def test_create_or_load_study_409_falls_back_to_load(self):
+        class Conflict(Exception):
+            def __init__(self):
+                self.resp = mock.MagicMock(status=409)
+
+        fake = FakeVizier()
+        studies = (fake.service.projects.return_value.locations
+                   .return_value.studies.return_value)
+
+        def conflicted_create(body=None, parent=None, studyId=None):
+            call = mock.MagicMock()
+            call.execute.side_effect = Conflict()
+            return call
+
+        studies.create.side_effect = conflicted_create
+        client = optimizer_client.create_or_load_study(
+            "p", "us-central1", "shared", {"metrics": []},
+            service_client=fake.service)
+        # Lost the creation race -> loaded the existing study and is
+        # fully usable.
+        assert client.study_id == "shared"
+        studies.get.assert_called_with(
+            name="projects/p/locations/us-central1/studies/shared")
+
+    def test_two_tuners_share_one_study(self, tmp_path):
+        x, y = _toy_xy()
+        hypermodel = _mlp_hypermodel
+
+        # One study (one fake service), two workers with max_trials=3.
+        # The suggestion budget (10) is deliberately ABOVE max_trials:
+        # only the client-side study-wide completed-trial count can stop
+        # worker 1, so the cross-worker accounting is load-bearing.
+        fake = FakeVizier(max_suggestions=10)
+
+        def worker(name):
+            tuner = CloudTuner(
+                hypermodel, directory=str(tmp_path / name),
+                project_id="p", region="us-central1",
+                objective=Objective("accuracy", "max"),
+                hyperparameters=_search_space(),
+                max_trials=3, study_id="shared_study",
+                service_client=fake.service)
+            tuner.search(x=x, y=y, epochs=1, batch_size=32,
+                         verbose=False)
+            return tuner
+
+        worker("w0")
+        t2 = worker("w1")
+
+        # Worker 0 consumed the study's max_trials; worker 1 saw the
+        # study-wide history and stopped WITHOUT requesting another
+        # suggestion (per-worker accounting would have asked for a 4th).
+        assert fake.suggested == 3
+        states = {tid: t["state"] for tid, t in fake.trials.items()}
+        assert states == {"1": "COMPLETED", "2": "COMPLETED",
+                          "3": "COMPLETED"}
+        # The late worker still sees the full study history.
+        best = t2.get_best_hyperparameters(1)
+        assert best[0].get("units") == 32
