@@ -161,35 +161,54 @@ class GeneratorDataset:
         return iter(self.factory())
 
 
-def prefetch_to_device(iterator, size=2, sharding=None):
-    """Wraps a host batch iterator, keeping `size` batches in flight on
-    device.
+def prefetch_to_device(iterator, size=2, sharding=None, feed=None,
+                       limit=None):
+    """Wraps a host batch iterator with device read-ahead.
 
     JAX async dispatch already overlaps host batching with device
     compute; explicit prefetch additionally overlaps the host->HBM copy
     of batch i+1 with step i, which matters when batches are large
     (images) relative to step time.
+
+    Args:
+        iterator: Host batch iterable.
+        size: Read-ahead depth — `size` batches are queued on device
+            ahead of the one being consumed (so up to size+1 alive;
+            size=0 feeds synchronously, the minimal-HBM mode).
+        sharding: Optional sharding for the default device_put feed.
+        feed: Optional callable replacing the default device_put (e.g.
+            a mesh-aware Trainer feed); its return value is yielded.
+        limit: Bound pulls from the iterator BEFORE reading ahead —
+            for steps_per_epoch over unbounded streams.
     """
     import collections
+    import itertools
 
-    queue = collections.deque()
-
-    def _put(batch):
-        if sharding is None:
-            return jax.tree_util.tree_map(jax.device_put, batch)
-        return jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, sharding), batch)
+    if feed is None:
+        def feed(batch):
+            if sharding is None:
+                return jax.device_put(batch)
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sharding), batch)
 
     it = iter(iterator)
+    if limit is not None:
+        it = itertools.islice(it, limit)
+    if size <= 0:
+        for batch in it:
+            yield feed(batch)
+        return
+
+    queue = collections.deque()
     try:
         for _ in range(size):
-            queue.append(_put(next(it)))
+            queue.append(feed(next(it)))
     except StopIteration:
         pass
     while queue:
         out = queue.popleft()
         try:
-            queue.append(_put(next(it)))
+            queue.append(feed(next(it)))
         except StopIteration:
             pass
         yield out

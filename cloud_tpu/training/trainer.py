@@ -481,6 +481,20 @@ class Trainer:
             return dataset.process_local_view()
         return iter(dataset)
 
+    def _prefetch_batches(self, batches, limit=None, size=2):
+        """Yields (local_example_count, device_batch) with `size` batches
+        of read-ahead (see data.prefetch_to_device; this just adds the
+        mesh-aware feed and the host-side example count)."""
+
+        def feed(batch):
+            lead = next((l for l in jax.tree_util.tree_leaves(batch)
+                         if getattr(l, "shape", ())), None)
+            n = int(lead.shape[0]) if lead is not None else 0
+            return (n, self._feed(batch))
+
+        return data_lib.prefetch_to_device(batches, size=size, feed=feed,
+                                           limit=limit)
+
     # -- public API -----------------------------------------------------
 
     def fit(self,
@@ -493,8 +507,14 @@ class Trainer:
             callbacks=(),
             steps_per_epoch=None,
             verbose=True,
-            resume_from=None):
+            resume_from=None,
+            prefetch=2):
         """Trains the model; returns a history dict of per-epoch logs.
+
+        prefetch: Device read-ahead depth — `prefetch` batches are kept
+        in flight ahead of the one being consumed (up to prefetch+1
+        resident). 0 feeds synchronously, the minimal-HBM mode for
+        workloads already near capacity.
 
         resume_from: Optional checkpoint directory (a ModelCheckpoint
         filepath from an earlier run). When it holds a checkpoint, the
@@ -538,7 +558,7 @@ class Trainer:
         try:
             self._fit_epochs(dataset, epochs, steps_per_epoch,
                              validation_data, batch_size, callbacks,
-                             history, verbose)
+                             history, verbose, prefetch)
         finally:
             # Guaranteed even when a train step raises (OOM, interrupt):
             # callbacks holding external resources (profiler traces,
@@ -549,7 +569,7 @@ class Trainer:
 
     def _fit_epochs(self, dataset, epochs, steps_per_epoch,
                     validation_data, batch_size, callbacks, history,
-                    verbose):
+                    verbose, prefetch=2):
         for epoch in range(epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
@@ -557,15 +577,11 @@ class Trainer:
             count = 0
             examples = 0
             t0 = time.time()
-            for step, batch in enumerate(self._epoch_batches(dataset)):
-                if steps_per_epoch is not None and step >= steps_per_epoch:
-                    break
-                batched = next(
-                    (l for l in jax.tree_util.tree_leaves(batch)
-                     if getattr(l, "shape", ())), None)
-                if batched is not None:
-                    examples += int(batched.shape[0])
-                batch = self._feed(batch)
+            feeder = self._prefetch_batches(
+                self._epoch_batches(dataset), limit=steps_per_epoch,
+                size=prefetch)
+            for batch_examples, batch in feeder:
+                examples += batch_examples
                 self.state, logs = self._jit_train_step(self.state, batch)
                 # Keep logs as device arrays: no host sync inside the hot
                 # loop (async dispatch overlaps host batching with the

@@ -144,3 +144,65 @@ class TestUnboundedStream:
         history = trainer.fit(ds, epochs=2, verbose=False)
         assert len(history["loss"]) == 2
         assert int(trainer.state.step) == 8  # 2 epochs x 4 capped steps
+
+
+class TestFitPrefetch:
+    """fit() feeds through the double-buffered prefetcher."""
+
+    def test_steps_per_epoch_bounds_stream_pulls(self):
+        import itertools
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import GeneratorDataset, Trainer
+
+        pulled = []
+
+        def factory():
+            def gen():
+                for i in itertools.count():
+                    pulled.append(i)
+                    rng = np.random.default_rng(i)
+                    yield (rng.normal(size=(16, 8)).astype(np.float32),
+                           rng.integers(0, 4, 16).astype(np.int32))
+            return gen()
+
+        ds = GeneratorDataset(factory, steps_per_epoch=3)
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          optimizer=optax.adam(1e-3))
+        history = trainer.fit(ds, epochs=2, verbose=False)
+        assert len(history["loss"]) == 2
+        # Exactly steps_per_epoch pulls per epoch (plus the build-time
+        # sample peek's single pull): read-ahead must respect the bound.
+        per_epoch = 3
+        assert len(pulled) <= 2 * per_epoch + 1
+
+    def test_prefetcher_yields_all_batches_with_counts(self):
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import Trainer
+
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          optimizer=optax.adam(1e-3))
+        batches = [(np.zeros((5, 8), np.float32),
+                    np.zeros((5,), np.int32))] * 4
+        out = list(trainer._prefetch_batches(iter(batches)))
+        assert [n for n, _ in out] == [5, 5, 5, 5]
+        out_limited = list(trainer._prefetch_batches(iter(batches),
+                                                     limit=2))
+        assert len(out_limited) == 2
+
+    def test_prefetch_zero_feeds_synchronously(self):
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import Trainer
+
+        x = np.random.default_rng(0).normal(size=(64, 8)).astype(np.float32)
+        y = np.random.default_rng(0).integers(0, 4, 64).astype(np.int32)
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          optimizer=optax.adam(1e-3))
+        h = trainer.fit(x, y, epochs=1, batch_size=32, verbose=False,
+                        prefetch=0)
+        assert len(h["loss"]) == 1
