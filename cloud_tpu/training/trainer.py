@@ -78,6 +78,11 @@ OPTIMIZERS = {
     "adam": lambda: optax.adam(1e-3),
     "adamw": lambda: optax.adamw(1e-3),
     "sgd": lambda: optax.sgd(1e-2, momentum=0.9),
+    "rmsprop": lambda: optax.rmsprop(1e-3),
+    "adagrad": lambda: optax.adagrad(1e-2),
+    "adafactor": lambda: optax.adafactor(),  # the TPU LLM workhorse
+    "lamb": lambda: optax.lamb(1e-3),
+    "lion": lambda: optax.lion(1e-4),
 }
 
 
@@ -601,7 +606,8 @@ class Trainer:
             if validation_data is not None:
                 val_logs = self.evaluate(*validation_data,
                                          batch_size=batch_size,
-                                         verbose=False)
+                                         verbose=False,
+                                         prefetch=prefetch)
                 logs.update({"val_" + k: v for k, v in val_logs.items()})
 
             for k, v in logs.items():
@@ -636,7 +642,7 @@ class Trainer:
         return self.state
 
     def evaluate(self, x, y=None, batch_size=32, verbose=True,
-                 steps=None):
+                 steps=None, prefetch=2):
         """Returns exact example-weighted mean loss/metrics.
 
         Tail batches are padded by wrapping (never dropped) so shapes
@@ -647,7 +653,9 @@ class Trainer:
 
         `steps` caps the batch loop; when unset, a dataset-level
         `steps_per_epoch` (e.g. GeneratorDataset over an unbounded
-        stream) applies, mirroring fit().
+        stream) applies, mirroring fit(). `prefetch` is the device
+        read-ahead depth (0 = synchronous), mirroring fit(); fit()
+        forwards its own value to the per-epoch validation pass.
         """
         if self.state is None:
             raise RuntimeError("Model is not built; call fit() first or "
@@ -662,36 +670,49 @@ class Trainer:
         global_bs = getattr(dataset, "batch_size", None)
         process_count = jax.process_count()
         process_index = jax.process_index()
+        def masked_batches():
+            """(real_example_count, (x, y, valid-mask)) per batch."""
+            for i, batch in enumerate(self._epoch_batches(dataset)):
+                if steps is not None and i >= steps:
+                    break
+                # Same unpacking the train step applies: any 2-sequence
+                # is (x, y); anything else is unlabeled input.
+                if isinstance(batch, (tuple, list)) and len(batch) == 2:
+                    xb, yb = batch
+                else:
+                    xb, yb = batch, None
+                local_b = jax.tree_util.tree_leaves(xb)[0].shape[0]
+                if num_examples is not None and global_bs is not None:
+                    # ArrayDataset pads the tail by wrapping: only the
+                    # first `real` rows of the global batch are fresh.
+                    real = min(global_bs, num_examples - i * global_bs)
+                else:
+                    # Arbitrary iterables yield their own (unpadded)
+                    # batches.
+                    real = local_b * process_count
+                # This process holds global rows
+                # [offset, offset + local_b).
+                offset = (process_index * local_b
+                          if process_count > 1 else 0)
+                mask = ((np.arange(local_b) + offset) < real).astype(
+                    np.float32)
+                yield real, (xb, yb, mask)
+
+        feeder = data_lib.prefetch_to_device(
+            masked_batches(), size=prefetch,
+            feed=lambda item: (item[0], self._feed(item[1])))
         totals, weight = {}, 0.0
-        for i, batch in enumerate(self._epoch_batches(dataset)):
-            if steps is not None and i >= steps:
-                break
-            # Same unpacking the train step applies: any 2-sequence is
-            # (x, y); anything else is unlabeled input.
-            if isinstance(batch, (tuple, list)) and len(batch) == 2:
-                xb, yb = batch
-            else:
-                xb, yb = batch, None
-            local_b = jax.tree_util.tree_leaves(xb)[0].shape[0]
-            if num_examples is not None and global_bs is not None:
-                # ArrayDataset pads the tail by wrapping: only the first
-                # `real` rows of the global batch are fresh examples.
-                real = min(global_bs, num_examples - i * global_bs)
-            else:
-                # Arbitrary iterables yield their own (unpadded) batches.
-                real = local_b * process_count
-            # This process holds global rows [offset, offset + local_b).
-            offset = process_index * local_b if process_count > 1 else 0
-            mask = ((np.arange(local_b) + offset) < real).astype(
-                np.float32)
-            fed = self._feed((xb, yb, mask))
+        for real, fed in feeder:
             logs = self._jit_eval_step(self.state, fed)
             weight += real
             for k, v in logs.items():
-                totals[k] = totals.get(k, 0.0) + float(v) * real
+                # Device-side accumulation: no host sync per batch (one
+                # tunnel round-trip per eval batch otherwise); the
+                # float() conversion below is the only barrier.
+                totals[k] = totals.get(k, 0.0) + v * real
         if weight == 0.0:
             raise ValueError("evaluate() received an empty dataset.")
-        logs = {k: v / weight for k, v in totals.items()}
+        logs = {k: float(v) / weight for k, v in totals.items()}
         if verbose and jax.process_index() == 0:
             logger.info("evaluate: %s", {
                 k: round(v, 4) for k, v in logs.items()})

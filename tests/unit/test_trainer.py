@@ -703,3 +703,16 @@ class TestFSDP:
         b = np.asarray(jax.device_get(
             restored.state.params["Dense_0"]["kernel"]))
         np.testing.assert_allclose(a, b)
+
+
+class TestOptimizerRegistry:
+
+    def test_all_names_build_and_step(self):
+        from cloud_tpu.training.trainer import OPTIMIZERS
+
+        x, y = _toy_classification(n=64)
+        for name in OPTIMIZERS:
+            trainer = Trainer(MLP(hidden=16, num_classes=4),
+                              optimizer=name, metrics=())
+            h = trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+            assert np.isfinite(h["loss"][-1]), name
