@@ -121,7 +121,7 @@ class ModelCheckpoint(Callback):
     """
 
     def __init__(self, filepath, monitor=None, mode="auto", min_delta=0.0,
-                 save_freq="epoch"):
+                 save_freq="epoch", use_async=False):
         from cloud_tpu.training import checkpoint as checkpoint_lib
         self._checkpoint_lib = checkpoint_lib
         self.filepath = filepath
@@ -130,6 +130,11 @@ class ModelCheckpoint(Callback):
         self.min_delta = abs(min_delta)
         if save_freq != "epoch":
             raise ValueError("Only save_freq='epoch' is supported.")
+        # use_async: the epoch's save snapshots the state and writes on
+        # a background thread, so epoch N+1 trains during the I/O (the
+        # standard trade for big states on gs://); on_train_end blocks
+        # until the last write commits.
+        self.use_async = bool(use_async)
         self.best = None
 
     def on_epoch_end(self, epoch, logs):
@@ -141,7 +146,12 @@ class ModelCheckpoint(Callback):
                 return
             self.best = value
         self._checkpoint_lib.save(self.filepath, self.trainer.state,
-                                  step=int(self.trainer.state.step))
+                                  step=int(self.trainer.state.step),
+                                  use_async=self.use_async)
+
+    def on_train_end(self, history):
+        if self.use_async:
+            self._checkpoint_lib.wait_until_finished()
 
 
 class MetricsLogger(Callback):

@@ -20,6 +20,28 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+_async_checkpointer = None
+
+
+def _get_async_checkpointer():
+    # One process-wide AsyncCheckpointer: it owns the background write
+    # thread, and orbax serializes saves through it (a second save waits
+    # for the first), so per-save construction would forfeit the async.
+    global _async_checkpointer
+    if _async_checkpointer is None:
+        _async_checkpointer = ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler())
+    return _async_checkpointer
+
+
+def wait_until_finished():
+    """Blocks until every async save has committed. No-op when none are
+    pending. Call before reading a checkpoint written with
+    `save(..., use_async=True)` or at end of training."""
+    if _async_checkpointer is not None:
+        _async_checkpointer.wait_until_finished()
+
+
 def _normalize(directory):
     """Local paths become absolute (orbax requires it); gs:// URIs pass
     through untouched — tensorstore reads/writes them directly."""
@@ -28,9 +50,20 @@ def _normalize(directory):
     return os.path.abspath(directory)
 
 
-def save(directory, state, step=0, force=True):
-    """Saves a pytree `state` under `<directory>/<step>`."""
+def save(directory, state, step=0, force=True, use_async=False):
+    """Saves a pytree `state` under `<directory>/<step>`.
+
+    use_async: Return as soon as the state is snapshotted (device
+    arrays copied out); the serialization/write happens on a background
+    thread so training continues during the I/O — the standard trade
+    for large states on slow stores (gs://). Call
+    `wait_until_finished()` before reading the checkpoint back or
+    exiting the process.
+    """
     path = storage.join(_normalize(directory), str(step))
+    if use_async:
+        _get_async_checkpointer().save(path, state, force=force)
+        return path
     with _checkpointer() as checkpointer:
         checkpointer.save(path, state, force=force)
     return path
@@ -39,6 +72,7 @@ def save(directory, state, step=0, force=True):
 def latest_step(directory):
     """Largest step number checkpointed under `directory` (local or
     gs://), or None."""
+    wait_until_finished()  # in-flight async saves must be visible
     steps = [int(name) for name in storage.listdir(_normalize(directory))
              if name.isdigit()]
     return max(steps) if steps else None
@@ -54,6 +88,7 @@ def restore(directory, target, step=None):
         step: Step to restore; default latest.
     """
     directory = _normalize(directory)
+    wait_until_finished()  # never read a checkpoint mid-write
     if step is None:
         step = latest_step(directory)
         if step is None:

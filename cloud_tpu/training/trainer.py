@@ -567,9 +567,20 @@ class Trainer:
         finally:
             # Guaranteed even when a train step raises (OOM, interrupt):
             # callbacks holding external resources (profiler traces,
-            # open files) rely on on_train_end for cleanup.
+            # open files) rely on on_train_end for cleanup. Isolated per
+            # callback so one failing teardown (e.g. an async checkpoint
+            # commit error) cannot skip the others; the first error
+            # still surfaces after all have run.
+            teardown_error = None
             for cb in callbacks:
-                cb.on_train_end(history)
+                try:
+                    cb.on_train_end(history)
+                except Exception as e:  # noqa: BLE001 - must not mask
+                    logger.exception("on_train_end failed for %r", cb)
+                    if teardown_error is None:
+                        teardown_error = e
+            if teardown_error is not None:
+                raise teardown_error
         return history
 
     def _fit_epochs(self, dataset, epochs, steps_per_epoch,
@@ -620,16 +631,19 @@ class Trainer:
             if self.stop_training:
                 break
 
-    def save_checkpoint(self, directory):
+    def save_checkpoint(self, directory, use_async=False):
         """Saves the full train state under `<directory>/<step>` (local
         or gs://). Keras `model.save` parity at the state level; pair
-        with `restore_checkpoint` or `fit(resume_from=...)`."""
+        with `restore_checkpoint` or `fit(resume_from=...)`. With
+        use_async=True the write happens on a background thread
+        (checkpoint.wait_until_finished() blocks on it)."""
         from cloud_tpu.training import checkpoint as checkpoint_lib
 
         if self.state is None:
             raise RuntimeError("Model is not built; nothing to save.")
         return checkpoint_lib.save(directory, self.state,
-                                   step=int(self.state.step))
+                                   step=int(self.state.step),
+                                   use_async=use_async)
 
     def restore_checkpoint(self, directory, sample_x, step=None):
         """Builds congruent state from `sample_x`, then restores the
