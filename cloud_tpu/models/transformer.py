@@ -28,6 +28,7 @@ class CausalSelfAttention(nn.Module):
     attention_impl: str = "auto"  # auto | flash | reference | ring
     decode: bool = False  # autoregressive KV-cache mode
     cache_len: int = 0  # cache size (tokens); set by TransformerLM
+    causal: bool = True  # False = bidirectional (encoder) attention
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -57,12 +58,14 @@ class CausalSelfAttention(nn.Module):
             if mask is not None:
                 raise NotImplementedError(
                     "ring attention does not take a padding mask.")
-            out = sequence_parallel_attention(q, k, v, causal=True)
+            out = sequence_parallel_attention(q, k, v,
+                                              causal=self.causal)
         else:
-            # "auto" uses the Pallas flash kernel on TPU (mask-free
-            # shapes), the jnp reference elsewhere; both are causal
-            # with 1/sqrt(D).
-            out = ops.attention(q, k, v, causal=True, mask=mask,
+            # "auto" uses the Pallas flash kernel on TPU, the jnp
+            # reference elsewhere; direction follows self.causal
+            # (False = bidirectional encoder attention), scale
+            # 1/sqrt(D).
+            out = ops.attention(q, k, v, causal=self.causal, mask=mask,
                                 impl=self.attention_impl)
         out = out.astype(self.compute_dtype)
         return nn.DenseGeneral(d_model, axis=(-2, -1),
@@ -123,6 +126,7 @@ class TransformerBlock(nn.Module):
     moe_experts: int = 0  # > 0 swaps the dense MLP for a Switch MoE
     decode: bool = False
     cache_len: int = 0
+    causal: bool = True
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
@@ -131,6 +135,7 @@ class TransformerBlock(nn.Module):
                                 self.attention_impl,
                                 decode=self.decode,
                                 cache_len=self.cache_len,
+                                causal=self.causal,
                                 name="attention")(y, mask)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
@@ -206,6 +211,76 @@ class TransformerLM(nn.Module):
         # Tied-free output head; vocab dim sharded on tp by the rules.
         logits = nn.Dense(self.vocab_size, use_bias=False,
                           dtype=self.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+class TransformerEncoder(nn.Module):
+    """BERT-style bidirectional encoder.
+
+    The encoder counterpart of TransformerLM (same blocks, same tp
+    sharding rules, bidirectional attention): per-token hidden states,
+    or a pooled classification / masked-LM head.
+
+    head: None -> [B, S, d_model] hidden states;
+          "classify" -> [B, num_classes] (masked-mean pooled);
+          "mlm" -> [B, S, vocab_size] token logits.
+    mask: optional [B, S] validity mask (1 = real token). Padding is
+        excluded from attention keys AND from the classify pooling.
+    """
+
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 512
+    d_ff: int = 2048
+    max_seq_len: int = 512
+    num_classes: int = 2
+    head: Optional[str] = "classify"
+    dropout_rate: float = 0.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, tokens, mask=None, deterministic=True):
+        seq = tokens.shape[1]
+        if seq > self.max_seq_len:
+            raise ValueError(
+                "Sequence length {} exceeds max_seq_len {}.".format(
+                    seq, self.max_seq_len))
+        if self.head not in (None, "classify", "mlm"):
+            raise ValueError("Unknown head: {!r}".format(self.head))
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     dtype=self.compute_dtype, name="embed")(tokens)
+        pos = nn.Embed(self.max_seq_len, self.d_model,
+                       dtype=self.compute_dtype,
+                       name="pos_embed")(jnp.arange(seq)[None, :])
+        x = x + pos
+        for i in range(self.num_layers):
+            x = TransformerBlock(self.num_heads, self.d_ff,
+                                 self.dropout_rate, self.compute_dtype,
+                                 self.attention_impl, causal=False,
+                                 name="block_%d" % i)(
+                                     x, mask, deterministic)
+        x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_final")(x)
+        if self.head is None:
+            return x.astype(jnp.float32)
+        if self.head == "mlm":
+            logits = nn.Dense(self.vocab_size, use_bias=False,
+                              dtype=self.compute_dtype,
+                              name="lm_head")(x)
+            return logits.astype(jnp.float32)
+        # Pool in f32: bf16 can't count >256 valid tokens exactly, and
+        # summing hundreds of tokens in bf16 rounds the features.
+        xf = x.astype(jnp.float32)
+        if mask is not None:
+            m = mask.astype(jnp.float32)[:, :, None]
+            pooled = jnp.sum(xf * m, axis=1) / jnp.maximum(
+                jnp.sum(m, axis=1), 1.0)
+        else:
+            pooled = jnp.mean(xf, axis=1)
+        logits = nn.Dense(self.num_classes, dtype=self.compute_dtype,
+                          name="classifier")(pooled.astype(
+                              self.compute_dtype))
         return logits.astype(jnp.float32)
 
 

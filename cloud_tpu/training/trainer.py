@@ -18,6 +18,7 @@ Example:
 """
 
 import logging
+import sys
 import time
 
 import jax
@@ -240,6 +241,7 @@ class Trainer:
         self.state = None
         self._jit_train_step = None
         self._jit_eval_step = None
+        self._jit_predict_step = None
         self.stop_training = False  # set by callbacks (EarlyStopping)
 
     # -- state construction --------------------------------------------
@@ -579,7 +581,10 @@ class Trainer:
                     logger.exception("on_train_end failed for %r", cb)
                     if teardown_error is None:
                         teardown_error = e
-            if teardown_error is not None:
+            # Surface a teardown failure only when no training exception
+            # is already propagating — raising inside `finally` would
+            # replace it, hiding the error that actually killed the run.
+            if teardown_error is not None and sys.exc_info()[1] is None:
                 raise teardown_error
         return history
 
@@ -732,19 +737,47 @@ class Trainer:
                 k: round(v, 4) for k, v in logs.items()})
         return logs
 
-    def predict(self, x, batch_size=32):
-        """Returns stacked model outputs for `x`."""
+    def _make_predict_step(self):
+        eval_kwargs = self.eval_kwargs
+
+        def predict_step(state, xb):
+            return self._apply(state.params, xb,
+                               extra_vars=state.extra_vars, **eval_kwargs)
+
+        if self._mesh is None:
+            return jax.jit(predict_step)
+        return jax.jit(
+            predict_step,
+            in_shardings=(self._state_sharding,
+                          sharding_lib.batch_sharding(self._mesh)))
+
+    def predict(self, x, batch_size=32, prefetch=2):
+        """Returns stacked model outputs for `x`.
+
+        Jitted and prefetched like fit/evaluate: batches stream to
+        device `prefetch` ahead, outputs stay on device until one
+        gather at the end.
+        """
         if self.state is None:
             raise RuntimeError("Model is not built; call fit() first.")
+        if self._jit_predict_step is None:
+            self._jit_predict_step = self._make_predict_step()
         dataset = data_lib.as_dataset(x, None, batch_size=batch_size,
                                       drop_remainder=False)
+        feeder = data_lib.prefetch_to_device(
+            iter(dataset), size=prefetch, feed=self._feed)
+        # One-behind gather: batch i's output is pulled to host while
+        # batch i+1 computes — transfer overlaps compute without ever
+        # holding more than two batches of outputs in HBM.
         outs = []
-        for xb in dataset:
-            xb = self._feed(xb)
-            outs.append(np.asarray(
-                self._apply(self.state.params, xb,
-                            extra_vars=self.state.extra_vars,
-                            **self.eval_kwargs)))
+        pending = None
+        for xb in feeder:
+            out = self._jit_predict_step(self.state, xb)
+            if pending is not None:
+                outs.append(np.asarray(pending))
+            pending = out
+        if pending is not None:
+            outs.append(np.asarray(pending))
         preds = np.concatenate(outs, axis=0)
         n = jax.tree_util.tree_leaves(x)[0].shape[0]
         return preds[:n]

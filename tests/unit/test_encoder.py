@@ -1,0 +1,101 @@
+"""TransformerEncoder (BERT-style bidirectional) tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from cloud_tpu.models import (TransformerEncoder, tensor_parallel_rules)
+from cloud_tpu.parallel import runtime
+from cloud_tpu.training import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _reset_runtime():
+    runtime.reset()
+    yield
+    runtime.reset()
+
+
+def _enc(**kw):
+    defaults = dict(vocab_size=64, num_layers=2, num_heads=2, d_model=32,
+                    d_ff=64, max_seq_len=16, num_classes=4,
+                    compute_dtype=jnp.float32)
+    defaults.update(kw)
+    return TransformerEncoder(**defaults)
+
+
+def _tokens(b=4, s=12, vocab=64, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(1, vocab, (b, s)), jnp.int32)
+
+
+class TestEncoder:
+
+    def test_head_shapes(self):
+        toks = _tokens()
+        for head, shape in ((None, (4, 12, 32)), ("classify", (4, 4)),
+                            ("mlm", (4, 12, 64))):
+            model = _enc(head=head)
+            out = model.apply(
+                model.init(jax.random.PRNGKey(0), toks), toks)
+            assert out.shape == shape, head
+
+    def test_attention_is_bidirectional(self):
+        """Perturbing a LATER token changes an EARLIER token's hidden
+        state — impossible under a causal mask."""
+        model = _enc(head=None)
+        toks = _tokens()
+        variables = model.init(jax.random.PRNGKey(0), toks)
+        h1 = model.apply(variables, toks)
+        toks2 = toks.at[:, -1].set((toks[:, -1] + 1) % 64)
+        h2 = model.apply(variables, toks2)
+        assert not np.allclose(np.asarray(h1[:, 0]), np.asarray(h2[:, 0]))
+
+    def test_padding_masked_out_of_attention_and_pooling(self):
+        """Changing pad-token content must not change the classification
+        of masked inputs."""
+        model = _enc(head="classify")
+        toks = _tokens()
+        mask = jnp.asarray(np.array([[1] * 8 + [0] * 4] * 4), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), toks, mask)
+        a = model.apply(variables, toks, mask)
+        garbage = toks.at[:, 8:].set(63)
+        b = model.apply(variables, garbage, mask)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_trains_with_trainer(self):
+        toks = np.asarray(_tokens(b=64, s=8))
+        labels = (np.asarray(toks[:, 0]) % 4).astype(np.int32)
+        trainer = Trainer(_enc(head="classify"),
+                          optimizer=optax.adam(1e-3))
+        h = trainer.fit(toks, labels, epochs=3, batch_size=16,
+                        verbose=False)
+        assert h["loss"][-1] < h["loss"][0]
+
+    def test_tp_rules_apply_on_mesh(self):
+        runtime.initialize(strategy="tpu_slice", axis_names=("dp", "tp"),
+                           mesh_shape=(4, 2))
+        toks = np.asarray(_tokens(b=16, s=8))
+        labels = (np.asarray(toks[:, 0]) % 4).astype(np.int32)
+        trainer = Trainer(_enc(head="classify"),
+                          optimizer=optax.adam(1e-3),
+                          param_sharding_rules=tensor_parallel_rules())
+        h = trainer.fit(toks, labels, epochs=1, batch_size=8,
+                        verbose=False)
+        assert np.isfinite(h["loss"][-1])
+        k = trainer.state.params["block_0"]["attention"]["query"]["kernel"]
+        assert "tp" in str(tuple(k.sharding.spec))
+
+    def test_seq_len_guard(self):
+        model = _enc(max_seq_len=8)
+        toks = _tokens(s=12)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            model.init(jax.random.PRNGKey(0), toks)
+
+    def test_unknown_head_rejected(self):
+        model = _enc(head="pool")
+        with pytest.raises(ValueError, match="head"):
+            model.init(jax.random.PRNGKey(0), _tokens())
