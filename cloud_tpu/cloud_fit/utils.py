@@ -15,4 +15,5 @@ SUPPORTED_DISTRIBUTION_STRATEGIES = (
     "multi_worker",
     "tpu_slice",
     "tpu_pod",
+    "multi_slice",
 )

@@ -35,7 +35,7 @@ logger = logging.getLogger("cloud_tpu")
 # Known strategy names, selected by the strategy compiler
 # (cloud_tpu/core/preprocess.py) from the cluster shape.
 STRATEGIES = ("one_device", "mirrored", "multi_worker", "tpu_slice",
-              "tpu_pod")
+              "tpu_pod", "multi_slice")
 
 _context = None
 
@@ -84,6 +84,7 @@ def _wait_for_devices(min_devices=1, retries=40, retry_interval_secs=10.0):
 def initialize(strategy="tpu_slice",
                axis_names=None,
                mesh_shape=None,
+               dcn_mesh_shape=None,
                coordinator_address=None,
                num_processes=None,
                process_id=None,
@@ -101,7 +102,16 @@ def initialize(strategy="tpu_slice",
             ("dp",); pass e.g. ("dp", "tp") with `mesh_shape` for hybrid
             layouts (explicit args always beat the env).
         mesh_shape: Optional tuple of ints matching `axis_names`. Default:
-            all devices on the first axis.
+            all devices on the first axis. For "multi_slice" this is the
+            PER-SLICE (ICI) shape; the full mesh axis sizes are
+            elementwise `dcn_mesh_shape * mesh_shape`.
+        dcn_mesh_shape: ("multi_slice" only) how each axis spans slices
+            over DCN; same length as axis_names. Default: all slices on
+            the first (data) axis — dp gradient reductions cross DCN,
+            tp/sp/pp collectives stay on intra-slice ICI, the standard
+            multi-slice layout. Slices are identified by the devices'
+            `slice_index` (fallback for simulation: contiguous groups of
+            CLOUD_TPU_NUM_SLICES equal chunks).
         coordinator_address / num_processes / process_id: Multi-process
             bootstrap parameters; default to the CLOUD_TPU_* env contract.
         devices: Explicit device list (tests); default `jax.devices()`
@@ -126,7 +136,7 @@ def initialize(strategy="tpu_slice",
     elif axis_names is None:
         axis_names = ("dp",)
 
-    if strategy in ("multi_worker", "tpu_pod"):
+    if strategy in ("multi_worker", "tpu_pod", "multi_slice"):
         _maybe_init_distributed(coordinator_address, num_processes,
                                 process_id)
 
@@ -140,33 +150,126 @@ def initialize(strategy="tpu_slice",
         else:
             devices = _wait_for_devices(1, retries, retry_interval_secs)
 
-    device_array = np.asarray(devices)
-    if mesh_shape is not None and -1 in mesh_shape:
-        known = 1
-        for dim in mesh_shape:
-            if dim != -1:
-                known *= dim
-        if (known <= 0 or mesh_shape.count(-1) != 1
-                or device_array.size % known):
-            raise ValueError(
-                "Cannot infer mesh_shape {} for {} devices.".format(
-                    mesh_shape, device_array.size))
-        mesh_shape = tuple(device_array.size // known if d == -1 else d
-                           for d in mesh_shape)
-    if mesh_shape is not None:
-        if len(mesh_shape) != len(axis_names):
-            raise ValueError(
-                "mesh_shape {} does not match axis_names {}.".format(
-                    mesh_shape, axis_names))
-        device_array = device_array.reshape(mesh_shape)
+    if strategy == "multi_slice":
+        device_array = _hybrid_device_array(devices, axis_names,
+                                            mesh_shape, dcn_mesh_shape)
     else:
-        device_array = device_array.reshape(
-            (device_array.size,) + (1,) * (len(axis_names) - 1))
+        device_array = np.asarray(devices)
+        mesh_shape = _infer_mesh_shape(mesh_shape, device_array.size)
+        if mesh_shape is not None:
+            if len(mesh_shape) != len(axis_names):
+                raise ValueError(
+                    "mesh_shape {} does not match axis_names {}.".format(
+                        mesh_shape, axis_names))
+            device_array = device_array.reshape(mesh_shape)
+        else:
+            device_array = device_array.reshape(
+                (device_array.size,) + (1,) * (len(axis_names) - 1))
 
     mesh = Mesh(device_array, axis_names)
     _context = DistributionContext(strategy, mesh)
     logger.info("cloud_tpu runtime initialized: %r", _context)
     return _context
+
+
+def _infer_mesh_shape(mesh_shape, total):
+    """Resolves one -1 entry against `total` devices (env-contract
+    layouts like "dp:-1,tp:2" leave the data axis inferred)."""
+    if mesh_shape is None or -1 not in mesh_shape:
+        return mesh_shape
+    known = 1
+    for dim in mesh_shape:
+        if dim != -1:
+            known *= dim
+    if known <= 0 or mesh_shape.count(-1) != 1 or total % known:
+        raise ValueError(
+            "Cannot infer mesh_shape {} for {} devices.".format(
+                mesh_shape, total))
+    return tuple(total // known if d == -1 else d for d in mesh_shape)
+
+
+def _group_by_slice(devices):
+    """Devices grouped by TPU slice.
+
+    Real multi-slice platforms expose `slice_index` per device; when
+    absent (CPU simulation, single slice), CLOUD_TPU_NUM_SLICES splits
+    the flat list into contiguous equal chunks so the layout logic can
+    be exercised anywhere.
+    """
+    groups = {}
+    for d in devices:
+        idx = getattr(d, "slice_index", None)
+        if idx is None:
+            break
+        groups.setdefault(idx, []).append(d)
+    else:
+        if len(groups) > 1:
+            return [groups[k] for k in sorted(groups)]
+    n = int(os.environ.get("CLOUD_TPU_NUM_SLICES", "1"))
+    if n <= 1:
+        return [list(devices)]
+    if len(devices) % n:
+        raise ValueError(
+            "CLOUD_TPU_NUM_SLICES={} does not divide {} devices.".format(
+                n, len(devices)))
+    per = len(devices) // n
+    return [list(devices[i * per:(i + 1) * per]) for i in range(n)]
+
+
+def _hybrid_device_array(devices, axis_names, ici_shape, dcn_shape):
+    """DCN x ICI hybrid mesh layout (the multi-slice analogue of
+    jax.experimental.mesh_utils.create_hybrid_device_mesh, built
+    directly from the slice grouping so it also works on simulated
+    slices).
+
+    Each mesh axis k has size dcn[k] * ici[k]; devices are arranged so
+    that moving along an axis inside one ICI block stays within a
+    slice (fast ICI hops) and the dcn factor strides across slices
+    (DCN hops). With the default dcn = (num_slices, 1, ...), dp spans
+    slices and every other axis is slice-local.
+    """
+    import numpy as np
+
+    groups = _group_by_slice(devices)
+    num_slices = len(groups)
+    per_slice = len(groups[0])
+    if any(len(g) != per_slice for g in groups):
+        raise ValueError("Slices are unequal: {}.".format(
+            [len(g) for g in groups]))
+    rank = len(axis_names)
+    if dcn_shape is None:
+        dcn_shape = (num_slices,) + (1,) * (rank - 1)
+    if len(dcn_shape) != rank:
+        raise ValueError(
+            "dcn_mesh_shape {} does not match axis_names {}.".format(
+                dcn_shape, axis_names))
+    dcn_total = int(np.prod(dcn_shape))
+    if dcn_total != num_slices:
+        raise ValueError(
+            "dcn_mesh_shape {} needs {} slices; found {}.".format(
+                dcn_shape, dcn_total, num_slices))
+    if ici_shape is None:
+        ici_shape = (per_slice,) + (1,) * (rank - 1)
+    if len(ici_shape) != rank:
+        raise ValueError(
+            "mesh_shape {} does not match axis_names {}.".format(
+                ici_shape, axis_names))
+    # Env-contract layouts leave one dim inferred ("dp:-1,tp:2"); for
+    # multi_slice the per-slice device count is the inference base.
+    ici_shape = _infer_mesh_shape(tuple(ici_shape), per_slice)
+    if int(np.prod(ici_shape)) != per_slice:
+        raise ValueError(
+            "Per-slice mesh_shape {} needs {} devices; each slice has "
+            "{}.".format(ici_shape, int(np.prod(ici_shape)), per_slice))
+
+    # [dcn0, dcn1, ..., ici0, ici1, ...] -> interleave -> combined.
+    arr = np.array([np.array(g).reshape(ici_shape) for g in groups])
+    arr = arr.reshape(tuple(dcn_shape) + tuple(ici_shape))
+    order = []
+    for k in range(rank):
+        order.extend([k, rank + k])
+    arr = np.transpose(arr, order)
+    return arr.reshape(tuple(d * i for d, i in zip(dcn_shape, ici_shape)))
 
 
 def _maybe_init_distributed(coordinator_address, num_processes, process_id):

@@ -139,3 +139,67 @@ class TestMeshEnvContract:
                                    devices=jax.devices()[:8])
         finally:
             runtime.reset()
+
+
+class TestMultiSlice:
+    """DCN x ICI hybrid mesh: slices simulated via CLOUD_TPU_NUM_SLICES
+    (real platforms group by the devices' slice_index)."""
+
+    def test_default_layout_dp_spans_slices(self, monkeypatch):
+        import jax
+
+        monkeypatch.setenv("CLOUD_TPU_NUM_SLICES", "2")
+        ctx = runtime.initialize(strategy="multi_slice",
+                                 axis_names=("dp", "tp"),
+                                 mesh_shape=(2, 2))
+        # 2 slices x (2, 2) per slice -> dp = 4, tp = 2.
+        assert dict(ctx.mesh.shape) == {"dp": 4, "tp": 2}
+        devs = ctx.mesh.devices
+        flat = list(jax.devices())
+        slice_of = {d: (0 if flat.index(d) < 4 else 1) for d in flat}
+        # tp rows never cross a slice boundary (tp collectives stay on
+        # ICI) ...
+        for row in range(4):
+            assert len({slice_of[d] for d in devs[row]}) == 1
+        # ... while dp strides across slices (gradient all-reduce rides
+        # DCN between slice blocks).
+        for col in range(2):
+            assert {slice_of[d] for d in devs[:, col]} == {0, 1}
+
+    def test_explicit_dcn_shape_validated(self, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_NUM_SLICES", "2")
+        with pytest.raises(ValueError, match="slices"):
+            runtime.initialize(strategy="multi_slice",
+                               axis_names=("dp",),
+                               dcn_mesh_shape=(4,))
+
+    def test_training_matches_flat_mesh(self, monkeypatch):
+        import numpy as np
+        import optax
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import Trainer
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=64).astype(np.int32)
+
+        def run(**init_kwargs):
+            runtime.reset()
+            runtime.initialize(**init_kwargs)
+            t = Trainer(MLP(hidden=16, num_classes=4),
+                        optimizer=optax.adam(1e-2), seed=0)
+            return t.fit(x, y, epochs=2, batch_size=32, shuffle=False,
+                         verbose=False)["loss"]
+
+        monkeypatch.setenv("CLOUD_TPU_NUM_SLICES", "2")
+        a = run(strategy="multi_slice", axis_names=("dp",))
+        b = run(strategy="tpu_slice", axis_names=("dp",))
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    def test_env_contract_inferred_dim(self, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_NUM_SLICES", "2")
+        monkeypatch.setenv("CLOUD_TPU_MESH", "dp:-1,tp:2")
+        ctx = runtime.initialize(strategy="multi_slice")
+        # Per-slice (-1, 2) infers to (2, 2); x2 slices on dp -> (4, 2).
+        assert dict(ctx.mesh.shape) == {"dp": 4, "tp": 2}
