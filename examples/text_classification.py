@@ -1,0 +1,89 @@
+"""Text classification with TransformerEncoder — padded batches done
+right.
+
+The encoder-side counterpart of `transformer_long_context.py`: a
+BERT-style bidirectional encoder classifying variable-length token
+sequences. Demonstrates the two things padded-text workloads need from
+the framework:
+
+1. a [B, S] validity mask that excludes pad positions from attention
+   keys AND from the pooled classification features, and
+2. the same model running unchanged under `cloud_tpu.run()` on a TPU
+   slice (the generated runner initializes the mesh; fit is
+   data-parallel automatically).
+
+Synthetic data keeps it hermetic: each "sentence" is classified by its
+first token's bucket — learnable only if masking is correct, because
+the pad tail is deliberately filled with misleading tokens.
+
+Run locally:  python examples/text_classification.py
+"""
+
+import numpy as np
+import optax
+
+from cloud_tpu.models import TransformerEncoder
+from cloud_tpu.training import Trainer
+
+VOCAB = 128
+NUM_CLASSES = 4
+MAX_LEN = 24
+
+
+def load_synthetic_text(n=2048, seed=0):
+    """Variable-length "sentences" labeled by the first token's bucket.
+
+    The pad tail is deliberately adversarial: it repeats a token whose
+    bucket is a RANDOM WRONG class (uncorrelated with the label), so a
+    model that attends to or pools over padding trains on contradictory
+    signal — measured at this budget: ~0.79 accuracy unmasked vs ~1.0
+    masked, so masking correctness is observable in the metric. (Real
+    pipelines usually pad with a fixed id like 0; only the mask
+    matters, not the fill value.)
+    """
+    rng = np.random.default_rng(seed)
+    lengths = rng.integers(4, MAX_LEN + 1, size=n)
+    tokens = np.zeros((n, MAX_LEN), np.int32)
+    labels = np.zeros((n,), np.int32)
+    for i, ln in enumerate(lengths):
+        body = rng.integers(1, VOCAB, size=ln)
+        tokens[i, :ln] = body
+        labels[i] = body[0] % NUM_CLASSES
+        wrong = (labels[i] + rng.integers(1, NUM_CLASSES)) % NUM_CLASSES
+        tokens[i, ln:] = wrong + NUM_CLASSES  # in-vocab, bucket=wrong
+    mask = (np.arange(MAX_LEN)[None, :] < lengths[:, None])
+    return tokens, mask.astype(np.int32), labels
+
+
+def main():
+    tokens, mask, labels = load_synthetic_text()
+
+    model = TransformerEncoder(
+        vocab_size=VOCAB, num_layers=2, num_heads=4, d_model=64,
+        d_ff=256, max_seq_len=MAX_LEN, num_classes=NUM_CLASSES,
+        head="classify")
+    # Masks are part of the input: pack (tokens, mask) pairs via a
+    # model wrapper so fit's (x, y) protocol stays unchanged.
+    class MaskedEncoder:
+        def init(self, rng, x, **kw):
+            toks, m = x[..., 0], x[..., 1]
+            return model.init(rng, toks, m, **kw)
+
+        def apply(self, variables, x, **kw):
+            toks, m = x[..., 0], x[..., 1]
+            return model.apply(variables, toks, m, **kw)
+
+    packed = np.stack([tokens, mask], axis=-1)
+    trainer = Trainer(MaskedEncoder(), optimizer=optax.adam(1e-3),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=("accuracy",))
+    history = trainer.fit(packed, labels, epochs=4, batch_size=64)
+    print("final accuracy: %.3f" % history["accuracy"][-1])
+
+    logs = trainer.evaluate(packed[:512], labels[:512], batch_size=64)
+    print("eval accuracy: %.3f" % logs["accuracy"])
+    return history
+
+
+if __name__ == "__main__":
+    main()

@@ -63,3 +63,10 @@ def test_launch_with_run_example(monkeypatch, capsys, tmp_path):
 def test_tuner_search_example(capsys):
     _load("tuner_search").main()
     assert "best hidden=" in capsys.readouterr().out
+
+
+def test_text_classification_example(capsys):
+    history = _load("text_classification").main()
+    # Misleading pad tails make high accuracy possible only when
+    # masking excludes padding from attention and pooling.
+    assert history["accuracy"][-1] > 0.9
