@@ -2,5 +2,5 @@ from cloud_tpu.training.callbacks import (Callback, EarlyStopping,
                                           LambdaCallback, MetricsLogger,
                                           ModelCheckpoint, read_metrics_log)
 from cloud_tpu.training.data import (ArrayDataset, GeneratorDataset,
-                                     prefetch_to_device)
+                                     ThreadedDataset, prefetch_to_device)
 from cloud_tpu.training.trainer import Trainer, TrainState

@@ -161,6 +161,96 @@ class GeneratorDataset:
         return iter(self.factory())
 
 
+class ThreadedDataset:
+    """Pulls a wrapped dataset on a background thread through a bounded
+    queue — the host-side complement of `prefetch_to_device`.
+
+    Device prefetch overlaps the host->HBM copy with compute; this
+    overlaps producing the batches themselves (augmentation, decoding,
+    a slow generator) with training. Wrap any dataset/iterable whose
+    per-batch host work is non-trivial:
+
+        ds = ThreadedDataset(GeneratorDataset(factory), buffer_size=4)
+        trainer.fit(ds, ...)
+
+    Semantics: batch order is preserved; producer exceptions re-raise
+    in the consumer; abandoning iteration mid-epoch (steps_per_epoch,
+    early break) stops the producer thread promptly. `steps_per_epoch`
+    and evaluate's exactness attributes are forwarded from the wrapped
+    dataset.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, dataset, buffer_size=4):
+        if buffer_size < 1:
+            raise ValueError("buffer_size must be >= 1.")
+        if hasattr(dataset, "__next__"):
+            raise TypeError(
+                "ThreadedDataset needs a re-iterable (multi-epoch "
+                "training re-iterates per epoch; a one-shot iterator "
+                "would be silently empty after epoch 1). Wrap the "
+                "source in GeneratorDataset(factory) instead.")
+        self.dataset = dataset
+        self.buffer_size = buffer_size
+        for attr in ("steps_per_epoch", "num_examples", "batch_size"):
+            value = getattr(dataset, attr, None)
+            if value is not None:
+                setattr(self, attr, value)
+
+    def __iter__(self):
+        return self._threaded(self.dataset)
+
+    def process_local_view(self):
+        """Threaded iteration over the wrapped dataset's process-local
+        shard — forwards the multi-host protocol (Trainer dispatches on
+        this method) so wrapping an ArrayDataset keeps pod sharding."""
+        return self._threaded(self.dataset.process_local_view())
+
+    def _threaded(self, source):
+        import queue as queue_lib
+        import threading
+
+        q = queue_lib.Queue(maxsize=self.buffer_size)
+        stop = threading.Event()
+
+        def _put(item):
+            """put() that gives up when the consumer is gone."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue_lib.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for item in source:
+                    if not _put((None, item)):
+                        return
+                _put((None, self._SENTINEL))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                _put((e, None))
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        try:
+            while True:
+                err, item = q.get()
+                if err is not None:
+                    raise err
+                if item is self._SENTINEL:
+                    return
+                yield item
+        finally:
+            # Deterministic shutdown: signal, then join — an abandoned
+            # epoch (steps_per_epoch break) must not leave a producer
+            # racing the next epoch's thread over the inner dataset.
+            stop.set()
+            thread.join(timeout=5.0)
+
+
 def prefetch_to_device(iterator, size=2, sharding=None, feed=None,
                        limit=None):
     """Wraps a host batch iterator with device read-ahead.

@@ -482,9 +482,14 @@ class Trainer:
         return sharding_lib.shard_batch(batch, self._mesh)
 
     def _epoch_batches(self, dataset):
-        """One epoch of host batches, process-local on multi-host pods."""
-        if (isinstance(dataset, data_lib.ArrayDataset)
-                and jax.process_count() > 1):
+        """One epoch of host batches, process-local on multi-host pods.
+
+        Dispatch on the protocol, not the class: ArrayDataset provides
+        `process_local_view`, and wrappers (ThreadedDataset) forward it,
+        so pod sharding survives wrapping.
+        """
+        if (jax.process_count() > 1
+                and hasattr(dataset, "process_local_view")):
             return dataset.process_local_view()
         return iter(dataset)
 
