@@ -1,0 +1,79 @@
+"""ResNet50 throughput sweep: batch size x stem variant on one chip.
+
+Finds the best operating point for the flagship metric (bench.py,
+BASELINE.md config 2) by running the bench worker across a grid. Each
+point runs in its own bounded subprocess (the tunneled backend can hang
+— a stuck point must not take the sweep down), emits one JSON line, and
+the sweep ends with a summary line naming the best config and how to
+pin it (BENCH_BATCH / BENCH_S2D env for bench.py).
+
+Usage: python benchmarks/sweep.py [--batches 128,256,512] [--s2d 0,1]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(_REPO_ROOT, "bench.py")
+
+
+def run_point(batch, s2d, timeout):
+    env = dict(
+        os.environ,
+        BENCH_BATCH=str(batch),
+        BENCH_S2D=str(s2d),
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, BENCH, "--worker"], capture_output=True,
+            text=True, timeout=timeout, env=env, cwd=_REPO_ROOT)
+    except subprocess.TimeoutExpired:
+        return {"batch": batch, "s2d": s2d,
+                "error": "hung past {:.0f}s".format(timeout)}
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                record = json.loads(line)
+                record.update({"batch": batch, "s2d": s2d})
+                return record
+            except ValueError:
+                break
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"batch": batch, "s2d": s2d,
+            "error": tail[-1] if tail else "rc={}".format(proc.returncode)}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batches", default="128,256,512")
+    parser.add_argument("--s2d", default="0,1")
+    parser.add_argument("--timeout", type=float, default=480.0)
+    args = parser.parse_args(argv)
+
+    best = None
+    for s2d in [int(v) for v in args.s2d.split(",")]:
+        for batch in [int(v) for v in args.batches.split(",")]:
+            record = run_point(batch, s2d, args.timeout)
+            print(json.dumps(record), flush=True)
+            if "error" not in record and (
+                    best is None or record["value"] > best["value"]):
+                best = record
+    if best is None:
+        print(json.dumps({"sweep": "failed",
+                          "hint": "backend unreachable for every point"}))
+        return 1
+    print(json.dumps({
+        "sweep": "best",
+        "value": best["value"],
+        "unit": best.get("unit", "images/sec"),
+        "pin": {"BENCH_BATCH": best["batch"], "BENCH_S2D": best["s2d"]},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

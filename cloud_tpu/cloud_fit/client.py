@@ -103,6 +103,7 @@ def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
         "remat": trainer.remat,
         "zero1": trainer.zero1,
         "fsdp": trainer.fsdp,
+        "ema_decay": trainer.ema_decay,
     }
     storage.write_bytes(storage.join(remote_dir, SPEC_FILE),
                         pickle.dumps(spec))

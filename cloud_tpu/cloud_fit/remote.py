@@ -53,6 +53,7 @@ def build_trainer(spec, mesh=None):
         remat=spec.get("remat", False),
         zero1=spec.get("zero1", False),
         fsdp=spec.get("fsdp", False),
+        ema_decay=spec.get("ema_decay"),
     )
 
 

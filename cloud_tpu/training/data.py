@@ -201,11 +201,16 @@ class ThreadedDataset:
     def __iter__(self):
         return self._threaded(self.dataset)
 
-    def process_local_view(self):
-        """Threaded iteration over the wrapped dataset's process-local
-        shard — forwards the multi-host protocol (Trainer dispatches on
-        this method) so wrapping an ArrayDataset keeps pod sharding."""
-        return self._threaded(self.dataset.process_local_view())
+    def __getattr__(self, name):
+        # Forward the multi-host protocol ONLY when the wrapped dataset
+        # provides it: Trainer dispatches on hasattr(process_local_view),
+        # and an unconditional method would make wrapping a plain
+        # GeneratorDataset crash on pods instead of iterating normally.
+        if name == "process_local_view" and hasattr(
+                self.dataset, "process_local_view"):
+            return lambda *a, **k: self._threaded(
+                self.dataset.process_local_view(*a, **k))
+        raise AttributeError(name)
 
     def _threaded(self, source):
         import queue as queue_lib

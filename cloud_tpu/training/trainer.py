@@ -107,6 +107,47 @@ def _emit_runtime_metrics(steps, examples, elapsed_secs):
         logger.debug("metric emission failed", exc_info=True)
 
 
+import typing
+
+
+class ParamEmaState(typing.NamedTuple):
+    """EMA shadow of the parameters.
+
+    A DISTINCT node type (not a bare params-shaped subtree) so
+    Trainer.build can recognize it structurally and keep the shadow in
+    the PARAMETER layout — eval/predict substitute it straight into the
+    params slot, so it must not pick up the ZeRO moment layout.
+    """
+    ema: typing.Any
+
+
+def _param_ema(decay):
+    """optax transform tracking an EMA of the PARAMETERS.
+
+    Chained AFTER the base optimizer: update() sees the pre-update
+    params and the final updates, reconstructs the post-update params,
+    and folds them into the shadow.
+    """
+
+    def init(params):
+        # A REAL copy: jnp.asarray would alias the live param buffers,
+        # and aliased leaves break the train step's state donation
+        # (same buffer donated twice).
+        return ParamEmaState(ema=jax.tree_util.tree_map(
+            lambda p: jnp.array(p, copy=True), params))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("param_ema requires params in update().")
+        new_params = optax.apply_updates(params, updates)
+        ema = jax.tree_util.tree_map(
+            lambda e, p: decay * e + (1.0 - decay) * p,
+            state.ema, new_params)
+        return updates, ParamEmaState(ema=ema)
+
+    return optax.GradientTransformation(init, update)
+
+
 class TrainState:
     """Step + params + optimizer state + auxiliary model variables
     (e.g. flax batch_stats), registered as a pytree."""
@@ -150,7 +191,8 @@ class Trainer:
                  gradient_accumulation_steps=1,
                  remat=False,
                  zero1=False,
-                 fsdp=False):
+                 fsdp=False,
+                 ema_decay=None):
         """Constructor.
 
         Args:
@@ -194,6 +236,12 @@ class Trainer:
                 all-gathers weights at use and reduce-scatters grads.
                 Implies the zero1 moment layout (moments follow their
                 params). No-op without a mesh or a >1-sized "dp" axis.
+            ema_decay: Track an exponential moving average of the
+                parameters (e.g. 0.999): `ema_params` exposes the
+                shadow, and evaluate/predict take `use_ema=True` to
+                run on it — the standard eval-quality lever for vision
+                and diffusion training. The shadow lives in optimizer
+                state (checkpointed, sharded like the params).
         """
         if hasattr(model, "init") and hasattr(model, "apply"):
             self._init_fn = model.init
@@ -212,6 +260,16 @@ class Trainer:
 
         if isinstance(optimizer, str):
             optimizer = OPTIMIZERS[optimizer]()
+        self.ema_decay = ema_decay
+        if ema_decay is not None:
+            if not 0.0 < ema_decay < 1.0:
+                raise ValueError(
+                    "ema_decay must be in (0, 1); got {}.".format(
+                        ema_decay))
+            # Chained before any MultiSteps wrap so the shadow folds in
+            # applied updates (zero updates on accumulation micro-steps
+            # just decay toward unchanged params — harmless smoothing).
+            optimizer = optax.chain(optimizer, _param_ema(ema_decay))
         self.gradient_accumulation_steps = int(gradient_accumulation_steps)
         if self.gradient_accumulation_steps > 1:
             optimizer = optax.MultiSteps(
@@ -303,9 +361,16 @@ class Trainer:
                     params, param_sharding, self._mesh)
 
             def _is_params_shaped(node):
-                return jax.tree_util.tree_structure(node) == param_struct
+                return (isinstance(node, ParamEmaState)
+                        or jax.tree_util.tree_structure(node)
+                        == param_struct)
 
             def _subtree_sharding(node):
+                if isinstance(node, ParamEmaState):
+                    # The EMA shadow substitutes into the params slot at
+                    # eval time, so it keeps the PARAM layout even under
+                    # zero1 moment sharding.
+                    return ParamEmaState(ema=param_sharding)
                 if _is_params_shaped(node):
                     return moment_sharding
                 return jax.tree_util.tree_map(
@@ -641,6 +706,26 @@ class Trainer:
             if self.stop_training:
                 break
 
+    @property
+    def ema_params(self):
+        """The EMA shadow parameters (requires `ema_decay=`)."""
+        if self.ema_decay is None:
+            raise RuntimeError(
+                "No EMA is tracked; construct Trainer(ema_decay=...).")
+        if self.state is None:
+            raise RuntimeError("Model is not built; call fit() first.")
+        opt_state = self.state.opt_state
+        if self.gradient_accumulation_steps > 1:
+            opt_state = opt_state.inner_opt_state
+        return opt_state[-1].ema
+
+    def _eval_state(self, use_ema):
+        if not use_ema:
+            return self.state
+        s = self.state
+        return TrainState(s.step, self.ema_params, s.opt_state, s.rng,
+                          s.extra_vars)
+
     def save_checkpoint(self, directory, use_async=False):
         """Saves the full train state under `<directory>/<step>` (local
         or gs://). Keras `model.save` parity at the state level; pair
@@ -666,7 +751,7 @@ class Trainer:
         return self.state
 
     def evaluate(self, x, y=None, batch_size=32, verbose=True,
-                 steps=None, prefetch=2):
+                 steps=None, prefetch=2, use_ema=False):
         """Returns exact example-weighted mean loss/metrics.
 
         Tail batches are padded by wrapping (never dropped) so shapes
@@ -725,9 +810,10 @@ class Trainer:
         feeder = data_lib.prefetch_to_device(
             masked_batches(), size=prefetch,
             feed=lambda item: (item[0], self._feed(item[1])))
+        eval_state = self._eval_state(use_ema)
         totals, weight = {}, 0.0
         for real, fed in feeder:
-            logs = self._jit_eval_step(self.state, fed)
+            logs = self._jit_eval_step(eval_state, fed)
             weight += real
             for k, v in logs.items():
                 # Device-side accumulation: no host sync per batch (one
@@ -756,7 +842,7 @@ class Trainer:
             in_shardings=(self._state_sharding,
                           sharding_lib.batch_sharding(self._mesh)))
 
-    def predict(self, x, batch_size=32, prefetch=2):
+    def predict(self, x, batch_size=32, prefetch=2, use_ema=False):
         """Returns stacked model outputs for `x`.
 
         Jitted and prefetched like fit/evaluate: batches stream to
@@ -776,8 +862,9 @@ class Trainer:
         # holding more than two batches of outputs in HBM.
         outs = []
         pending = None
+        predict_state = self._eval_state(use_ema)
         for xb in feeder:
-            out = self._jit_predict_step(self.state, xb)
+            out = self._jit_predict_step(predict_state, xb)
             if pending is not None:
                 outs.append(np.asarray(pending))
             pending = out

@@ -319,3 +319,9 @@ class TestThreadedDataset:
         want = [np.asarray(b[4:]) for b in inner]
         assert all((g == w).all() for g, w in zip(got, want))
         assert len(got) == len(want)
+
+    def test_no_pod_protocol_for_plain_generators(self):
+        from cloud_tpu.training import GeneratorDataset, ThreadedDataset
+
+        ds = ThreadedDataset(GeneratorDataset(lambda: iter(())))
+        assert not hasattr(ds, "process_local_view")
