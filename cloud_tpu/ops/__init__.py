@@ -3,5 +3,8 @@
 from cloud_tpu.ops.attention import attention
 from cloud_tpu.ops.attention import flash_attention
 from cloud_tpu.ops.attention import mha_reference
+from cloud_tpu.ops.fused_ce import lm_head_loss
+from cloud_tpu.ops.fused_ce import lm_head_loss_reference
 
-__all__ = ["attention", "flash_attention", "mha_reference"]
+__all__ = ["attention", "flash_attention", "mha_reference",
+           "lm_head_loss", "lm_head_loss_reference"]

@@ -1,0 +1,162 @@
+"""Chunked LM-head cross-entropy: loss without materializing logits.
+
+For a decoder LM the [B, S, V] logits tensor is usually the single
+largest array in the step: batch 8 x seq 2048 x vocab 256k in f32 is
+16 GiB — more than a v5e chip's HBM — while the loss itself only needs
+one logsumexp and one gathered label logit per token. This op fuses the
+output projection with the softmax cross-entropy, scanning the vocab in
+chunks:
+
+    forward:  per chunk c: logits_c = h @ W[:, c]  (an MXU matmul),
+              folded into a running online logsumexp + the label logit
+              for labels that land in the chunk. Peak extra memory is
+              one [N, chunk] block.
+    backward: recompute logits_c per chunk, form p_c = exp(logits_c -
+              lse), accumulate dh += (p_c - onehot) @ W[:, c]^T and
+              dW[:, c] = h^T (p_c - onehot), scaled by the cotangent.
+              Same [N, chunk] peak; dW is the same size as W (it is the
+              gradient).
+
+The scan is `lax.scan` over chunk indices with `dynamic_slice` into W,
+so XLA compiles one chunk program — compile time and HBM stay flat as
+V grows. Numerics: accumulation in f32 regardless of input dtype
+(matching optax.softmax_cross_entropy_with_integer_labels on the same
+values).
+
+No reference counterpart (the reference delegates losses to Keras);
+this is TPU-first design for the long-context/big-vocab regime the
+framework's TransformerLM targets.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _num_chunks(vocab, chunk):
+    return -(-vocab // chunk)
+
+
+def _chunk_logits(hidden, weights, start, chunk, vocab):
+    """f32 logits for one vocab chunk of the PADDED weights; columns at
+    or beyond the TRUE vocab (zero pad columns would otherwise leak
+    exp(0) terms into the logsumexp) are masked to -inf."""
+    w_c = lax.dynamic_slice(weights, (0, start),
+                            (weights.shape[0], chunk))
+    logits = jnp.einsum("nd,dc->nc", hidden, w_c,
+                        preferred_element_type=jnp.float32)
+    col = start + jnp.arange(chunk)
+    return jnp.where(col[None, :] < vocab, logits, _NEG_INF), w_c, col
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def lm_head_loss(hidden, weights, labels, chunk=8192):
+    """Per-token softmax cross-entropy of `hidden @ weights` vs labels.
+
+    Args:
+        hidden: [N, D] (flatten batch/seq dims first) activations.
+        weights: [D, V] output projection (no bias).
+        labels: [N] int32 target ids. Ids in [0, V) contribute their
+            cross-entropy; ids OUTSIDE that range (e.g. the common -1
+            ignore-index for padded tokens) produce loss 0 and zero
+            gradient for that position — unlike the materializing optax
+            oracle, which clips out-of-range gathers.
+        chunk: vocab tile width (static); peak extra memory is one
+            [N, chunk] f32 block. V is padded up internally.
+
+    Returns:
+        [N] f32 per-token losses — identical (to f32 numerics) to
+        `optax.softmax_cross_entropy_with_integer_labels(h @ W, labels)`
+        for in-range labels, 0 for ignored positions.
+    """
+    loss, _ = _forward(hidden, weights, labels, chunk)
+    return loss
+
+
+def _forward(hidden, weights, labels, chunk):
+    n = hidden.shape[0]
+    vocab = weights.shape[1]
+    chunk = min(chunk, vocab)
+    num_chunks = _num_chunks(vocab, chunk)
+    pad = num_chunks * chunk - vocab
+    if pad:
+        weights = jnp.pad(weights, ((0, 0), (0, pad)))
+
+    def step(carry, idx):
+        m, s, label_logit = carry
+        logits, _, col = _chunk_logits(hidden, weights, idx * chunk,
+                                       chunk, vocab)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        hit = (labels[:, None] == col[None, :])
+        label_logit = label_logit + jnp.sum(
+            jnp.where(hit, logits, 0.0), axis=-1)
+        return (m_new, s, label_logit), None
+
+    init = (jnp.full((n,), _NEG_INF, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, label_logit), _ = lax.scan(step, init,
+                                      jnp.arange(num_chunks))
+    lse = m + jnp.log(s)
+    # Ignore-index semantics: out-of-range labels (padding convention
+    # -1) carry zero loss instead of a garbage `lse - 0` value.
+    valid = (labels >= 0) & (labels < vocab)
+    return jnp.where(valid, lse - label_logit, 0.0), lse
+
+
+def _fwd(hidden, weights, labels, chunk):
+    loss, lse = _forward(hidden, weights, labels, chunk)
+    return loss, (hidden, weights, labels, lse)
+
+
+def _bwd(chunk, residuals, g):
+    hidden, weights, labels, lse = residuals
+    vocab = weights.shape[1]
+    chunk = min(chunk, vocab)
+    num_chunks = _num_chunks(vocab, chunk)
+    pad = num_chunks * chunk - vocab
+    w_padded = jnp.pad(weights, ((0, 0), (0, pad))) if pad else weights
+    # Ignored positions (out-of-range labels) have zero cotangent: no
+    # gradient flows from them, matching their zero loss.
+    valid = (labels >= 0) & (labels < vocab)
+    g = g.astype(jnp.float32) * valid.astype(jnp.float32)
+
+    def step(carry, idx):
+        dh, dw = carry
+        start = idx * chunk
+        logits, w_c, col = _chunk_logits(hidden, w_padded, start, chunk,
+                                         vocab)
+        p = jnp.exp(logits - lse[:, None])  # [N, C]; 0 for masked cols
+        onehot = (labels[:, None] == col[None, :]).astype(jnp.float32)
+        dlogits = (p - onehot) * g[:, None]
+        dh = dh + jnp.einsum("nc,dc->nd", dlogits, w_c,
+                             preferred_element_type=jnp.float32)
+        dw_c = jnp.einsum("nd,nc->dc", hidden.astype(jnp.float32),
+                          dlogits, preferred_element_type=jnp.float32)
+        dw = lax.dynamic_update_slice(dw, dw_c, (0, start))
+        return (dh, dw), None
+
+    init = (jnp.zeros(hidden.shape, jnp.float32),
+            jnp.zeros(w_padded.shape, jnp.float32))
+    (dh, dw), _ = lax.scan(step, init, jnp.arange(num_chunks))
+    if pad:
+        dw = dw[:, :vocab]
+    return (dh.astype(hidden.dtype), dw.astype(weights.dtype), None)
+
+
+lm_head_loss.defvjp(_fwd, _bwd)
+
+
+def lm_head_loss_reference(hidden, weights, labels):
+    """Naive oracle: materializes the full logits."""
+    import optax
+
+    logits = jnp.einsum("nd,dv->nv", hidden, weights,
+                        preferred_element_type=jnp.float32)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
