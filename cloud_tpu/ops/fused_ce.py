@@ -41,16 +41,27 @@ def _num_chunks(vocab, chunk):
     return -(-vocab // chunk)
 
 
-def _chunk_logits(hidden, weights, start, chunk, vocab):
-    """f32 logits for one vocab chunk of the PADDED weights; columns at
-    or beyond the TRUE vocab (zero pad columns would otherwise leak
-    exp(0) terms into the logsumexp) are masked to -inf."""
+def _chunk_logits(hidden, weights, idx, chunk):
+    """f32 logits for vocab chunk `idx`, sliced straight from W.
+
+    No padded copy of W is ever made (padding would materialize a
+    second [D, V] array — the very memory this op exists to avoid).
+    Instead the slice start is clamped so the final chunk ends at V;
+    columns the previous chunk already covered (the overlap a clamped
+    start creates when chunk does not divide V) are masked out of the
+    logsumexp/label accounting via `keep`.
+    """
+    vocab = weights.shape[1]
+    unclamped = idx * chunk
+    start = jnp.minimum(unclamped, vocab - chunk)
     w_c = lax.dynamic_slice(weights, (0, start),
                             (weights.shape[0], chunk))
     logits = jnp.einsum("nd,dc->nc", hidden, w_c,
                         preferred_element_type=jnp.float32)
     col = start + jnp.arange(chunk)
-    return jnp.where(col[None, :] < vocab, logits, _NEG_INF), w_c, col
+    keep = col >= unclamped
+    return jnp.where(keep[None, :], logits, _NEG_INF), w_c, col, keep, \
+        start
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -66,7 +77,8 @@ def lm_head_loss(hidden, weights, labels, chunk=8192):
             gradient for that position — unlike the materializing optax
             oracle, which clips out-of-range gathers.
         chunk: vocab tile width (static); peak extra memory is one
-            [N, chunk] f32 block. V is padded up internally.
+            [N, chunk] f32 block. W is never copied/padded — the final
+            chunk's slice is clamped and its overlap masked.
 
     Returns:
         [N] f32 per-token losses — identical (to f32 numerics) to
@@ -82,18 +94,15 @@ def _forward(hidden, weights, labels, chunk):
     vocab = weights.shape[1]
     chunk = min(chunk, vocab)
     num_chunks = _num_chunks(vocab, chunk)
-    pad = num_chunks * chunk - vocab
-    if pad:
-        weights = jnp.pad(weights, ((0, 0), (0, pad)))
 
     def step(carry, idx):
         m, s, label_logit = carry
-        logits, _, col = _chunk_logits(hidden, weights, idx * chunk,
-                                       chunk, vocab)
+        logits, _, col, keep, _ = _chunk_logits(hidden, weights, idx,
+                                                chunk)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.sum(
             jnp.exp(logits - m_new[:, None]), axis=-1)
-        hit = (labels[:, None] == col[None, :])
+        hit = (labels[:, None] == col[None, :]) & keep[None, :]
         label_logit = label_logit + jnp.sum(
             jnp.where(hit, logits, 0.0), axis=-1)
         return (m_new, s, label_logit), None
@@ -120,8 +129,6 @@ def _bwd(chunk, residuals, g):
     vocab = weights.shape[1]
     chunk = min(chunk, vocab)
     num_chunks = _num_chunks(vocab, chunk)
-    pad = num_chunks * chunk - vocab
-    w_padded = jnp.pad(weights, ((0, 0), (0, pad))) if pad else weights
     # Ignored positions (out-of-range labels) have zero cotangent: no
     # gradient flows from them, matching their zero loss.
     valid = (labels >= 0) & (labels < vocab)
@@ -129,24 +136,27 @@ def _bwd(chunk, residuals, g):
 
     def step(carry, idx):
         dh, dw = carry
-        start = idx * chunk
-        logits, w_c, col = _chunk_logits(hidden, w_padded, start, chunk,
-                                         vocab)
-        p = jnp.exp(logits - lse[:, None])  # [N, C]; 0 for masked cols
-        onehot = (labels[:, None] == col[None, :]).astype(jnp.float32)
+        logits, w_c, col, keep, start = _chunk_logits(hidden, weights,
+                                                      idx, chunk)
+        p = jnp.exp(logits - lse[:, None])  # 0 for overlap-masked cols
+        onehot = ((labels[:, None] == col[None, :])
+                  & keep[None, :]).astype(jnp.float32)
         dlogits = (p - onehot) * g[:, None]
         dh = dh + jnp.einsum("nc,dc->nd", dlogits, w_c,
                              preferred_element_type=jnp.float32)
-        dw_c = jnp.einsum("nd,nc->dc", hidden.astype(jnp.float32),
-                          dlogits, preferred_element_type=jnp.float32)
-        dw = lax.dynamic_update_slice(dw, dw_c, (0, start))
+        dw_c = jnp.einsum("nd,nc->dc", hidden, dlogits,
+                          preferred_element_type=jnp.float32)
+        # Accumulate (read-add-write): a clamped final chunk overlaps
+        # the previous one, and its masked columns carry dlogits == 0 —
+        # a plain update_slice would zero the overlap's earlier grads.
+        prev = lax.dynamic_slice(dw, (0, start),
+                                 (dw.shape[0], chunk))
+        dw = lax.dynamic_update_slice(dw, prev + dw_c, (0, start))
         return (dh, dw), None
 
     init = (jnp.zeros(hidden.shape, jnp.float32),
-            jnp.zeros(w_padded.shape, jnp.float32))
+            jnp.zeros(weights.shape, jnp.float32))
     (dh, dw), _ = lax.scan(step, init, jnp.arange(num_chunks))
-    if pad:
-        dw = dw[:, :vocab]
     return (dh.astype(hidden.dtype), dw.astype(weights.dtype), None)
 
 

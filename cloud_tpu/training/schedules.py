@@ -1,0 +1,56 @@
+"""Learning-rate schedule helpers.
+
+Thin, named constructors over optax schedules for the patterns TPU
+training actually uses (the reference leaves schedules to Keras; these
+are the optax-native equivalents). Every helper returns an optax
+schedule — pass it as the learning rate of any optax optimizer:
+
+    tx = optax.adamw(schedules.warmup_cosine(3e-4, total_steps=10_000))
+    Trainer(model, optimizer=tx, ...)
+"""
+
+import optax
+
+
+def warmup_cosine(peak_lr, total_steps, warmup_steps=None, end_lr=0.0):
+    """Linear warmup to `peak_lr`, cosine decay to `end_lr`.
+
+    The default LLM/vision pretraining shape. `warmup_steps` defaults
+    to 10% of `total_steps`.
+    """
+    if warmup_steps is None:
+        warmup_steps = max(total_steps // 10, 1)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak_lr,
+        warmup_steps=warmup_steps, decay_steps=total_steps,
+        end_value=end_lr)
+
+
+def warmup_linear(peak_lr, total_steps, warmup_steps=None, end_lr=0.0):
+    """Linear warmup then linear decay — the BERT fine-tuning shape."""
+    if warmup_steps is None:
+        warmup_steps = max(total_steps // 10, 1)
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak_lr, warmup_steps),
+         optax.linear_schedule(peak_lr, end_lr,
+                               max(total_steps - warmup_steps, 1))],
+        boundaries=[warmup_steps])
+
+
+def inverse_sqrt(peak_lr, warmup_steps=1000):
+    """Noam/Transformer schedule: linear warmup, then 1/sqrt(step)."""
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        s = jnp.asarray(step, jnp.float32) + 1.0
+        warm = peak_lr * s / warmup_steps
+        decay = peak_lr * (warmup_steps ** 0.5) / jnp.sqrt(s)
+        return jnp.minimum(warm, decay)
+
+    return schedule
+
+
+def constant(lr):
+    """A constant schedule (symmetry with the named shapes)."""
+    return optax.constant_schedule(lr)

@@ -52,6 +52,27 @@ def _mse(preds, targets):
                     axis=tuple(range(1, preds.ndim)))
 
 
+def sparse_categorical_crossentropy(label_smoothing=0.0):
+    """Loss factory: integer-label softmax CE with label smoothing.
+
+    smoothing=0 is the registry default; >0 mixes the one-hot target
+    with the uniform distribution (Keras `label_smoothing=` parity).
+    """
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError("label_smoothing must be in [0, 1); got "
+                         "{}.".format(label_smoothing))
+    if not label_smoothing:
+        return _sparse_categorical_crossentropy
+
+    def loss(logits, labels):
+        num_classes = logits.shape[-1]
+        smoothed = optax.smooth_labels(
+            jax.nn.one_hot(labels, num_classes), label_smoothing)
+        return optax.softmax_cross_entropy(logits, smoothed)
+
+    return loss
+
+
 LOSSES = {
     "sparse_categorical_crossentropy": _sparse_categorical_crossentropy,
     "categorical_crossentropy": _categorical_crossentropy,
@@ -279,6 +300,15 @@ class Trainer:
         self.zero1 = bool(zero1)
         self.fsdp = bool(fsdp)
 
+        if loss is sparse_categorical_crossentropy:
+            # The FACTORY, not a loss: Keras muscle memory makes
+            # `loss=sparse_categorical_crossentropy` an easy slip that
+            # would otherwise fail with an arity error deep inside the
+            # jitted step.
+            raise TypeError(
+                "sparse_categorical_crossentropy is a factory — call it "
+                "(e.g. loss=sparse_categorical_crossentropy(0.1)) or "
+                "use the string 'sparse_categorical_crossentropy'.")
         self.loss_fn = LOSSES[loss] if isinstance(loss, str) else loss
         self.metric_fns = {}
         for m in metrics:

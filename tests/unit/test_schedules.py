@@ -1,0 +1,89 @@
+"""LR schedule helpers + label-smoothing loss factory."""
+
+import numpy as np
+import optax
+import pytest
+
+from cloud_tpu.models import MLP
+from cloud_tpu.training import (Trainer, schedules,
+                                sparse_categorical_crossentropy)
+
+
+class TestSchedules:
+
+    def test_warmup_cosine_shape(self):
+        s = schedules.warmup_cosine(1.0, total_steps=100,
+                                    warmup_steps=10)
+        assert float(s(0)) == 0.0
+        assert float(s(10)) == pytest.approx(1.0)
+        assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+        assert float(s(55)) < 1.0
+
+    def test_warmup_linear_shape(self):
+        s = schedules.warmup_linear(2.0, total_steps=100,
+                                    warmup_steps=20)
+        assert float(s(0)) == 0.0
+        assert float(s(20)) == pytest.approx(2.0)
+        assert float(s(60)) == pytest.approx(1.0)
+        assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+
+    def test_inverse_sqrt_shape(self):
+        s = schedules.inverse_sqrt(1.0, warmup_steps=100)
+        assert float(s(9)) == pytest.approx(0.1)
+        assert float(s(99)) == pytest.approx(1.0)
+        # decays ~1/sqrt beyond warmup
+        assert float(s(399)) == pytest.approx(0.5, rel=1e-3)
+
+    def test_trains_with_trainer(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(128, 8)).astype(np.float32)
+        y = rng.integers(0, 4, 128).astype(np.int32)
+        tx = optax.adam(schedules.warmup_cosine(1e-2, total_steps=8))
+        t = Trainer(MLP(hidden=16, num_classes=4), optimizer=tx)
+        h = t.fit(x, y, epochs=2, batch_size=64, verbose=False)
+        assert np.isfinite(h["loss"][-1])
+
+
+class TestLabelSmoothing:
+
+    def test_zero_smoothing_is_registry_loss(self):
+        from cloud_tpu.training.trainer import (
+            _sparse_categorical_crossentropy)
+
+        assert (sparse_categorical_crossentropy(0.0)
+                is _sparse_categorical_crossentropy)
+
+    def test_smoothing_matches_hand_formula(self):
+        import jax.numpy as jnp
+
+        logits = jnp.asarray(np.random.default_rng(0).normal(
+            size=(8, 5)), jnp.float32)
+        labels = jnp.asarray(np.arange(8) % 5, jnp.int32)
+        eps = 0.2
+        got = sparse_categorical_crossentropy(eps)(logits, labels)
+        logp = np.asarray(jnp.log(jnp.exp(logits) /
+                                  jnp.sum(jnp.exp(logits), -1,
+                                          keepdims=True)))
+        target = np.full((8, 5), eps / 5)
+        target[np.arange(8), np.asarray(labels)] += 1 - eps
+        want = -(target * logp).sum(-1)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="label_smoothing"):
+            sparse_categorical_crossentropy(1.0)
+
+    def test_trains(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(128, 8)).astype(np.float32)
+        y = rng.integers(0, 4, 128).astype(np.int32)
+        t = Trainer(MLP(hidden=16, num_classes=4),
+                    loss=sparse_categorical_crossentropy(0.1),
+                    metrics=("accuracy",))
+        h = t.fit(x, y, epochs=2, batch_size=64, verbose=False)
+        assert np.isfinite(h["loss"][-1])
+
+    def test_factory_passed_directly_rejected(self):
+        with pytest.raises(TypeError, match="factory"):
+            Trainer(MLP(hidden=8, num_classes=4),
+                    loss=sparse_categorical_crossentropy)
