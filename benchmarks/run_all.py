@@ -289,9 +289,47 @@ def config7_ring_attention():
             "shape": [B, H, S, D], "sp": sp}
 
 
+def config8_ulysses_attention():
+    """Ulysses (all-to-all) sequence parallelism on the same shape as
+    config 7, so ring vs Ulysses is a direct row-to-row comparison.
+
+    Like config 7, the collectives are memcpys on the virtual CPU mesh;
+    on real ICI the all-to-all cost model (O(1) rounds vs ring's n-1
+    rotations) is what this row exists to measure.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.ops.attention import mha_reference
+    from cloud_tpu.parallel import runtime, ulysses_attention
+
+    runtime.reset()
+    n = len(jax.devices())
+    sp = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    runtime.initialize(strategy="tpu_slice",
+                       axis_names=("dp", "sp"),
+                       mesh_shape=(n // sp, sp))
+    on_tpu = jax.default_backend() == "tpu"
+    B, H, S, D = (2, 8, 8192, 64) if on_tpu else (2, 4, 1024, 32)
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.bfloat16)
+               for _ in range(3))
+
+    uly = _timed(lambda q, k, v: ulysses_attention(
+        q, k, v, causal=True), q, k, v)
+    ref = _timed(lambda q, k, v: mha_reference(q, k, v, causal=True),
+                 q, k, v)
+    runtime.reset()
+    return {"metric": "ulysses_attention_sp%d_ms" % sp,
+            "value": round(uly * 1e3, 2), "unit": "ms",
+            "single_device_reference_ms": round(ref * 1e3, 2),
+            "shape": [B, H, S, D], "sp": sp}
+
+
 CONFIGS = {1: config1_mnist, 2: config2_resnet50, 3: config3_dp_pod_shape,
            4: config4_tuner_loop, 5: config5_ctl,
-           6: config6_flash_attention, 7: config7_ring_attention}
+           6: config6_flash_attention, 7: config7_ring_attention,
+           8: config8_ulysses_attention}
 
 
 def main(argv):

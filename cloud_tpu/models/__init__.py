@@ -1,3 +1,5 @@
+from cloud_tpu.models.llama import (GQAttention, LlamaLM,
+                                    llama_tensor_parallel_rules)
 from cloud_tpu.models.mnist import MLP, ConvNet
 from cloud_tpu.models.resnet import (ResNet, ResNet18, ResNet34, ResNet50,
                                      ResNet101, ResNet152)

@@ -1,0 +1,273 @@
+"""Llama-family decoder LM: RMSNorm + RoPE + SwiGLU + grouped-query attention.
+
+The modern-LLM counterpart of `TransformerLM` (which is GPT-2-shaped:
+LayerNorm, learned positions, GELU, full MHA). No reference equivalent —
+the reference stops at Keras models (SURVEY §0) — but a complete TPU
+framework needs the architecture family that today's open checkpoints
+(Llama/Mistral/Gemma-style) actually use:
+
+- **RMSNorm** instead of LayerNorm: one fewer HBM pass (no mean
+  subtraction / bias), fuses into the adjacent matmul under XLA.
+- **Rotary position embeddings** instead of a learned table: positions
+  are a closed-form rotation of q/k, so the KV cache carries them for
+  free and long-context extension is a theta change, not a re-train.
+- **SwiGLU MLP**: two column-parallel input projections (gate, up) and
+  one row-parallel output — same two-collective Megatron layout as the
+  GELU MLP, expressed in `llama_tensor_parallel_rules`.
+- **GQA**: `num_kv_heads < num_heads` shrinks the KV cache (the decode
+  memory bound) by H/H_kv while the q heads keep full MXU tiles. K/V
+  are broadcast to the q-head grouping only at the attention op, never
+  stored expanded.
+
+`LlamaLM` keeps `TransformerLM`'s module contract (same attribute
+names, same "cache" collection shape conventions), so `generate()` —
+the jitted prefill + `lax.scan` decode loop in
+`cloud_tpu/models/transformer.py` — drives it unchanged.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary position embedding over the last (head_dim) axis.
+
+    x: [B, S, H, D] (D even); positions: [S] or [B, S] int32.
+    Returns x with each (even, odd) feature pair rotated by
+    pos * theta^(-2i/D) — f32 rotation math regardless of input dtype
+    (bf16 angles at position ~10k would quantize to whole radians).
+    """
+    head_dim = x.shape[-1]
+    if head_dim % 2:
+        raise ValueError("RoPE needs an even head_dim; got %d." % head_dim)
+    freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                      / head_dim)
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                        axis=-1).reshape(x.shape)
+    return rotated.astype(x.dtype)
+
+
+# Re-exported from ops (canonical home; the parallel layer uses it too
+# without importing the models package).
+from cloud_tpu.ops.attention import repeat_kv  # noqa: E402,F401
+
+
+class GQAttention(nn.Module):
+    """Grouped-query attention with RoPE and an H_kv-sized decode cache."""
+
+    num_heads: int
+    num_kv_heads: int
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"  # auto | flash | reference | ring
+    rope_theta: float = 10000.0
+    decode: bool = False
+    cache_len: int = 0
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        from cloud_tpu import ops
+
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, use_bias=False, dtype=self.compute_dtype,
+            name=name)
+        q = dense((self.num_heads, head_dim), "query")(x)
+        k = dense((self.num_kv_heads, head_dim), "key")(x)
+        v = dense((self.num_kv_heads, head_dim), "value")(x)
+
+        if self.decode:
+            if mask is not None:
+                raise NotImplementedError(
+                    "decode mode does not take a padding mask; left-pad "
+                    "prompts or decode per example.")
+            out = self._decode_attention(q, k, v)
+        else:
+            positions = jnp.arange(x.shape[1])
+            q = apply_rope(q, positions, self.rope_theta)
+            k = apply_rope(k, positions, self.rope_theta)
+            if self.attention_impl in SEQUENCE_PARALLEL_IMPLS:
+                # RoPE composes with sequence parallelism for free: the
+                # rotation above ran on the *global* [B, S, H, D] arrays
+                # (traced shapes under jit are global), so every shard
+                # carries its true absolute positions into the SP path.
+                # K/V stay at H_kv width: ulysses exchanges them grouped
+                # (when H_kv divides sp), ring expands internally.
+                from cloud_tpu.parallel import sp_attention
+                out = sp_attention(self.attention_impl, q, k, v,
+                                   causal=True, mask=mask)
+            else:
+                # flash/reference take the grouped H_kv layout natively.
+                out = ops.attention(q, k, v, causal=True, mask=mask,
+                                    impl=self.attention_impl)
+        out = out.astype(self.compute_dtype)
+        return nn.DenseGeneral(d_model, axis=(-2, -1), use_bias=False,
+                               dtype=self.compute_dtype, name="out")(out)
+
+    def _decode_attention(self, q, k, v):
+        """KV-cache attention at H_kv width (the point of GQA: the cache
+        is num_heads/num_kv_heads times smaller than MHA's).
+
+        Mirrors `CausalSelfAttention._decode_attention`
+        (transformer.py): one path serves prefill (whole prompt, index
+        0) and per-token steps (S=1); RoPE angles use absolute cache
+        positions so decode continues the training-time rotation.
+        """
+        import jax.lax as lax
+
+        batch, seq, _, head_dim = q.shape
+        if not self.cache_len:
+            raise ValueError("decode=True needs cache_len > 0.")
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (batch, self.cache_len, self.num_kv_heads, head_dim),
+            self.compute_dtype)
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (batch, self.cache_len, self.num_kv_heads, head_dim),
+            self.compute_dtype)
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+
+        idx = index.value
+        positions = idx + jnp.arange(seq)
+        q = apply_rope(q, positions, self.rope_theta)
+        k = apply_rope(k, positions, self.rope_theta)
+
+        cached_k.value = lax.dynamic_update_slice(
+            cached_k.value, k.astype(self.compute_dtype), (0, idx, 0, 0))
+        cached_v.value = lax.dynamic_update_slice(
+            cached_v.value, v.astype(self.compute_dtype), (0, idx, 0, 0))
+        index.value = idx + seq
+
+        key_positions = jnp.arange(self.cache_len)
+        allowed = key_positions[None, :] <= positions[:, None]  # [S, L]
+        scale = 1.0 / np.sqrt(head_dim)
+        group = self.num_heads // self.num_kv_heads
+        # Grouped einsum: q reshaped [B,S,H_kv,G,D] attends its own kv
+        # head — no materialized repeat of the cache.
+        qg = q.reshape(batch, seq, self.num_kv_heads, group, head_dim)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, cached_k.value,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(allowed[None, None, None], logits, -1e30)
+        weights = nn.softmax(logits, axis=-1).astype(self.compute_dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, cached_v.value)
+        return out.reshape(batch, seq, self.num_heads, head_dim)
+
+
+class SwiGLU(nn.Module):
+    """Gated MLP: down(silu(gate(x)) * up(x)), all bias-free."""
+
+    d_ff: int
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        gate = nn.Dense(self.d_ff, use_bias=False,
+                        dtype=self.compute_dtype, name="gate")(x)
+        up = nn.Dense(self.d_ff, use_bias=False,
+                      dtype=self.compute_dtype, name="up")(x)
+        return nn.Dense(x.shape[-1], use_bias=False,
+                        dtype=self.compute_dtype,
+                        name="down")(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    d_ff: int
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+    rope_theta: float = 10000.0
+    dropout_rate: float = 0.0
+    decode: bool = False
+    cache_len: int = 0
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        y = nn.RMSNorm(dtype=self.compute_dtype, name="norm_attn")(x)
+        y = GQAttention(self.num_heads, self.num_kv_heads,
+                        self.compute_dtype, self.attention_impl,
+                        self.rope_theta, decode=self.decode,
+                        cache_len=self.cache_len, name="attention")(y, mask)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        x = x + y
+        y = nn.RMSNorm(dtype=self.compute_dtype, name="norm_mlp")(x)
+        y = SwiGLU(self.d_ff, self.compute_dtype, name="mlp")(y)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        return x + y
+
+
+class LlamaLM(nn.Module):
+    """Llama-style decoder-only LM.
+
+    Drop-in peer of `TransformerLM` for Trainer / `generate()` /
+    checkpointing; differs in the block recipe (RMSNorm, RoPE, SwiGLU,
+    GQA) and in having no learned position table.
+    """
+
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # None -> num_heads (full MHA)
+    d_model: int = 512
+    d_ff: int = 1408  # ~2/3 * 4 * d_model, the SwiGLU convention
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dropout_rate: float = 0.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, mask=None, deterministic=True):
+        seq = tokens.shape[1]
+        if seq > self.max_seq_len:
+            raise ValueError(
+                "Sequence length {} exceeds max_seq_len {}.".format(
+                    seq, self.max_seq_len))
+        num_kv = self.num_kv_heads or self.num_heads
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     dtype=self.compute_dtype, name="embed")(tokens)
+        for i in range(self.num_layers):
+            x = LlamaBlock(self.num_heads, num_kv, self.d_ff,
+                           self.compute_dtype, self.attention_impl,
+                           self.rope_theta, self.dropout_rate,
+                           decode=self.decode,
+                           cache_len=self.max_seq_len,
+                           name="block_%d" % i)(x, mask, deterministic)
+        x = nn.RMSNorm(dtype=self.compute_dtype, name="norm_final")(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False,
+                          dtype=self.compute_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def llama_tensor_parallel_rules(tp_axis: str = "tp"):
+    """Megatron layout for LlamaLM: same two-collective-per-block shape
+    as `tensor_parallel_rules` (transformer.py), with SwiGLU's gate/up
+    both column-parallel and kv projections head-sharded (requires
+    num_kv_heads % tp == 0)."""
+    return [
+        (r"attention/(query|key|value)/kernel", P(None, tp_axis, None)),
+        (r"attention/out/kernel", P(tp_axis, None, None)),
+        (r"mlp/(gate|up)/kernel", P(None, tp_axis)),
+        (r"mlp/down/kernel", P(tp_axis, None)),
+        (r"(^|/)embed/embedding", P(tp_axis, None)),
+        (r"lm_head/kernel", P(None, tp_axis)),
+    ]

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
+
 
 class CausalSelfAttention(nn.Module):
     num_heads: int
@@ -50,16 +52,15 @@ class CausalSelfAttention(nn.Module):
                     "decode mode does not take a padding mask; left-pad "
                     "prompts or decode per example.")
             out = self._decode_attention(q, k, v)
-        elif self.attention_impl == "ring":
-            # Sequence-parallel long-context path: the sequence dim is
-            # sharded over the ambient mesh's "sp" axis and K/V rotate
-            # around the ring (cloud_tpu/parallel/ring_attention.py).
-            from cloud_tpu.parallel import sequence_parallel_attention
-            if mask is not None:
-                raise NotImplementedError(
-                    "ring attention does not take a padding mask.")
-            out = sequence_parallel_attention(q, k, v,
-                                              causal=self.causal)
+        elif self.attention_impl in SEQUENCE_PARALLEL_IMPLS:
+            # Sequence-parallel long-context paths over the mesh's "sp"
+            # axis: "ring" rotates K/V around a ppermute ring
+            # (parallel/ring_attention.py); "ulysses" all-to-alls into
+            # head-sharded full-sequence layout and runs the flash
+            # kernel (parallel/ulysses.py).
+            from cloud_tpu.parallel import sp_attention
+            out = sp_attention(self.attention_impl, q, k, v,
+                               causal=self.causal, mask=mask)
         else:
             # "auto" uses the Pallas flash kernel on TPU, the jnp
             # reference elsewhere; direction follows self.causal
@@ -318,10 +319,10 @@ def generate(model,
     """
     import jax
 
-    if model.attention_impl == "ring":
+    if model.attention_impl in SEQUENCE_PARALLEL_IMPLS:
         raise NotImplementedError(
-            "generate() decodes on a single mesh shard; use a non-ring "
-            "attention_impl for inference.")
+            "generate() decodes on a single mesh shard; use a "
+            "non-sequence-parallel attention_impl for inference.")
     batch, prompt_len = prompt.shape
     if max_new_tokens < 0:
         raise ValueError("max_new_tokens must be >= 0; got {}.".format(

@@ -46,20 +46,50 @@ class _Config(NamedTuple):
     block_q: int
     block_k: int
     kv_len: int  # true (unpadded) sequence length
-    heads: int   # folded into the grid's leading batch*heads dim
+    heads: int   # q heads, folded into the grid's leading batch*heads dim
     has_mask: bool  # per-example key mask streamed as [B, S_pad] blocks
     interpret: bool
+    kv_group: int = 1  # q heads per kv head (grouped-query attention)
+
+
+def repeat_kv(k, num_heads):
+    """Broadcast [B, S, H_kv, D] key/value heads to num_heads groups.
+
+    GQA's compute-side expansion: each kv head serves
+    num_heads // H_kv query heads. Prefer passing H_kv-width k/v
+    straight to `flash_attention`/`mha_reference` (both take the
+    grouped layout natively); this helper is for paths that need the
+    materialized expansion (e.g. sharding heads across a mesh axis).
+    """
+    h_kv = k.shape[2]
+    if num_heads == h_kv:
+        return k
+    if num_heads % h_kv:
+        raise ValueError(
+            "num_heads=%d must be a multiple of num_kv_heads=%d."
+            % (num_heads, h_kv))
+    return jnp.repeat(k, num_heads // h_kv, axis=2)
 
 
 def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None):
     """Pure-jnp multi-head attention, layout [B, S, H, D].
 
     The correctness oracle for the kernel and the fallback path for
-    shapes/backends the kernel does not cover.
+    shapes/backends the kernel does not cover. Grouped-query attention:
+    k/v may carry H_kv < H heads (H divisible by H_kv); they are
+    broadcast to the q-head grouping here.
     """
     head_dim = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
+    if k.shape[2] != q.shape[2]:
+        heads, h_kv = q.shape[2], k.shape[2]
+        if heads % h_kv:
+            raise ValueError(
+                "q heads {} must be a multiple of kv heads {}.".format(
+                    heads, h_kv))
+        k = jnp.repeat(k, heads // h_kv, axis=2)
+        v = jnp.repeat(v, heads // h_kv, axis=2)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
     logits = logits.astype(jnp.float32)
     seq_q, seq_k = q.shape[1], k.shape[1]
@@ -152,11 +182,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 
 def _mask_spec(config, transposed=False):
     """BlockSpec for the [B, S_pad] key-mask: one (1, block_k) strip per
-    k-block, indexed by the example this (batch*head) program serves."""
+    k-block, indexed by the example this program serves."""
     heads = config.heads
-    if transposed:  # dk/dv grid order: (b, j, i)
+    if transposed:  # dk/dv grid: (b over B*H_kv, j, t)
+        heads_kv = config.heads // config.kv_group
         return pl.BlockSpec((1, config.block_k),
-                            lambda b, j, i: (b // heads, j))
+                            lambda b, j, t: (b // heads_kv, j))
     return pl.BlockSpec((1, config.block_k),
                         lambda b, i, j: (b // heads, j))
 
@@ -172,21 +203,27 @@ def _maybe_mask(config, kernel):
 
 
 def _flash_forward(config, q, k, v, kmask):
-    """q/k/v: [BH, S_pad, D]; kmask: [B, S_pad] int32 or None ->
-    (out [BH, S_pad, D], lse [BH, S_pad, 128])."""
+    """q: [B*H, S_pad, D]; k/v: [B*H_kv, S_pad, D] (H_kv = H/kv_group);
+    kmask: [B, S_pad] int32 or None ->
+    (out [B*H, S_pad, D], lse [B*H, S_pad, 128]).
+
+    GQA streams each kv head's blocks to its group of q-head programs
+    via the index map (b // kv_group) — the H-wide expansion is never
+    materialized in HBM."""
     bh, seq, head_dim = q.shape
     num_q = seq // config.block_q
     num_k = seq // config.block_k
     grid = (bh, num_q, num_k)
+    group = config.kv_group
     kernel = _maybe_mask(
         config, functools.partial(_fwd_kernel, config=config, num_k=num_k))
     in_specs = [
         pl.BlockSpec((1, config.block_q, head_dim),
                      lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, config.block_k, head_dim),
-                     lambda b, i, j: (b, j, 0)),
+                     lambda b, i, j: (b // group, j, 0)),
         pl.BlockSpec((1, config.block_k, head_dim),
-                     lambda b, i, j: (b, j, 0)),
+                     lambda b, i, j: (b // group, j, 0)),
     ]
     inputs = [q, k, v]
     if config.has_mask:
@@ -271,10 +308,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
                  dk_ref, dv_ref, dk_acc, dv_acc, *, config, num_q):
+    """Grid (B*H_kv, num_k, kv_group*num_q): each kv head's dk/dv block
+    accumulates over every q block of every q head in its group — the
+    GQA sum over the group happens in the same VMEM accumulator that
+    already sums over q blocks. t decomposes as g*num_q + i."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    t = pl.program_id(2)
+    qi = jax.lax.rem(t, num_q)
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -306,7 +348,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     else:
         _step()
 
-    @pl.when(qi == num_q - 1)
+    @pl.when(t == config.kv_group * num_q - 1)
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -314,8 +356,10 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(config, q, k, v, kmask, out, lse, g):
     bh, seq, head_dim = q.shape
+    bh_kv = k.shape[0]
     num_q = seq // config.block_q
     num_k = seq // config.block_k
+    group = config.kv_group
 
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (bh, seq, _LANES))
@@ -325,7 +369,7 @@ def _flash_backward(config, q, k, v, kmask, out, lse, g):
     row_spec = pl.BlockSpec((1, config.block_q, _LANES),
                             lambda b, i, j: (b, i, 0))
     k_spec = pl.BlockSpec((1, config.block_k, head_dim),
-                          lambda b, i, j: (b, j, 0))
+                          lambda b, i, j: (b // group, j, 0))
 
     in_specs = [q_spec, k_spec, k_spec]
     inputs = [q, k, v]
@@ -345,20 +389,25 @@ def _flash_backward(config, q, k, v, kmask, out, lse, g):
         interpret=config.interpret,
     )(*inputs, g, lse, delta)[0]
 
-    # dk/dv: k-blocks are the outer (parallel) dim, q-blocks innermost.
-    qT_spec = pl.BlockSpec((1, config.block_q, head_dim),
-                           lambda b, j, i: (b, i, 0))
-    rowT_spec = pl.BlockSpec((1, config.block_q, _LANES),
-                             lambda b, j, i: (b, i, 0))
+    # dk/dv: one program per kv head and k-block; the innermost dim t
+    # fuses (group, q_blocks) so the group sum lands in the accumulator
+    # (see _dkdv_kernel). Index maps lift t -> (q head b*group + t//num_q,
+    # q block t%num_q).
+    qT_spec = pl.BlockSpec(
+        (1, config.block_q, head_dim),
+        lambda b, j, t: (b * group + t // num_q, t % num_q, 0))
+    rowT_spec = pl.BlockSpec(
+        (1, config.block_q, _LANES),
+        lambda b, j, t: (b * group + t // num_q, t % num_q, 0))
     kT_spec = pl.BlockSpec((1, config.block_k, head_dim),
-                           lambda b, j, i: (b, j, 0))
+                           lambda b, j, t: (b, j, 0))
     inT_specs = [qT_spec, kT_spec, kT_spec]
     if config.has_mask:
         inT_specs.append(_mask_spec(config, transposed=True))
     dk, dv = pl.pallas_call(
         _maybe_mask(config, functools.partial(
             _dkdv_kernel, config=config, num_q=num_q)),
-        grid=(bh, num_k, num_q),
+        grid=(bh_kv, num_k, group * num_q),
         in_specs=inT_specs + [qT_spec, rowT_spec, rowT_spec],
         out_specs=[kT_spec, kT_spec],
         out_shape=[
@@ -429,7 +478,11 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
 
     Args:
         q, k, v: [B, S, H, D] arrays (any float dtype; compute is f32 on
-            the MXU, output in the input dtype).
+            the MXU, output in the input dtype). Grouped-query
+            attention: k/v may carry H_kv < H heads (H divisible by
+            H_kv) — each kv head serves H/H_kv consecutive q heads, and
+            the kernel streams kv blocks per group instead of
+            materializing the H-wide expansion in HBM.
         causal: Apply a causal (autoregressive) mask.
         sm_scale: Softmax temperature; default 1/sqrt(D).
         mask: Optional [B, S] boolean key mask (True = attend). The
@@ -447,6 +500,14 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
         [B, S, H, D] attention output, differentiable w.r.t. q/k/v.
     """
     batch, seq, heads, head_dim = q.shape
+    h_kv = k.shape[2]
+    if v.shape != k.shape:
+        raise ValueError("k and v must have identical shapes; got "
+                         "{} vs {}.".format(k.shape, v.shape))
+    if heads % h_kv:
+        raise ValueError(
+            "q heads {} must be a multiple of kv heads {}.".format(
+                heads, h_kv))
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
     if interpret is None:
@@ -464,11 +525,13 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
     config = _Config(causal=bool(causal), sm_scale=float(sm_scale),
                      block_q=block_q, block_k=block_k, kv_len=seq,
                      heads=heads, has_mask=mask is not None,
-                     interpret=bool(interpret))
+                     interpret=bool(interpret),
+                     kv_group=heads // h_kv)
 
     def fold(x):
+        n_heads = x.shape[2]
         x = jnp.transpose(x, (0, 2, 1, 3)).reshape(
-            batch * heads, seq, head_dim)
+            batch * n_heads, seq, head_dim)
         if seq_pad != seq:
             x = jnp.pad(x, ((0, 0), (0, seq_pad - seq), (0, 0)))
         return x

@@ -15,6 +15,33 @@ from cloud_tpu.parallel import sharding
 from cloud_tpu.parallel.pipeline import pipeline_apply
 from cloud_tpu.parallel.ring_attention import ring_attention
 from cloud_tpu.parallel.ring_attention import sequence_parallel_attention
+from cloud_tpu.parallel.ulysses import ulysses_attention, ulysses_local
+
+# The names model code dispatches on (transformer/llama attention_impl).
+SEQUENCE_PARALLEL_IMPLS = ("ring", "ulysses")
+
+
+def sp_attention(impl, q, k, v, causal=True, mask=None):
+    """Sequence-parallel attention dispatch, shared by every model.
+
+    One place owns the impl-name set and the padding-mask contract so
+    the model families can't drift apart. Both impls accept GQA
+    (k/v with H_kv < H heads): ulysses exchanges at H_kv width when it
+    divides the sp axis; ring expands to H before rotating.
+    """
+    if mask is not None:
+        raise NotImplementedError(
+            "sequence-parallel attention does not take a padding mask.")
+    if impl == "ring":
+        return sequence_parallel_attention(q, k, v, causal=causal)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, causal=causal)
+    raise ValueError(
+        "Unknown sequence-parallel impl {!r}; expected one of {}.".format(
+            impl, SEQUENCE_PARALLEL_IMPLS))
+
 
 __all__ = ["runtime", "sharding", "pipeline_apply",
-           "ring_attention", "sequence_parallel_attention"]
+           "ring_attention", "sequence_parallel_attention",
+           "ulysses_attention", "ulysses_local",
+           "SEQUENCE_PARALLEL_IMPLS", "sp_attention"]

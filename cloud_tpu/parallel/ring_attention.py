@@ -184,6 +184,15 @@ def sequence_parallel_attention(q, k, v, mesh=None, axis="sp", causal=True,
 
     from cloud_tpu.parallel import sharding as _sharding_resolve
 
+    if k.shape[2] != q.shape[2]:
+        # GQA: the ring rotates K/V at full q-head width (no native
+        # grouped path yet — the per-chunk einsums assume matching
+        # heads), so expand before sharding. Ulysses keeps H_kv width;
+        # prefer it when kv heads divide the sp axis.
+        from cloud_tpu.ops.attention import repeat_kv
+        k = repeat_kv(k, q.shape[2])
+        v = repeat_kv(v, q.shape[2])
+
     mesh = _sharding_resolve._resolve_mesh(mesh)
     if axis not in mesh.axis_names:
         raise ValueError(

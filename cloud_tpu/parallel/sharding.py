@@ -21,11 +21,43 @@ MODEL_AXIS = "tp"
 SEQUENCE_AXIS = "sp"
 
 
+def _active_context_mesh():
+    """The mesh of an enclosing `with Mesh(...)` block, if any.
+
+    The legacy-but-idiomatic `with Mesh(devices, axes):` context sets a
+    thread-local physical mesh that `jax.sharding` doesn't expose
+    publicly; read it through the internal module (stable across the
+    jax versions this repo supports; `jax.interpreters.pxla` re-exports
+    it with a deprecation warning, so go to the source)."""
+    try:
+        from jax._src import mesh as _mesh_lib
+        m = _mesh_lib.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        # A jax upgrade moved the internal: don't silently ignore the
+        # user's `with Mesh(...)` block — say why it can't be seen.
+        import warnings
+        warnings.warn(
+            "cloud_tpu: this jax version does not expose the active "
+            "Mesh context (jax._src.mesh.thread_resources); pass "
+            "`mesh=` explicitly or use runtime.initialize().",
+            RuntimeWarning, stacklevel=3)
+        return None
+    if m is not None and not m.empty:
+        return m
+    return None
+
+
 def _resolve_mesh(mesh=None):
-    mesh = mesh if mesh is not None else runtime.global_mesh()
+    """Explicit arg > enclosing `with Mesh(...)` context > ambient
+    runtime mesh — most-local wins, like variable scoping."""
+    if mesh is None:
+        mesh = _active_context_mesh()
+    if mesh is None:
+        mesh = runtime.global_mesh()
     if mesh is None:
         raise RuntimeError(
-            "No mesh: pass `mesh=` or initialize the ambient runtime "
+            "No mesh: pass `mesh=`, enter a `with Mesh(...)` block, or "
+            "initialize the ambient runtime "
             "(cloud_tpu.parallel.runtime.initialize).")
     return mesh
 

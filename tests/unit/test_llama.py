@@ -1,0 +1,224 @@
+"""Llama model family: RoPE, GQA, SwiGLU, decode cache, TP sharding.
+
+Correctness oracles: RoPE's relative-position identity (closed form),
+GQA vs repeated-head full attention (algebraic equivalence), and the
+KV-cache greedy decode vs full-context recompute (cache is a pure
+layout optimization) — the same oracle style as test_generate.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from cloud_tpu.models import (LlamaLM, generate,
+                              llama_tensor_parallel_rules)
+from cloud_tpu.models.llama import apply_rope, repeat_kv
+from cloud_tpu.parallel import runtime
+from cloud_tpu.training import Trainer
+
+
+def _model(**kw):
+    defaults = dict(vocab_size=64, num_layers=2, num_heads=4,
+                    num_kv_heads=2, d_model=32, d_ff=48, max_seq_len=32,
+                    compute_dtype=jnp.float32)
+    defaults.update(kw)
+    return LlamaLM(**defaults)
+
+
+def _prompt(b=2, s=5, vocab=64, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, vocab, (b, s)), jnp.int32)
+
+
+class TestRope:
+
+    def test_norm_preserved(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 8, 4, 16)), jnp.float32)
+        y = apply_rope(x, jnp.arange(8))
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(y), axis=-1), rtol=1e-5)
+
+    def test_relative_position_identity(self):
+        """<rope(q, p), rope(k, p+d)> depends only on the offset d."""
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(1, 1, 1, 32)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 1, 1, 32)), jnp.float32)
+
+        def dot_at(p, d):
+            qr = apply_rope(q, jnp.array([p]))
+            kr = apply_rope(k, jnp.array([p + d]))
+            return float(jnp.sum(qr * kr))
+
+        for d in (0, 3, 17):
+            assert dot_at(0, d) == pytest.approx(dot_at(100, d), rel=1e-4)
+
+    def test_position_zero_is_identity(self):
+        x = jnp.asarray(np.random.default_rng(2).normal(
+            size=(1, 1, 2, 8)), jnp.float32)
+        np.testing.assert_allclose(np.asarray(apply_rope(x, jnp.zeros(
+            (1,), jnp.int32))), np.asarray(x), atol=1e-6)
+
+    def test_odd_head_dim_rejected(self):
+        with pytest.raises(ValueError, match="even"):
+            apply_rope(jnp.zeros((1, 1, 1, 7)), jnp.arange(1))
+
+
+class TestGQA:
+
+    def test_repeat_kv(self):
+        k = jnp.arange(2 * 3 * 2 * 4, dtype=jnp.float32).reshape(2, 3, 2, 4)
+        r = repeat_kv(k, 6)
+        assert r.shape == (2, 3, 6, 4)
+        # Head i of the expansion is kv head i // group.
+        np.testing.assert_array_equal(np.asarray(r[:, :, 0]),
+                                      np.asarray(r[:, :, 1]))
+        np.testing.assert_array_equal(np.asarray(r[:, :, 0]),
+                                      np.asarray(k[:, :, 0]))
+        assert repeat_kv(k, 2) is k
+        with pytest.raises(ValueError, match="multiple"):
+            repeat_kv(k, 5)
+
+    def test_full_mha_when_kv_equals_heads(self):
+        """num_kv_heads=None and num_kv_heads=num_heads are the same
+        model (identical param tree and outputs)."""
+        prompt = _prompt()
+        a = _model(num_kv_heads=None)
+        b = _model(num_kv_heads=4)
+        va = a.init(jax.random.PRNGKey(0), prompt)
+        out_a = a.apply(va, prompt)
+        out_b = b.apply(va, prompt)  # same tree shapes by construction
+        np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                                   atol=1e-6)
+
+    def test_cache_is_kv_sized(self):
+        """The decode cache must hold H_kv heads, not H — GQA's memory
+        win is the cache shrinkage."""
+        model = _model(decode=True)
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((2, 1), jnp.int32)))
+        cache = shapes["cache"]["block_0"]["attention"]["cached_key"]
+        assert cache.shape == (2, 32, 2, 32 // 4)  # [B, L, H_kv, D]
+
+
+class TestLlamaLM:
+
+    def test_forward_shape_and_finite(self):
+        model = _model()
+        prompt = _prompt()
+        out = model.apply(model.init(jax.random.PRNGKey(0), prompt), prompt)
+        assert out.shape == (2, 5, 64)
+        assert out.dtype == jnp.float32
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_no_learned_positions(self):
+        """RoPE replaces the position table: shifting token content
+        must change logits (positions matter), but there is no
+        pos_embed parameter to carry them."""
+        model = _model()
+        prompt = _prompt()
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        assert "pos_embed" not in params
+        rolled = jnp.roll(prompt, 1, axis=1)
+        out = model.apply({"params": params}, prompt)
+        out_r = model.apply({"params": params}, rolled)
+        assert not np.allclose(np.asarray(out), np.asarray(out_r))
+
+    def test_seq_len_guard(self):
+        model = _model(max_seq_len=4)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            model.init(jax.random.PRNGKey(0), _prompt(s=5))
+
+    def test_padding_mask_rejected_under_sp(self):
+        model = _model(attention_impl="ring")
+        prompt = _prompt(s=8)
+        mask = jnp.ones((2, 8), bool)
+        import jax as _jax
+        from jax.sharding import Mesh as _Mesh
+        devices = np.array(_jax.devices()[:2])
+        with _Mesh(devices, ("sp",)):
+            with pytest.raises(NotImplementedError, match="mask"):
+                model.init(_jax.random.PRNGKey(0), prompt, mask)
+
+    def test_trains(self):
+        model = _model()
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 64, size=(16, 8)).astype(np.int32)
+        targets = rng.integers(0, 64, size=(16, 8)).astype(np.int32)
+
+        def lm_loss(logits, labels):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean(axis=-1)
+
+        trainer = Trainer(model, optimizer=optax.adam(1e-2), loss=lm_loss,
+                          metrics=())
+        history = trainer.fit(tokens, targets, epochs=3, batch_size=16,
+                              shuffle=False, verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
+
+
+class TestLlamaDecode:
+
+    def test_greedy_matches_full_context_oracle(self):
+        """KV-cache decode (grouped einsum, H_kv cache, absolute-position
+        RoPE) must be token-identical to recomputing the full context."""
+        model = _model()
+        prompt = _prompt()
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        toks = generate(model, params, prompt, max_new_tokens=6,
+                        temperature=0)
+        cur = prompt
+        for _ in range(6):
+            logits = model.apply({"params": params}, cur)
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(cur))
+
+    def test_greedy_parity_bf16(self):
+        model = _model(compute_dtype=jnp.bfloat16)
+        prompt = _prompt()
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        toks = generate(model, params, prompt, max_new_tokens=4,
+                        temperature=0)
+        cur = prompt
+        for _ in range(4):
+            logits = model.apply({"params": params}, cur)
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(cur))
+
+
+class TestLlamaTensorParallel:
+
+    def test_tp_sharding_and_training(self):
+        runtime.initialize(strategy="tpu_slice", axis_names=("dp", "tp"),
+                           mesh_shape=(4, 2))
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+        targets = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
+
+        def lm_loss(logits, labels):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean(axis=-1)
+
+        # tp=2 divides num_kv_heads=2: kv kernels shard cleanly.
+        model = _model(compute_dtype=jnp.bfloat16)
+        trainer = Trainer(
+            model, optimizer=optax.adam(1e-2), loss=lm_loss, metrics=(),
+            param_sharding_rules=llama_tensor_parallel_rules("tp"))
+        history = trainer.fit(tokens, targets, epochs=2, batch_size=8,
+                              shuffle=False, verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
+
+        gate = trainer.state.params["block_0"]["mlp"]["gate"]["kernel"]
+        shard = next(iter(gate.addressable_shards))
+        assert shard.data.shape == (32, 48 // 2)
+        kproj = trainer.state.params["block_0"]["attention"]["key"]["kernel"]
+        kshard = next(iter(kproj.addressable_shards))
+        assert kshard.data.shape == (32, 2 // 2, 8)

@@ -179,3 +179,93 @@ def test_jit_wrapped():
     np.testing.assert_allclose(
         fn(q, k, v), mha_reference(q, k, v, causal=True),
         atol=TOL, rtol=TOL)
+
+
+# ---------------------------------------------------------------------------
+# Grouped-query attention (GQA): k/v with fewer heads than q
+# ---------------------------------------------------------------------------
+
+
+def _gqa_qkv(batch=2, seq=128, heads=4, kv_heads=2, head_dim=32, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(
+        rng.normal(size=(batch, seq, heads, head_dim)), jnp.float32)
+    k = jnp.asarray(
+        rng.normal(size=(batch, seq, kv_heads, head_dim)), jnp.float32)
+    v = jnp.asarray(
+        rng.normal(size=(batch, seq, kv_heads, head_dim)), jnp.float32)
+    return q, k, v
+
+
+def _expand(x, heads):
+    return jnp.repeat(x, heads // x.shape[2], axis=2)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_gqa_forward_matches_expanded(causal, kv_heads):
+    """Native GQA == explicitly repeating kv heads (MQA at kv_heads=1)."""
+    q, k, v = _gqa_qkv(kv_heads=kv_heads)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = mha_reference(q, _expand(k, 4), _expand(v, 4), causal=causal)
+    np.testing.assert_allclose(out, ref, atol=TOL, rtol=TOL)
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_gqa_gradients_match_expanded(kv_heads):
+    """dk/dv at H_kv width must equal autodiff through an explicit
+    repeat (which sums each group's contributions) — the kernel does
+    that sum in its VMEM accumulator over the fused (group, q-block)
+    grid dim."""
+    q, k, v = _gqa_qkv(seq=64, kv_heads=kv_heads)
+    g = jnp.asarray(
+        np.random.default_rng(1).normal(size=q.shape), jnp.float32)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, interpret=True) * g)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(
+            mha_reference(q, _expand(k, 4), _expand(v, 4),
+                          causal=True) * g)
+
+    got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", got, want):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(
+            a, b, atol=5e-5, rtol=5e-5,
+            err_msg="GQA grad wrt {} diverges".format(name))
+
+
+def test_gqa_masked_and_padded():
+    """GQA composes with the key-mask fast path and non-block-multiple
+    sequence lengths."""
+    q, k, v = _gqa_qkv(seq=100)
+    mask_np = np.zeros((2, 100), bool)
+    mask_np[0, :37] = True
+    mask_np[1, :] = True
+    mask = jnp.asarray(mask_np)
+    out = flash_attention(q, k, v, causal=True, mask=mask, interpret=True)
+    ref = mha_reference(q, _expand(k, 4), _expand(v, 4), causal=True,
+                        mask=mask)
+    np.testing.assert_allclose(out[0, :37], ref[0, :37], atol=TOL,
+                               rtol=TOL)
+    np.testing.assert_allclose(out[1], ref[1], atol=TOL, rtol=TOL)
+
+
+def test_gqa_reference_handles_fewer_kv_heads():
+    q, k, v = _gqa_qkv(seq=64)
+    ref = mha_reference(q, k, v, causal=True)
+    exp = mha_reference(q, _expand(k, 4), _expand(v, 4), causal=True)
+    np.testing.assert_allclose(ref, exp, atol=TOL, rtol=TOL)
+
+
+def test_gqa_shape_validation():
+    q, k, v = _gqa_qkv(heads=4, kv_heads=3)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, k, v, interpret=True)
+    q, k, v = _gqa_qkv()
+    with pytest.raises(ValueError, match="identical"):
+        flash_attention(q, k, v[:, :, :1], interpret=True)

@@ -1,0 +1,224 @@
+"""Ulysses (all-to-all) sequence parallelism on a virtual CPU mesh.
+
+Same fake-cluster testing shape as test_ring_attention.py: the
+multi-device all-to-all exchange runs in-process on forced CPU devices
+(tests/conftest.py), asserting numerical parity against the
+single-device jnp oracle — the collective layout shuffle must be
+invisible in the math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from cloud_tpu.ops import mha_reference
+from cloud_tpu.parallel import runtime, ulysses_attention
+from cloud_tpu.training import Trainer
+
+
+@pytest.fixture
+def sp_mesh():
+    devices = np.array(jax.devices()[:4]).reshape(1, 4)
+    with Mesh(devices, ("dp", "sp")) as mesh:
+        yield mesh
+
+
+def _rand_qkv(batch=2, seq=32, heads=4, head_dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (batch, seq, heads, head_dim)
+    return tuple(
+        jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        for _ in range(3))
+
+
+class TestUlyssesAttention:
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, sp_mesh, causal):
+        q, k, v = _rand_qkv()
+        out = ulysses_attention(q, k, v, mesh=sp_mesh, causal=causal)
+        expected = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_single_shard_degenerate(self):
+        devices = np.array(jax.devices()[:1]).reshape(1,)
+        q, k, v = _rand_qkv(seq=16)
+        with Mesh(devices, ("sp",)) as mesh:
+            out = ulysses_attention(q, k, v, mesh=mesh)
+        expected = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_reference(self, sp_mesh):
+        q, k, v = _rand_qkv(seq=16)
+
+        def ulysses_loss(q, k, v):
+            return jnp.sum(
+                ulysses_attention(q, k, v, mesh=sp_mesh) ** 2)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+        got = jax.grad(ulysses_loss, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=5e-5, rtol=5e-5)
+
+    def test_head_divisibility_rejected(self, sp_mesh):
+        q, k, v = _rand_qkv(heads=2)  # 2 heads on sp=4
+        with pytest.raises(ValueError, match="head count"):
+            ulysses_attention(q, k, v, mesh=sp_mesh)
+
+    def test_seq_divisibility_rejected(self, sp_mesh):
+        q, k, v = _rand_qkv(seq=30)
+        with pytest.raises(ValueError, match="Sequence length"):
+            ulysses_attention(q, k, v, mesh=sp_mesh)
+
+    def test_missing_axis_rejected(self):
+        devices = np.array(jax.devices()[:2])
+        q, k, v = _rand_qkv(seq=16)
+        with Mesh(devices, ("dp",)) as mesh:
+            with pytest.raises(ValueError, match="no 'sp' axis"):
+                ulysses_attention(q, k, v, mesh=mesh)
+
+    def test_gqa_kv_kept_grouped(self):
+        """K/V enter at H_kv < H: with H_kv divisible by sp the
+        exchange stays grouped; output must match the expanded
+        single-device oracle either way."""
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(2, 32, 4, 8)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+        expected = mha_reference(q, jnp.repeat(k, 2, 2),
+                                 jnp.repeat(v, 2, 2), causal=True)
+        for sp in (2, 4):  # 2 divides H_kv (grouped), 4 does not (expand)
+            devices = np.array(jax.devices()[:sp]).reshape(1, sp)
+            with Mesh(devices, ("dp", "sp")) as mesh:
+                out = ulysses_attention(q, k, v, mesh=mesh, causal=True)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(expected), atol=2e-5,
+                rtol=2e-5, err_msg="sp=%d" % sp)
+
+    def test_ring_accepts_gqa(self):
+        """Ring expands H_kv internally; same oracle."""
+        from cloud_tpu.parallel import sequence_parallel_attention
+
+        rng = np.random.default_rng(4)
+        q = jnp.asarray(rng.normal(size=(2, 32, 4, 8)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+        expected = mha_reference(q, jnp.repeat(k, 2, 2),
+                                 jnp.repeat(v, 2, 2), causal=True)
+        devices = np.array(jax.devices()[:4]).reshape(1, 4)
+        with Mesh(devices, ("dp", "sp")) as mesh:
+            out = sequence_parallel_attention(q, k, v, mesh=mesh,
+                                              causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_unknown_impl_rejected(self):
+        from cloud_tpu.parallel import sp_attention
+
+        q = jnp.zeros((1, 8, 2, 4))
+        with pytest.raises(ValueError, match="Unknown"):
+            sp_attention("rings", q, q, q)
+        with pytest.raises(NotImplementedError, match="mask"):
+            sp_attention("ring", q, q, q, mask=jnp.ones((1, 8), bool))
+
+    def test_dp_composition(self):
+        """Batch sharded on dp AND sequence on sp in one call."""
+        devices = np.array(jax.devices()[:8]).reshape(2, 4)
+        q, k, v = _rand_qkv(batch=4)
+        with Mesh(devices, ("dp", "sp")) as mesh:
+            out = ulysses_attention(q, k, v, mesh=mesh)
+        expected = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestUlyssesInModels:
+
+    def test_transformer_lm_trains_with_ulysses(self):
+        from cloud_tpu.models import TransformerLM
+
+        runtime.reset()
+        runtime.initialize(strategy="tpu_slice",
+                           axis_names=("dp", "sp"), mesh_shape=(2, 4))
+        try:
+            rng = np.random.default_rng(0)
+            tokens = rng.integers(0, 64, size=(4, 32)).astype(np.int32)
+            targets = rng.integers(0, 64, size=(4, 32)).astype(np.int32)
+
+            def lm_loss(logits, labels):
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels).mean(axis=-1)
+
+            model = TransformerLM(vocab_size=64, num_layers=1,
+                                  num_heads=4, d_model=32, d_ff=64,
+                                  max_seq_len=32,
+                                  attention_impl="ulysses",
+                                  compute_dtype=jnp.float32)
+            trainer = Trainer(model, optimizer=optax.adam(1e-2),
+                              loss=lm_loss, metrics=())
+            history = trainer.fit(tokens, targets, epochs=2, batch_size=4,
+                                  shuffle=False, verbose=False)
+            assert history["loss"][-1] < history["loss"][0]
+        finally:
+            runtime.reset()
+
+    def test_llama_ulysses_matches_reference_impl(self):
+        """LlamaLM forward under Ulysses SP == single-device reference
+        attention: RoPE (applied to global arrays) must be unaffected
+        by the sequence sharding."""
+        from cloud_tpu.models import LlamaLM
+
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 64, size=(2, 32)), jnp.int32)
+        kw = dict(vocab_size=64, num_layers=2, num_heads=4,
+                  num_kv_heads=2, d_model=32, d_ff=48, max_seq_len=32,
+                  compute_dtype=jnp.float32)
+        ref = LlamaLM(attention_impl="reference", **kw)
+        params = ref.init(jax.random.PRNGKey(0), tokens)
+
+        expected = ref.apply(params, tokens)
+        devices = np.array(jax.devices()[:4]).reshape(1, 4)
+        with Mesh(devices, ("dp", "sp")):
+            uly = LlamaLM(attention_impl="ulysses", **kw)
+            got = uly.apply(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_llama_ring_matches_reference_impl(self):
+        """Same global-position argument, ring path."""
+        from cloud_tpu.models import LlamaLM
+
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 64, size=(2, 32)), jnp.int32)
+        kw = dict(vocab_size=64, num_layers=2, num_heads=4,
+                  num_kv_heads=2, d_model=32, d_ff=48, max_seq_len=32,
+                  compute_dtype=jnp.float32)
+        ref = LlamaLM(attention_impl="reference", **kw)
+        params = ref.init(jax.random.PRNGKey(0), tokens)
+
+        expected = ref.apply(params, tokens)
+        devices = np.array(jax.devices()[:4]).reshape(1, 4)
+        with Mesh(devices, ("dp", "sp")):
+            ring = LlamaLM(attention_impl="ring", **kw)
+            got = ring.apply(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_generate_rejects_ulysses(self):
+        from cloud_tpu.models import TransformerLM, generate
+
+        model = TransformerLM(vocab_size=64, num_layers=1, num_heads=4,
+                              d_model=32, d_ff=64, max_seq_len=16,
+                              attention_impl="ulysses")
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(NotImplementedError):
+            generate(model, {}, prompt, max_new_tokens=2, temperature=0)
