@@ -14,13 +14,18 @@ Structure: the top-level process never touches the accelerator backend
 directly — the TPU on this host sits behind an experimental tunnel
 whose init can hang indefinitely, so (1) backend health is probed in a
 bounded subprocess, (2) the measurement itself runs in a bounded
-subprocess, (3) both are retried, and (4) persistent failure produces a
-diagnostic JSON line instead of a traceback or a hang.
+subprocess, and (3) the probe loop keeps running for the WHOLE
+BENCH_DEADLINE window: any ~3-minute tunnel-up window is enough to
+capture a number (the persistent XLA compilation cache under
+benchmarks/.jax_cache makes retries skip the multi-minute ResNet50
+compile). Every green measurement is cached to
+benchmarks/last_green.json; on persistent tunnel failure the cached
+record is emitted with "stale": true so the record is never empty.
 
 Prints exactly one JSON line:
     {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
-     "method": "median_chunk", ...}
-or, when the backend is unreachable after all retries:
+     "method": "median_chunk", "kernel_parity": "ok", ...}
+or, when the backend stayed unreachable and no cached green run exists:
     {"metric": ..., "value": 0.0, ..., "error": "<diagnosis>"}
 """
 
@@ -39,19 +44,35 @@ TIMED_STEPS = int(os.environ.get("BENCH_STEPS", 20))
 CHUNK = min(int(os.environ.get("BENCH_CHUNK", 5)), TIMED_STEPS)
 BASELINE_IMAGES_PER_SEC = 350.0  # one V100, fp16 ResNet50 (8xV100 / 8)
 
-ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", 3))
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
-RETRY_DELAY_S = float(os.environ.get("BENCH_RETRY_DELAY", 20))
-# Overall wall-clock budget: whatever happens, the JSON line appears
-# within roughly this many seconds, so an outer `timeout` on the driver
-# side never fires first and the result is always recorded. The
-# per-attempt worker timeout is additionally clamped to the remaining
-# deadline — raise BENCH_DEADLINE together with BENCH_TIMEOUT for a
-# slow-but-healthy backend.
-DEADLINE_S = float(os.environ.get("BENCH_DEADLINE", 600))
+# ResNet50 fwd+bwd+update FLOPs per image at 224^2 (PERF.md roofline
+# sanity check) and v5e bf16 peak, for the %-of-peak line in the JSON.
+RESNET50_GFLOPS_PER_IMAGE = 12.3
+V5E_PEAK_TFLOPS = 197.0
+
+# Probe cadence: a 1-op jit in a bounded subprocess. Healthy tunnel
+# answers in ~5s; a stalled one eats the whole timeout, so the loop's
+# worst-case period is PROBE_TIMEOUT + PROBE_INTERVAL.
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", 60))
+PROBE_INTERVAL_S = float(os.environ.get("BENCH_PROBE_INTERVAL", 15))
+# Overall wall-clock budget. Round-2 lesson: 600s gave up while the
+# tunnel stayed down for the driver's whole capture window; the probe
+# loop is cheap, so default to most of the driver's budget and measure
+# the moment the tunnel comes up. Raise/lower via env.
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE", 2400))
 WORKER_TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", 480))
+# Cap on full measurement launches (probes are uncapped — they're the
+# cheap part): a worker that fails for a non-tunnel reason (bad env,
+# import error) must not be relaunched in a tight loop all window.
+MAX_MEASUREMENTS = int(os.environ.get("BENCH_ATTEMPTS", 5))
+RETRY_DELAY_S = float(os.environ.get("BENCH_RETRY_DELAY", 10))
 
 METRIC = "resnet50_train_images_per_sec_per_chip"
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+LAST_GREEN_PATH = os.environ.get(
+    "BENCH_LAST_GREEN", os.path.join(_HERE, "benchmarks",
+                                     "last_green.json"))
+COMPILE_CACHE_DIR = os.path.join(_HERE, "benchmarks", ".jax_cache")
 
 
 def _metric_name():
@@ -82,7 +103,7 @@ def _probe_backend(timeout=None):
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout, cwd=os.path.dirname(__file__) or ".")
+            timeout=timeout, cwd=_HERE)
     except subprocess.TimeoutExpired:
         return False, "backend probe hung past {:.0f}s".format(timeout)
     except OSError as e:
@@ -98,25 +119,75 @@ def _probe_backend(timeout=None):
 def _run_worker(timeout=None):
     """Run the measurement in a bounded subprocess; returns (record, err)."""
     timeout = WORKER_TIMEOUT_S if timeout is None else timeout
+    def parse(stdout):
+        for line in reversed((stdout or "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    # A record cut mid-write (killed during the
+                    # enriched print): keep scanning for the intact
+                    # pre-smoke line.
+                    continue
+        return None
+
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--worker"],
-            capture_output=True, text=True, timeout=timeout,
-            cwd=os.path.dirname(__file__) or ".")
-    except subprocess.TimeoutExpired:
+            capture_output=True, text=True, timeout=timeout, cwd=_HERE)
+    except subprocess.TimeoutExpired as e:
+        # The worker prints the throughput record BEFORE the kernel
+        # smoke: a smoke that hangs on the tunnel must not discard a
+        # completed measurement.
+        stdout = e.stdout
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode("utf-8", "replace")
+        record = parse(stdout)
+        if record is not None:
+            record.setdefault("kernel_parity",
+                              "timeout past {:.0f}s".format(timeout))
+            return record, None
         return None, "measurement hung past {:.0f}s".format(timeout)
     except OSError as e:
         return None, "measurement failed to launch: {}".format(e)
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line), None
-            except ValueError:
-                break
+    record = parse(proc.stdout)
+    if record is not None:
+        if proc.returncode != 0:
+            # Throughput line landed but the process then aborted —
+            # on TPU that's the Mosaic-compile failure class the
+            # kernel smoke exists to surface; don't report it green.
+            tail = (proc.stderr or "").strip().splitlines()
+            record.setdefault(
+                "kernel_parity", "crashed rc={}: {}".format(
+                    proc.returncode, tail[-1][:160] if tail else ""))
+        return record, None
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()
     return None, "measurement died: {}".format(tail[-1] if tail else
                                                "rc={}".format(proc.returncode))
+
+
+def _save_last_green(record):
+    try:
+        os.makedirs(os.path.dirname(LAST_GREEN_PATH), exist_ok=True)
+        with open(LAST_GREEN_PATH, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+    except OSError as e:
+        print("# could not cache green record: {}".format(e),
+              file=sys.stderr)
+
+
+def _load_last_green():
+    """Most recent green record for this metric series, or None."""
+    try:
+        with open(LAST_GREEN_PATH) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if record.get("metric") != _metric_name() or not record.get("value"):
+        return None
+    return record
 
 
 def main():
@@ -126,40 +197,138 @@ def main():
         return DEADLINE_S - (time.monotonic() - start)
 
     last_err = "no attempts made"
-    attempt = 0
-    while attempt < ATTEMPTS and remaining() > 10:
-        if attempt:
-            time.sleep(min(RETRY_DELAY_S, max(remaining() - 10, 0)))
-        attempt += 1
-        ok, diag = _probe_backend(timeout=min(PROBE_TIMEOUT_S, remaining()))
-        print("# probe attempt {}: {}".format(attempt, diag),
-              file=sys.stderr)
+    probes = 0
+    measurements = 0
+    while True:
+        if measurements >= MAX_MEASUREMENTS:
+            # No further measurement can ever launch; don't burn the
+            # rest of the window probing for one.
+            last_err = "{} (after {} measurement attempts)".format(
+                last_err, measurements)
+            break
+        if probes and remaining() <= 10:
+            break
+        # The first probe always runs — even under a tiny deadline the
+        # contract is a diagnosed error, not "no attempts made".
+        ok, diag = _probe_backend(
+            timeout=min(PROBE_TIMEOUT_S, max(remaining(), 0.1)))
+        probes += 1
+        print("# probe {} (t+{:.0f}s): {}".format(
+            probes, time.monotonic() - start, diag), file=sys.stderr)
         if not ok:
             last_err = diag
+            if remaining() <= 10:
+                break
+            time.sleep(min(PROBE_INTERVAL_S, max(remaining() - 10, 0)))
             continue
         if remaining() < 30:
             last_err = "backend healthy but <30s of budget left for " \
                        "measurement"
             break
+        measurements += 1
         record, err = _run_worker(timeout=min(WORKER_TIMEOUT_S, remaining()))
         if record is not None:
+            # Only a real-TPU number is worth serving stale later; a
+            # forced-CPU CI run must not shadow the last green TPU run.
+            if record.get("platform") == "tpu":
+                _save_last_green(record)
             print(json.dumps(record))
             return
         last_err = err
-        print("# measurement attempt {} failed: {}".format(attempt, err),
-              file=sys.stderr)
+        print("# measurement attempt {} failed: {}".format(
+            measurements, err), file=sys.stderr)
+        # The compile cache makes a tunnel-flap retry cheap, but pause
+        # before re-probing so a deterministically-failing worker can't
+        # spin the whole window.
+        time.sleep(min(RETRY_DELAY_S, max(remaining() - 10, 0)))
+    cached = _load_last_green()
+    if cached is not None:
+        stale = dict(cached)
+        stale["stale"] = True
+        stale["stale_reason"] = last_err
+        print(json.dumps(stale))
+        return
     print(json.dumps({
         "metric": _metric_name(),
         "value": 0.0,
         "unit": "images/sec",
         "vs_baseline": 0.0,
         "error": last_err,
-        "attempts": attempt,
+        "probes": probes,
+        "measurement_attempts": measurements,
     }))
 
 
+def _kernel_parity_smoke(jax):
+    """Flash-attention parity vs the jnp oracle, non-interpreted.
+
+    Round-2 gap: every kernel test ran in interpret mode off-TPU, so a
+    Mosaic compile/layout failure would first surface during the
+    benchmark itself. This runs the real kernel (forward AND grad) on
+    whatever backend the worker measured on; on TPU that is the
+    compiled Mosaic kernel. ~30s budget, [2,256,4,64] shapes, three
+    configs: causal MHA, masked non-causal MHA, causal+masked GQA.
+    Returns "ok", or "fail: ..."/"error: ..." without sinking the
+    throughput record.
+    """
+    import jax.numpy as jnp
+
+    from cloud_tpu.ops import flash_attention, mha_reference
+
+    try:
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        b, s, h, d = 2, 256, 4, 64
+        q = jax.random.normal(kq, (b, s, h, d), dtype=jnp.float32)
+        # Contiguous-prefix key mask (valid lengths 256 and 192) so the
+        # causal config never produces a fully-masked row, where flash
+        # (zeros) and the oracle (uniform average) legitimately differ.
+        mask = (np.arange(s)[None, :] <
+                np.array([[s], [192]])).astype(bool)
+        mask = jnp.asarray(mask)
+        configs = [
+            ("causal", h, True, None),
+            ("masked", h, False, mask),
+            ("gqa", h // 2, True, mask),
+        ]
+        for name, h_kv, causal, m in configs:
+            k = jax.random.normal(kk, (b, s, h_kv, d), dtype=jnp.float32)
+            v = jax.random.normal(kv, (b, s, h_kv, d), dtype=jnp.float32)
+
+            def loss_flash(q, k, v, causal=causal, m=m):
+                return flash_attention(q, k, v, causal=causal,
+                                       mask=m).sum()
+
+            def loss_ref(q, k, v, causal=causal, m=m):
+                return mha_reference(q, k, v, causal=causal,
+                                     mask=m).sum()
+
+            out = jax.jit(lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, mask=m))(q, k, v)
+            ref = mha_reference(q, k, v, causal=causal, mask=m)
+            fwd_err = float(jax.device_get(
+                jnp.max(jnp.abs(out - ref))))
+            g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(
+                q, k, v)
+            g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+            grad_err = max(
+                float(jax.device_get(jnp.max(jnp.abs(a - b_))))
+                for a, b_ in zip(g_flash, g_ref))
+            if fwd_err > 5e-2 or grad_err > 5e-2:
+                return ("fail: {} fwd_err={:.2e} grad_err={:.2e}"
+                        .format(name, fwd_err, grad_err))
+        return "ok"
+    except Exception as e:  # noqa: BLE001 - report, don't sink the bench
+        return "error: {}: {}".format(type(e).__name__, str(e)[:200])
+
+
 def worker():
+    # Persistent compilation cache: a tunnel-flap retry (or the sweep's
+    # next config) skips the multi-minute ResNet50 compile entirely.
+    os.makedirs(COMPILE_CACHE_DIR, exist_ok=True)
     import jax
+    jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
     import optax
@@ -214,6 +383,7 @@ def worker():
     median_elapsed = sorted(chunk_times)[len(chunk_times) // 2]
 
     images_per_sec = BATCH * CHUNK / median_elapsed
+    tflops = images_per_sec * RESNET50_GFLOPS_PER_IMAGE / 1000.0
     record = {
         "metric": _metric_name(),
         "value": round(images_per_sec, 2),
@@ -225,9 +395,19 @@ def worker():
         "batch": BATCH,
         "image": IMAGE,
         "platform": jax.default_backend(),
+        "tflops": round(tflops, 1),
+        "pct_peak": round(100.0 * tflops / V5E_PEAK_TFLOPS, 1),
     }
     if s2d:
         record["stem"] = "space_to_depth"
+    if os.environ.get("BENCH_SKIP_KERNEL_PARITY", "0") != "1":
+        # Emit the throughput record FIRST: if the kernel smoke hangs
+        # the tunnel, the parent salvages this line from the killed
+        # process's stdout instead of losing the measurement. The
+        # enriched record below (last JSON line) wins when the smoke
+        # completes.
+        print(json.dumps(record), flush=True)
+        record["kernel_parity"] = _kernel_parity_smoke(jax)
     print(json.dumps(record))
 
 

@@ -23,6 +23,16 @@ framework needs the architecture family that today's open checkpoints
 names, same "cache" collection shape conventions), so `generate()` —
 the jitted prefill + `lax.scan` decode loop in
 `cloud_tpu/models/transformer.py` — drives it unchanged.
+
+RoPE convention: `apply_rope` rotates INTERLEAVED (even, odd) feature
+pairs — the GPT-NeoX layout — not Llama's rotate-half (first half vs
+second half). Self-consistent for from-scratch training (the two are
+related by a fixed permutation of head_dim features, which the learned
+q/k projections absorb), but weights are NOT layout-compatible with
+real Llama/Mistral checkpoints as-is: importing one requires permuting
+the q/k projection output features from rotate-half order
+`[0..D/2, D/2..D]` to interleaved order `[0, D/2, 1, D/2+1, ...]`
+(per head), or swapping `apply_rope` for a rotate-half variant.
 """
 
 from typing import Optional
@@ -72,7 +82,7 @@ class GQAttention(nn.Module):
     num_heads: int
     num_kv_heads: int
     compute_dtype: jnp.dtype = jnp.bfloat16
-    attention_impl: str = "auto"  # auto | flash | reference | ring
+    attention_impl: str = "auto"  # auto | flash | reference | ring | ulysses
     rope_theta: float = 10000.0
     decode: bool = False
     cache_len: int = 0

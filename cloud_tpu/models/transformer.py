@@ -27,7 +27,7 @@ from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
 class CausalSelfAttention(nn.Module):
     num_heads: int
     compute_dtype: jnp.dtype = jnp.bfloat16
-    attention_impl: str = "auto"  # auto | flash | reference | ring
+    attention_impl: str = "auto"  # auto | flash | reference | ring | ulysses
     decode: bool = False  # autoregressive KV-cache mode
     cache_len: int = 0  # cache size (tokens); set by TransformerLM
     causal: bool = True  # False = bidirectional (encoder) attention

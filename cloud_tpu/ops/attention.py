@@ -82,6 +82,9 @@ def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None):
     head_dim = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
+    if v.shape != k.shape:
+        raise ValueError("k and v must have identical shapes; got "
+                         "{} vs {}.".format(k.shape, v.shape))
     if k.shape[2] != q.shape[2]:
         heads, h_kv = q.shape[2], k.shape[2]
         if heads % h_kv:

@@ -26,22 +26,32 @@ def _active_context_mesh():
 
     The legacy-but-idiomatic `with Mesh(devices, axes):` context sets a
     thread-local physical mesh that `jax.sharding` doesn't expose
-    publicly; read it through the internal module (stable across the
-    jax versions this repo supports; `jax.interpreters.pxla` re-exports
-    it with a deprecation warning, so go to the source)."""
+    publicly. Two lookup paths, most-stable first: the internal module
+    (fast, no deprecation machinery), then the public-but-deprecated
+    `jax.interpreters.pxla` re-export — so a jax upgrade that moves the
+    internal doesn't silently disable `with Mesh(...)` resolution
+    (tests/unit/test_runtime.py pins this behavior)."""
+    m = None
     try:
         from jax._src import mesh as _mesh_lib
         m = _mesh_lib.thread_resources.env.physical_mesh
     except (ImportError, AttributeError):
-        # A jax upgrade moved the internal: don't silently ignore the
-        # user's `with Mesh(...)` block — say why it can't be seen.
         import warnings
-        warnings.warn(
-            "cloud_tpu: this jax version does not expose the active "
-            "Mesh context (jax._src.mesh.thread_resources); pass "
-            "`mesh=` explicitly or use runtime.initialize().",
-            RuntimeWarning, stacklevel=3)
-        return None
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", DeprecationWarning)
+                from jax.interpreters import pxla
+                m = pxla.thread_resources.env.physical_mesh
+        except (ImportError, AttributeError):
+            # Both paths gone: don't silently ignore the user's
+            # `with Mesh(...)` block — say why it can't be seen.
+            warnings.warn(
+                "cloud_tpu: this jax version does not expose the "
+                "active Mesh context (jax._src.mesh.thread_resources "
+                "or jax.interpreters.pxla); pass `mesh=` explicitly "
+                "or use runtime.initialize().",
+                RuntimeWarning, stacklevel=3)
+            return None
     if m is not None and not m.empty:
         return m
     return None

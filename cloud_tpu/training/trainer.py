@@ -17,6 +17,7 @@ Example:
     history = trainer.fit(x_train, y_train, epochs=2, batch_size=128)
 """
 
+import inspect
 import logging
 import sys
 import time
@@ -329,6 +330,7 @@ class Trainer:
         self.state = None
         self._jit_train_step = None
         self._jit_eval_step = None
+        self._scalar_unmasked_metrics = set()
         self._jit_predict_step = None
         self.stop_training = False  # set by callbacks (EarlyStopping)
 
@@ -446,6 +448,8 @@ class Trainer:
         loss_fn = self.loss_fn
         optimizer = self.optimizer
         train_kwargs = self.train_kwargs
+        train_mask_aware = {name: self._metric_accepts_mask(fn)
+                            for name, fn in metric_fns.items()}
         rng_keys = self.rng_keys
 
         aux_loss_weight = self.aux_loss_weight
@@ -495,7 +499,16 @@ class Trainer:
             for name, fn in metric_fns.items():
                 # Mean-reduce: metric fns may return per-example values
                 # (built-ins do) or a scalar; train logs are batch means.
-                logs[name] = jnp.mean(fn(outputs, y))
+                # Mask-aware metrics (fn(outputs, y, mask=...), the
+                # padded-eval contract) get an all-ones mask — train
+                # batches are never padded.
+                if train_mask_aware[name]:
+                    lead = jax.tree_util.tree_leaves(outputs)[0].shape[0]
+                    v = fn(outputs, y, mask=jnp.ones((lead,),
+                                                     jnp.float32))
+                else:
+                    v = fn(outputs, y)
+                logs[name] = jnp.mean(v)
             return new_state, logs
 
         if self._mesh is None:
@@ -508,10 +521,32 @@ class Trainer:
             out_shardings=(self._state_sharding, None),
             donate_argnums=0)
 
+    @staticmethod
+    def _metric_accepts_mask(fn):
+        """Opt-in masked-metric signature: fn(outputs, y, mask=...).
+
+        The opt-in must be the EXPLICIT named parameter — treating a
+        bare ``**kwargs`` as mask-aware would silently hand scalar
+        metrics that ignore it an unmasked mean on padded batches, the
+        exact leak the mask contract exists to close.
+        """
+        try:
+            params = inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            return False
+        return "mask" in params
+
     def _make_eval_step(self):
         metric_fns = self.metric_fns
         loss_fn = self.loss_fn
         eval_kwargs = self.eval_kwargs
+        mask_aware = {name: self._metric_accepts_mask(fn)
+                      for name, fn in metric_fns.items()}
+        # Names of metrics that return a scalar AND can't take the
+        # valid-mask: populated at trace time (shape info is static),
+        # read by evaluate() to fail loudly on padded tail batches
+        # instead of silently averaging padded duplicates in.
+        scalar_unmasked = self._scalar_unmasked_metrics = set()
 
         def _per_example(v, batch_dim):
             # Collapse any non-batch dims (e.g. per-token losses) to one
@@ -533,14 +568,25 @@ class Trainer:
             per_ex = _per_example(loss_fn(outputs, y), mask.shape[0])
             logs = {"loss": jnp.sum(per_ex * mask) / n}
             for name, fn in metric_fns.items():
+                if mask_aware[name]:
+                    v = jnp.asarray(fn(outputs, y, mask=mask))
+                    if v.ndim >= 1:
+                        v = _per_example(v, mask.shape[0])
+                        logs[name] = jnp.sum(v * mask) / n
+                    else:
+                        # Scalar from a mask-aware fn: it already
+                        # weighted out the padded rows.
+                        logs[name] = v
+                    continue
                 v = jnp.asarray(fn(outputs, y))
                 if v.ndim >= 1:
                     v = _per_example(v, mask.shape[0])
                     logs[name] = jnp.sum(v * mask) / n
                 else:
-                    # Scalar custom metric: no per-example view to mask;
-                    # batch mean (includes padded duplicates) is the
-                    # best available estimate.
+                    # Scalar custom metric with no way to apply the
+                    # valid-mask: correct on full batches only.
+                    # evaluate() raises if a padded batch shows up.
+                    scalar_unmasked.add(name)
                     logs[name] = v
             return logs
 
@@ -788,7 +834,11 @@ class Trainer:
         stay static for XLA, but padded duplicates are masked out inside
         the eval step and each batch is weighted by its real example
         count — metrics match a hand-computed mean over the dataset
-        (Keras-exact), regardless of tail padding.
+        (Keras-exact), regardless of tail padding. Custom metrics may
+        opt into the valid-mask via a `fn(outputs, y, mask=...)`
+        signature; a custom metric that returns a scalar WITHOUT taking
+        the mask raises on padded batches rather than silently folding
+        duplicated rows into its mean.
 
         `steps` caps the batch loop; when unset, a dataset-level
         `steps_per_epoch` (e.g. GeneratorDataset over an unbounded
@@ -844,6 +894,19 @@ class Trainer:
         totals, weight = {}, 0.0
         for real, fed in feeder:
             logs = self._jit_eval_step(eval_state, fed)
+            if (global_bs is not None and real < global_bs
+                    and self._scalar_unmasked_metrics):
+                # A padded tail batch would silently fold duplicated
+                # rows into these metrics' batch means.
+                raise ValueError(
+                    "Custom metrics {} return a scalar and cannot be "
+                    "masked, but this eval batch is padded ({} real of "
+                    "{} rows). Give the metric a mask-aware signature "
+                    "fn(outputs, y, mask=...) (weight rows by mask), "
+                    "return per-example values instead, or pick a batch "
+                    "size that divides the dataset.".format(
+                        sorted(self._scalar_unmasked_metrics), real,
+                        global_bs))
             weight += real
             for k, v in logs.items():
                 # Device-side accumulation: no host sync per batch (one

@@ -13,6 +13,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import unittest
 from unittest import mock
 
@@ -144,16 +145,41 @@ class BenchJsonContractTest(unittest.TestCase):
         return json.loads(json_lines[0])
 
     def test_unreachable_backend_emits_error_json(self):
+        # A probe that can never finish in 0.2s + a 3s overall budget:
+        # the full-window probe loop must still exit with the error
+        # JSON. The last-green cache is pointed at a nonexistent path
+        # so the committed seed record doesn't satisfy the fallback.
         record = self._run_bench({
-            "BENCH_ATTEMPTS": "1",
             "BENCH_PROBE_TIMEOUT": "0.2",
-            "BENCH_RETRY_DELAY": "0",
+            "BENCH_PROBE_INTERVAL": "0.1",
+            "BENCH_DEADLINE": "3",
+            "BENCH_LAST_GREEN": os.path.join(
+                tempfile.mkdtemp(), "absent.json"),
         })
         self.assertEqual(record["value"], 0.0)
         self.assertEqual(record["vs_baseline"], 0.0)
         self.assertIn("error", record)
         self.assertEqual(record["metric"],
                          "resnet50_train_images_per_sec_per_chip")
+
+    def test_unreachable_backend_serves_stale_green(self):
+        # With a cached green TPU record, persistent tunnel failure
+        # emits that record marked stale instead of an empty error.
+        cache = os.path.join(tempfile.mkdtemp(), "last_green.json")
+        green = {"metric": "resnet50_train_images_per_sec_per_chip",
+                 "value": 1234.5, "unit": "images/sec",
+                 "vs_baseline": 3.527, "platform": "tpu"}
+        with open(cache, "w") as f:
+            json.dump(green, f)
+        record = self._run_bench({
+            "BENCH_PROBE_TIMEOUT": "0.2",
+            "BENCH_PROBE_INTERVAL": "0.1",
+            "BENCH_DEADLINE": "3",
+            "BENCH_LAST_GREEN": cache,
+        })
+        self.assertEqual(record["value"], 1234.5)
+        self.assertTrue(record["stale"])
+        self.assertIn("stale_reason", record)
 
 
 if __name__ == "__main__":

@@ -269,3 +269,7 @@ def test_gqa_shape_validation():
     q, k, v = _gqa_qkv()
     with pytest.raises(ValueError, match="identical"):
         flash_attention(q, k, v[:, :, :1], interpret=True)
+    # The oracle validates the same way (round-2 advisor finding: a
+    # mismatched v used to die later as an opaque einsum shape error).
+    with pytest.raises(ValueError, match="identical"):
+        mha_reference(q, k, v[:, :, :1])

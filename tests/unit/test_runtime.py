@@ -203,3 +203,32 @@ class TestMultiSlice:
         ctx = runtime.initialize(strategy="multi_slice")
         # Per-slice (-1, 2) infers to (2, 2); x2 slices on dp -> (4, 2).
         assert dict(ctx.mesh.shape) == {"dp": 4, "tp": 2}
+
+
+class TestContextMeshResolution:
+    """Pins the `with Mesh(...)` lookup so a jax upgrade that moves the
+    internal thread_resources API fails loudly here, not silently in a
+    model (round-2 advisor finding)."""
+
+    def test_context_mesh_is_seen_without_warning(self):
+        import warnings
+
+        import jax
+        from jax.sharding import Mesh
+
+        from cloud_tpu.parallel import sharding
+
+        devices = np.array(jax.devices())
+        with Mesh(devices, ("dp",)) as mesh:
+            with warnings.catch_warnings():
+                # The fallback paths warn; the supported path must not.
+                warnings.simplefilter("error", RuntimeWarning)
+                seen = sharding._active_context_mesh()
+            assert seen is not None
+            assert seen.shape == mesh.shape
+            assert sharding._resolve_mesh() is seen
+
+    def test_no_context_mesh_resolves_none(self):
+        from cloud_tpu.parallel import sharding
+
+        assert sharding._active_context_mesh() is None

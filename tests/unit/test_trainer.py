@@ -136,6 +136,47 @@ class TestFit:
         logs = trainer.evaluate(dataset, verbose=False)
         assert np.isfinite(logs["loss"])
 
+    def test_mask_aware_custom_metric_exact_under_padding(self):
+        """A custom metric that takes mask= sees the valid-mask and can
+        return an exact scalar even on padded tail batches."""
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=33)
+
+        def frac_class0(outputs, y, mask=None):
+            hit = (jnp.argmax(outputs, axis=-1) == 0).astype(jnp.float32)
+            return jnp.sum(hit * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        trainer = Trainer(MLP(hidden=16, num_classes=4,
+                              compute_dtype=jnp.float32),
+                          metrics=(frac_class0,))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        logs = trainer.evaluate(x, y, batch_size=32, verbose=False)
+        logits = trainer.predict(x, batch_size=32)
+        expected = float((np.argmax(logits, axis=-1) == 0).mean())
+        assert logs["frac_class0"] == pytest.approx(expected, rel=1e-5)
+
+    def test_scalar_unmasked_metric_raises_on_padded_batch(self):
+        """A scalar custom metric with no mask= signature cannot be
+        corrected for padded duplicates: evaluate fails loudly instead
+        of silently averaging them in."""
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=33)
+
+        def scalar_metric(outputs, y):
+            return jnp.mean(jnp.argmax(outputs, axis=-1) == y)
+
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          metrics=(scalar_metric,))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        with pytest.raises(ValueError, match="scalar_metric"):
+            trainer.evaluate(x, y, batch_size=32, verbose=False)
+        # Unpadded eval still works fine with the same metric.
+        logs = trainer.evaluate(x[:32], y[:32], batch_size=32,
+                                verbose=False)
+        assert np.isfinite(logs["scalar_metric"])
+
     def test_validation_data(self):
         x, y = _toy_classification()
         trainer = Trainer(MLP(hidden=16, num_classes=4))
