@@ -21,6 +21,7 @@ import datetime
 import io
 import logging
 import pickle
+import json
 
 import numpy as np
 
@@ -39,6 +40,7 @@ logger = logging.getLogger("cloud_tpu")
 
 SPEC_FILE = "spec.pkl"
 DATA_FILE = "data.npz"
+DATASET_SPEC_FILE = "dataset_spec.json"
 FIT_KWARGS_FILE = "fit_kwargs.pkl"
 
 
@@ -75,16 +77,13 @@ def _serializable_ref(obj, registry, kind):
             kind, obj, sorted(registry)))
 
 
-def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
-                     **fit_kwargs):
-    """Writes the trainer spec + data + fit kwargs under `remote_dir`.
-
-    Reference parity: `_serialize_assets` (client.py:138-192), with
-    explicit picklability rules instead of SavedModel tracing.
-    """
+def make_spec(trainer):
+    """The picklable trainer spec dict `remote.build_trainer` rebuilds
+    from (names/dotted-paths for registry objects, pickle for the
+    rest)."""
     from cloud_tpu.training import trainer as trainer_lib
 
-    spec = {
+    return {
         "model": trainer.model,
         "optimizer": _serializable_ref(
             trainer.optimizer_spec, trainer_lib.OPTIMIZERS, "optimizer"),
@@ -105,8 +104,122 @@ def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
         "fsdp": trainer.fsdp,
         "ema_decay": trainer.ema_decay,
     }
+
+
+def dataset_spec(x):
+    """A JSON-able spec for dataset-typed `x`, or None for arrays.
+
+    The dataset transport (the JAX-native analogue of the reference
+    shipping live tf.data datasets as tf.function closures inside a
+    SavedModel, reference cloud_fit/client.py:151-189): what crosses
+    the wire is a REFERENCE — a dotted factory path + kwargs, or a
+    shard-path manifest — never the data itself.
+    """
+    from cloud_tpu.training import data as data_lib
+
+    spec = {"threaded": False, "buffer_size": None}
+    ds = x
+    if isinstance(ds, data_lib.ThreadedDataset):
+        spec["threaded"] = True
+        spec["buffer_size"] = ds.buffer_size
+        ds = ds.dataset
+    if isinstance(ds, data_lib.GeneratorDataset):
+        path = _dotted_path(ds.factory)
+        if path is None:
+            raise ValueError(
+                "GeneratorDataset factories shipped through cloud_fit "
+                "must be module-level functions (the remote worker "
+                "re-imports them by dotted path); got {!r}. Hoist the "
+                "factory to module scope and parameterize it via "
+                "factory_kwargs.".format(ds.factory))
+        try:
+            json.dumps(ds.factory_kwargs)
+        except (TypeError, ValueError):
+            raise ValueError(
+                "factory_kwargs must be JSON-serializable to ship "
+                "through cloud_fit; got {!r}.".format(ds.factory_kwargs))
+        spec.update(kind="generator", factory=path,
+                    factory_kwargs=ds.factory_kwargs,
+                    steps_per_epoch=ds.steps_per_epoch)
+        return spec
+    if isinstance(ds, data_lib.NpzShardDataset):
+        spec.update(kind="npz_shards", paths=ds.shard_paths,
+                    batch_size=ds.batch_size)
+        return spec
+    if spec["threaded"]:
+        raise ValueError(
+            "ThreadedDataset must wrap a GeneratorDataset or "
+            "NpzShardDataset to ship through cloud_fit; it wraps "
+            "{!r}.".format(type(ds)))
+    return None
+
+
+def build_dataset(spec):
+    """Rebuilds the dataset a `dataset_spec` describes (worker side)."""
+    from cloud_tpu.training import data as data_lib
+
+    kind = spec["kind"]
+    if kind == "generator":
+        ds = data_lib.GeneratorDataset(
+            resolve_dotted(spec["factory"]),
+            steps_per_epoch=spec.get("steps_per_epoch"),
+            factory_kwargs=spec.get("factory_kwargs"))
+    elif kind == "npz_shards":
+        ds = data_lib.NpzShardDataset(spec["paths"],
+                                      batch_size=spec["batch_size"])
+    else:
+        raise ValueError("Unknown dataset spec kind {!r}.".format(kind))
+    if spec.get("threaded"):
+        ds = data_lib.ThreadedDataset(ds, buffer_size=spec["buffer_size"])
+    return ds
+
+
+def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
+                     **fit_kwargs):
+    """Writes the trainer spec + data + fit kwargs under `remote_dir`.
+
+    Reference parity: `_serialize_assets` (client.py:138-192), with
+    explicit picklability rules instead of SavedModel tracing. Arrays
+    ship as one compressed npz; GeneratorDataset / ThreadedDataset /
+    NpzShardDataset ship as a JSON dataset spec (factory dotted path +
+    kwargs, or shard manifest) with no data bytes in it.
+    """
     storage.write_bytes(storage.join(remote_dir, SPEC_FILE),
-                        pickle.dumps(spec))
+                        pickle.dumps(make_spec(trainer)))
+
+    ds_spec = dataset_spec(x)
+    if ds_spec is not None:
+        if y is not None:
+            raise ValueError(
+                "y must be None when x is a dataset (datasets yield "
+                "(x, y) batches themselves).")
+        if (ds_spec["kind"] == "npz_shards"
+                and storage.is_gcs_path(remote_dir)):
+            local = [p for p in ds_spec["paths"]
+                     if not storage.is_gcs_path(p)]
+            if local:
+                # Fail before job submission, like the module-level
+                # factory check — a remote worker can't read the
+                # client's local filesystem.
+                raise ValueError(
+                    "NpzShardDataset shard paths must be gs:// for a "
+                    "gs:// remote_dir (the worker cannot read local "
+                    "paths); local: {}".format(local[:3]))
+        storage.write_bytes(
+            storage.join(remote_dir, DATASET_SPEC_FILE),
+            json.dumps(ds_spec).encode("utf-8"))
+        if validation_data is not None:
+            arrays = {"val_x": np.asarray(validation_data[0]),
+                      "val_y": np.asarray(validation_data[1])}
+            buf = io.BytesIO()
+            np.savez_compressed(buf, **arrays)
+            storage.write_bytes(storage.join(remote_dir, DATA_FILE),
+                                buf.getvalue())
+        storage.write_bytes(storage.join(remote_dir, FIT_KWARGS_FILE),
+                            pickle.dumps(fit_kwargs))
+        logger.info("Serialized cloud_fit assets (dataset spec: %s) "
+                    "to %s", ds_spec["kind"], remote_dir)
+        return
 
     arrays = {"x": np.asarray(x)}
     if y is not None:
@@ -156,7 +269,12 @@ def cloud_fit(trainer,
             client.py:87-93 validates against its registry).
         job_spec: Optional full trainingInput override.
         job_id: Optional job id; default `cloud_fit_<timestamp>`.
-        x / y / validation_data: Training data arrays.
+        x / y / validation_data: Training data. Arrays ship inline
+            (compressed npz); a GeneratorDataset / ThreadedDataset /
+            NpzShardDataset `x` ships as a JSON dataset spec (dotted
+            factory path + kwargs, or shard manifest) with no data
+            bytes — for data that does not fit one array (y must be
+            None then; validation_data stays array-typed).
         api_client: Injectable platform client (tests).
         **fit_kwargs: Forwarded to `Trainer.fit` remotely (epochs,
             batch_size, callbacks, ...).

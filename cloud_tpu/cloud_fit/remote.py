@@ -76,14 +76,28 @@ def run(remote_dir, distribution_strategy="tpu_slice"):
         storage.read_bytes(storage.join(remote_dir, client_lib.SPEC_FILE)))
     fit_kwargs = pickle.loads(storage.read_bytes(
         storage.join(remote_dir, client_lib.FIT_KWARGS_FILE)))
-    arrays = np.load(io.BytesIO(storage.read_bytes(
-        storage.join(remote_dir, client_lib.DATA_FILE))))
 
     trainer = build_trainer(spec, mesh=runtime.global_mesh())
 
-    x = arrays["x"]
-    y = arrays["y"] if "y" in arrays.files else None
-    if "val_x" in arrays.files:
+    ds_spec_path = storage.join(remote_dir, client_lib.DATASET_SPEC_FILE)
+    data_path = storage.join(remote_dir, client_lib.DATA_FILE)
+    arrays = None
+    if storage.exists(ds_spec_path):
+        # Dataset transport: rebuild the generator/shard pipeline from
+        # its JSON spec — the data itself never crossed in the assets
+        # (reference ships live tf.data datasets, client.py:151-189;
+        # this is the reference-free equivalent). The npz, if present,
+        # carries only validation arrays.
+        x = client_lib.build_dataset(
+            json.loads(storage.read_bytes(ds_spec_path)))
+        y = None
+        if storage.exists(data_path):
+            arrays = np.load(io.BytesIO(storage.read_bytes(data_path)))
+    else:
+        arrays = np.load(io.BytesIO(storage.read_bytes(data_path)))
+        x = arrays["x"]
+        y = arrays["y"] if "y" in arrays.files else None
+    if arrays is not None and "val_x" in arrays.files:
         fit_kwargs.setdefault(
             "validation_data", (arrays["val_x"], arrays["val_y"]))
 

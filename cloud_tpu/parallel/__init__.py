@@ -27,15 +27,17 @@ def sp_attention(impl, q, k, v, causal=True, mask=None):
     One place owns the impl-name set and the padding-mask contract so
     the model families can't drift apart. Both impls accept GQA
     (k/v with H_kv < H heads): ulysses exchanges at H_kv width when it
-    divides the sp axis; ring expands to H before rotating.
+    divides the sp axis; ring expands to H before rotating. Both accept
+    a [B, S] boolean key mask (True = attend, the `flash_attention`
+    padded-batch contract): ring rotates the mask chunks with k/v,
+    ulysses re-gathers them for the full-sequence local kernel — so
+    Keras-parity padded batches stay on the sequence-parallel path.
     """
-    if mask is not None:
-        raise NotImplementedError(
-            "sequence-parallel attention does not take a padding mask.")
     if impl == "ring":
-        return sequence_parallel_attention(q, k, v, causal=causal)
+        return sequence_parallel_attention(q, k, v, causal=causal,
+                                           mask=mask)
     if impl == "ulysses":
-        return ulysses_attention(q, k, v, causal=causal)
+        return ulysses_attention(q, k, v, causal=causal, mask=mask)
     raise ValueError(
         "Unknown sequence-parallel impl {!r}; expected one of {}.".format(
             impl, SEQUENCE_PARALLEL_IMPLS))

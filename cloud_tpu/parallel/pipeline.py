@@ -99,7 +99,7 @@ def pipeline(stage_fn, stage_params, microbatches, axis_name):
 
 
 def pipeline_apply(stage_fn, stacked_params, x, num_microbatches,
-                   mesh=None, axis="pp"):
+                   mesh=None, axis="pp", batch_axis="auto"):
     """Pipeline-parallel apply over global arrays.
 
     Args:
@@ -111,6 +111,13 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches,
         num_microbatches: M; B must divide by it.
         mesh: Mesh override; default ambient.
         axis: Pipeline mesh axis name.
+        batch_axis: Mesh axis the microbatch dim is sharded over —
+            "auto" picks the ambient data axis ("dp") when the mesh has
+            one and the per-microbatch size divides it, so pp composes
+            with dp in ONE mesh: each dp group runs the full schedule
+            on its batch shard, stage params replicated across dp (the
+            dp gradient psum over stage grads is inserted by shard_map's
+            transpose). None forces replication (pure pp).
 
     Returns:
         [B, ...] output of the last stage.
@@ -133,6 +140,24 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches,
         raise ValueError(
             "Batch size {} is not divisible by num_microbatches {}."
             .format(batch, num_microbatches))
+    micro_b = batch // num_microbatches
+
+    if batch_axis == "auto":
+        batch_axis = (sharding_lib.DATA_AXIS
+                      if sharding_lib.DATA_AXIS in mesh.axis_names
+                      else None)
+        if batch_axis is not None and micro_b % mesh.shape[batch_axis]:
+            batch_axis = None
+    elif batch_axis is not None:
+        if batch_axis not in mesh.axis_names:
+            raise ValueError(
+                "Mesh axes {} have no {!r} batch axis.".format(
+                    tuple(mesh.axis_names), batch_axis))
+        if micro_b % mesh.shape[batch_axis]:
+            raise ValueError(
+                "Microbatch size {} is not divisible by the {!r} axis "
+                "size {}.".format(micro_b, batch_axis,
+                                  mesh.shape[batch_axis]))
 
     def check_leading(leaf):
         if leaf.shape[0] != n_stages:
@@ -143,8 +168,7 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches,
 
     jax.tree_util.tree_map(check_leading, stacked_params)
 
-    micro = x.reshape((num_microbatches, batch // num_microbatches)
-                      + x.shape[1:])
+    micro = x.reshape((num_microbatches, micro_b) + x.shape[1:])
 
     def local_fn(stage_params, microbatches):
         # shard_map keeps the sharded leading stage axis as size 1;
@@ -153,8 +177,9 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches,
         return pipeline(stage_fn, own, microbatches, axis_name=axis)
 
     params_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    micro_spec = P(None, batch_axis)
     out = shard_map(
         local_fn, mesh=mesh,
-        in_specs=(params_spec, P()),
-        out_specs=P())(stacked_params, micro)
+        in_specs=(params_spec, micro_spec),
+        out_specs=micro_spec)(stacked_params, micro)
     return out.reshape((batch,) + out.shape[2:])

@@ -35,7 +35,7 @@ _NEG_INF = -1e30
 
 
 def _chunk_attention(q, k, v, row_offset, col_offset, kv_len, causal,
-                     sm_scale):
+                     sm_scale, key_mask=None):
     """Attention of a Q chunk against one K/V chunk, with logsumexp.
 
     Args:
@@ -45,6 +45,8 @@ def _chunk_attention(q, k, v, row_offset, col_offset, kv_len, causal,
             chunks (traced values; the ring rotates col_offset).
         kv_len: True global K/V length (masks ring padding).
         causal / sm_scale: As in `ring_attention`.
+        key_mask: Optional [B, Sk] per-example key validity for THIS
+            visiting chunk (True = attend); rotates with k/v.
 
     Returns:
         (out, lse): normalized chunk output [B, Sq, H, D] and its
@@ -57,7 +59,10 @@ def _chunk_attention(q, k, v, row_offset, col_offset, kv_len, causal,
     mask = (cols < kv_len)[None, :]
     if causal:
         mask = mask & (cols[None, :] <= rows[:, None])
-    logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    mask = mask[None, None]                 # [1, 1, {1|Sq}, Sk]
+    if key_mask is not None:
+        mask = mask & key_mask[:, None, None, :]  # [B, 1, {1|Sq}, Sk]
+    logits = jnp.where(mask, logits, _NEG_INF)
 
     m = jnp.max(logits, axis=-1)                      # [B, H, Sq]
     p = jnp.exp(logits - m[..., None])
@@ -86,7 +91,7 @@ def _merge(o1, lse1, o2, lse2):
 
 
 def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
-                   kv_len=None):
+                   kv_len=None, mask=None):
     """Sequence-parallel attention inside `shard_map`.
 
     Call this from a `shard_map`-ed function whose inputs shard the
@@ -100,6 +105,13 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
         sm_scale: Softmax scale; default 1/sqrt(D).
         kv_len: True global sequence length when the padded global length
             (S_local * axis_size) exceeds it; default no padding.
+        mask: Optional [B, S_local] boolean key mask for THIS device's
+            local sequence chunk (True = attend) — the per-example
+            padding contract of `flash_attention`, sharded with the
+            sequence. The mask chunk rotates around the ring alongside
+            its k/v chunk. Rows whose keys end up all masked output
+            zeros (flash convention). Any pattern is supported, not
+            just contiguous prefixes.
 
     Returns:
         Local output chunk [B, S_local, H, D], same dtype as q.
@@ -111,27 +123,32 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
     s_local = q.shape[1]
     if kv_len is None:
         kv_len = s_local * axis_size
+    if mask is not None:
+        mask = mask.astype(bool)
 
     row_offset = my_index * s_local
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    def compute_chunk(out, lse, ck, cv, chunk_index):
+    def compute_chunk(out, lse, ck, cv, cm, chunk_index):
         """Folds one visiting chunk into (out, lse), skipping the
         attention compute entirely for chunks strictly above the causal
         diagonal (their mask is all-False; `lax.cond` makes that a real
-        skip, not a masked full-price einsum)."""
-        def visit(out, lse, ck, cv):
+        skip, not a masked full-price einsum). `cm` is the visiting
+        chunk's key mask (None when no padding mask is in play — a
+        static choice, so the no-mask path compiles identically to
+        before)."""
+        def visit(out, lse, ck, cv, cm):
             chunk_out, chunk_lse = _chunk_attention(
                 q, ck, cv, row_offset, chunk_index * s_local, kv_len,
-                causal, sm_scale)
+                causal, sm_scale, key_mask=cm)
             return _merge(out, lse, chunk_out, chunk_lse)
 
         if not causal:
-            return visit(out, lse, ck, cv)
+            return visit(out, lse, ck, cv, cm)
         fully_masked = chunk_index * s_local > row_offset + s_local - 1
         return jax.lax.cond(fully_masked,
-                            lambda out, lse, ck, cv: (out, lse),
-                            visit, out, lse, ck, cv)
+                            lambda out, lse, ck, cv, cm: (out, lse),
+                            visit, out, lse, ck, cv, cm)
 
     # Derived from q (not fresh literals) so the carry is marked varying
     # over `axis_name` under shard_map's per-axis type system.
@@ -139,33 +156,62 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
     lse0 = jnp.sum(out0, axis=-1) - jnp.inf           # [B, Sq, H]
 
     # Step 0: the locally-resident chunk, no rotation needed.
-    out, lse = compute_chunk(out0, lse0, k, v, my_index)
+    out, lse = compute_chunk(out0, lse0, k, v, mask, my_index)
 
     @jax.checkpoint
     def body(carry, step):
-        out, lse, ck, cv = carry
+        # `mask is None` is static: the carry simply has no mask leaf
+        # on the unmasked path (None is an empty pytree).
+        out, lse, ck, cv, cm = carry
         ck = jax.lax.ppermute(ck, axis_name, perm)
         cv = jax.lax.ppermute(cv, axis_name, perm)
+        if cm is not None:
+            cm = jax.lax.ppermute(cm, axis_name, perm)
         # After `step` forward rotations, this device holds the chunk
         # originally resident on (my_index - step) mod n.
         chunk_index = jax.lax.rem(my_index - step + axis_size, axis_size)
-        out, lse = compute_chunk(out, lse, ck, cv, chunk_index)
-        return (out, lse, ck, cv), None
+        out, lse = compute_chunk(out, lse, ck, cv, cm, chunk_index)
+        return (out, lse, ck, cv, cm), None
 
-    (out, _, _, _), _ = jax.lax.scan(
-        body, (out, lse, k, v), jnp.arange(1, axis_size))
+    (out, _, _, _, _), _ = jax.lax.scan(
+        body, (out, lse, k, v, mask), jnp.arange(1, axis_size))
     return out.astype(q.dtype)
+
+
+def sharded_sp_call(shard_map_fn, fn, mesh, spec, seq_axis, q, k, v,
+                     mask):
+    """Shared masked/unmasked shard_map entry for the sp strategies.
+
+    One place owns the mask leg of the entry contract (shape check,
+    [B, S] spec over (batch, sequence) axes, bool cast) so ring and
+    ulysses can't drift apart.
+    """
+    if mask is None:
+        return shard_map_fn(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec)(q, k, v)
+    expect = (q.shape[0], q.shape[1])
+    if mask.shape != expect:
+        raise ValueError(
+            "mask must be [batch, seq] = {}; got {}.".format(
+                expect, mask.shape))
+    mask_spec = P(spec[0], seq_axis)
+    masked = lambda q, k, v, m: fn(q, k, v, mask=m)
+    return shard_map_fn(masked, mesh=mesh,
+                        in_specs=(spec, spec, spec, mask_spec),
+                        out_specs=spec)(q, k, v, mask.astype(bool))
 
 
 def sequence_parallel_attention(q, k, v, mesh=None, axis="sp", causal=True,
                                 sm_scale=None, batch_axis="auto",
-                                head_axis="auto"):
+                                head_axis="auto", mask=None):
     """Ring attention over global [B, S, H, D] arrays on a mesh.
 
     The standalone entry point: shards the sequence dim over `axis` with
     `shard_map` and runs `ring_attention` per shard. S must divide by the
     axis size (pad upstream; causal masking makes right-padding safe for
-    all non-pad rows).
+    all non-pad rows). `mask` is the global [B, S] boolean key mask
+    (True = attend, the `flash_attention` padded-batch contract); it is
+    sharded over `axis` with the sequence and rotates with k/v.
 
     batch_axis: Mesh axis the batch dim is sharded over — "auto" picks
     the ambient data axis ("dp") when the mesh has one, so ring (sp) and
@@ -237,5 +283,5 @@ def sequence_parallel_attention(q, k, v, mesh=None, axis="sp", causal=True,
     spec = P(batch_axis, axis, head_axis, None)
     fn = functools.partial(ring_attention, axis_name=axis, causal=causal,
                            sm_scale=sm_scale, kv_len=seq)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    return sharded_sp_call(shard_map, fn, mesh, spec, axis, q, k, v,
+                           mask)

@@ -43,7 +43,7 @@ from jax.sharding import PartitionSpec as P
 
 
 def ulysses_local(q, k, v, axis_name, causal=True, sm_scale=None,
-                  impl="auto"):
+                  impl="auto", mask=None):
     """Ulysses attention on per-device shards inside `shard_map`.
 
     Args:
@@ -54,6 +54,12 @@ def ulysses_local(q, k, v, axis_name, causal=True, sm_scale=None,
         causal / sm_scale: As in `cloud_tpu.ops.attention`.
         impl: Attention implementation for the full-sequence local
             compute ("auto" = flash kernel on TPU).
+        mask: Optional [B, S_local] boolean key mask for this device's
+            sequence chunk (True = attend). The local attention after
+            the head/sequence exchange covers the FULL sequence, so the
+            mask chunks are all-gathered along `axis_name` — [B, S]
+            bools, a negligible fraction of the q/k/v all-to-all bytes
+            — and handed to the kernel's native masked path.
 
     Returns:
         Local output chunk [B, S_local, H, D], same dtype as q.
@@ -88,14 +94,19 @@ def ulysses_local(q, k, v, axis_name, causal=True, sm_scale=None,
         return jax.lax.all_to_all(x, axis_name, split_axis=1,
                                   concat_axis=2, tiled=True)
 
+    full_mask = None
+    if mask is not None:
+        full_mask = jax.lax.all_gather(mask.astype(bool), axis_name,
+                                       axis=1, tiled=True)
     out = ops.attention(scatter_heads(q), scatter_heads(k),
                         scatter_heads(v), causal=causal,
-                        sm_scale=sm_scale, impl=impl)
+                        sm_scale=sm_scale, impl=impl, mask=full_mask)
     return scatter_seq(out)
 
 
 def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=True,
-                      sm_scale=None, batch_axis="auto", impl="auto"):
+                      sm_scale=None, batch_axis="auto", impl="auto",
+                      mask=None):
     """Ulysses sequence-parallel attention over global [B, S, H, D].
 
     The standalone entry point, API-compatible with
@@ -103,6 +114,9 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=True,
     `axis` with `shard_map`, all-to-alls into head-sharded
     full-sequence layout, runs the flash/reference kernel, and
     all-to-alls back. S and H must both divide by the axis size.
+    `mask` is the global [B, S] boolean key mask (True = attend); it is
+    sharded over `axis` and re-gathered inside the shard for the
+    full-sequence local kernel.
 
     batch_axis: Mesh axis the batch dim is sharded over — "auto" picks
     the ambient data axis ("dp") when present, so Ulysses (sp) and data
@@ -150,8 +164,10 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=True,
                 "Batch size {} is not divisible by the {!r} axis size "
                 "{}.".format(batch, batch_axis, mesh.shape[batch_axis]))
 
+    from cloud_tpu.parallel.ring_attention import sharded_sp_call
+
     spec = P(batch_axis, axis, None, None)
     fn = functools.partial(ulysses_local, axis_name=axis, causal=causal,
                            sm_scale=sm_scale, impl=impl)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    return sharded_sp_call(shard_map, fn, mesh, spec, axis, q, k, v,
+                           mask)

@@ -2,7 +2,8 @@ from cloud_tpu.training.callbacks import (Callback, EarlyStopping,
                                           LambdaCallback, MetricsLogger,
                                           ModelCheckpoint, read_metrics_log)
 from cloud_tpu.training.data import (ArrayDataset, GeneratorDataset,
-                                     ThreadedDataset, prefetch_to_device)
+                                     NpzShardDataset, ThreadedDataset,
+                                     prefetch_to_device)
 from cloud_tpu.training import schedules
 from cloud_tpu.training.trainer import (Trainer, TrainState,
                                         sparse_categorical_crossentropy)

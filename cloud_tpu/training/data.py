@@ -146,19 +146,70 @@ class GeneratorDataset:
     `steps_per_epoch` bounds each epoch for non-terminating streams
     (Trainer.fit picks it up when its own steps_per_epoch is unset).
 
-    Note: cloud_fit ships arrays (np.savez), not factories — materialize
-    a representative array set for remote training.
+    cloud_fit ships this WITHOUT materializing the stream: a
+    module-level `factory` travels as its dotted path plus
+    `factory_kwargs` (JSON), and the remote worker rebuilds the dataset
+    and pulls batches there (the JAX-native analogue of the reference
+    shipping datasets as serialized tf.functions,
+    reference cloud_fit/client.py:151-189).
     """
 
-    def __init__(self, factory, steps_per_epoch=None):
+    def __init__(self, factory, steps_per_epoch=None,
+                 factory_kwargs=None):
         if not callable(factory):
             raise TypeError("factory must be callable, got {!r}"
                             .format(type(factory)))
         self.factory = factory
         self.steps_per_epoch = steps_per_epoch
+        self.factory_kwargs = dict(factory_kwargs or {})
 
     def __iter__(self):
-        return iter(self.factory())
+        return iter(self.factory(**self.factory_kwargs))
+
+
+class NpzShardDataset:
+    """Batches from .npz shards already sitting on storage.
+
+    The cloud_fit shard-manifest path: the client ships only the list
+    of shard paths (JSON manifest); the worker streams each shard
+    through the storage seam (local or gs://) per epoch — data that
+    never fits one `np.asarray` crosses as references, not bytes.
+
+    Each shard is an .npz with an `x` array (and optionally `y`),
+    uniform across shards except possibly a short last shard. Batches
+    of `batch_size` are cut per shard; a shard tail smaller than
+    `batch_size` is dropped (static shapes for XLA) unless the shard
+    yields no full batch at all, in which case it is yielded whole.
+    """
+
+    def __init__(self, shard_paths, batch_size=32):
+        if not shard_paths:
+            raise ValueError("shard_paths must be non-empty.")
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive.")
+        self.shard_paths = [str(p) for p in shard_paths]
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        import io
+
+        from cloud_tpu.utils import storage
+
+        for path in self.shard_paths:
+            arrays = np.load(io.BytesIO(storage.read_bytes(path)))
+            x = arrays["x"]
+            y = arrays["y"] if "y" in arrays.files else None
+            n = x.shape[0]
+            steps = n // self.batch_size
+            if steps == 0:
+                yield (x, y) if y is not None else x
+                continue
+            for i in range(steps):
+                sl = slice(i * self.batch_size, (i + 1) * self.batch_size)
+                if y is not None:
+                    yield x[sl], y[sl]
+                else:
+                    yield x[sl]
 
 
 class ThreadedDataset:

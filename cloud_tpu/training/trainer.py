@@ -894,7 +894,13 @@ class Trainer:
         totals, weight = {}, 0.0
         for real, fed in feeder:
             logs = self._jit_eval_step(eval_state, fed)
-            if (global_bs is not None and real < global_bs
+            # Padding only ever happens on the ArrayDataset path
+            # (num_examples known, tail wrapped); datasets that just
+            # yield a short final batch (e.g. shard tails) are short,
+            # not padded — their mask is all-ones and every metric is
+            # exact.
+            if (num_examples is not None and global_bs is not None
+                    and real < global_bs
                     and self._scalar_unmasked_metrics):
                 # A padded tail batch would silently fold duplicated
                 # rows into these metrics' batch means.

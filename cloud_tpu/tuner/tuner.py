@@ -374,9 +374,10 @@ class DistributingCloudTuner(CloudTuner):
             storage.join(trial_dir, cloud_fit_client.SPEC_FILE)))
         trainer = cloud_fit_remote.build_trainer(spec)
         output_dir = storage.join(trial_dir, cloud_fit_remote.OUTPUT_DIR)
-        if storage.is_gcs_path(output_dir):
-            raise NotImplementedError(
-                "Restoring from gs:// requires a local mirror.")
         trainer.build(sample_x)
+        # gs:// works as-is: checkpoint.restore hands the URI straight
+        # to orbax, whose tensorstore backend reads GCS directly — the
+        # per-trial layout real distributed trials write (the reference
+        # leaves remote restore NotImplemented, tuner.py:562-567).
         trainer.state = checkpoint_lib.restore(output_dir, trainer.state)
         return trainer

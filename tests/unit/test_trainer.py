@@ -177,6 +177,24 @@ class TestFit:
                                 verbose=False)
         assert np.isfinite(logs["scalar_metric"])
 
+    def test_scalar_metric_ok_on_short_unpadded_batch(self):
+        """A dataset that yields a genuinely SHORT final batch (no
+        wrapping, mask all-ones) is exact for any metric — the padded
+        guard must not fire (it conflated short with padded once)."""
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=42)
+
+        def scalar_metric(outputs, y):
+            return jnp.mean(jnp.argmax(outputs, -1) == y)
+
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          metrics=(scalar_metric,))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        batches = [(x[:32], y[:32]), (x[32:], y[32:])]  # short tail
+        logs = trainer.evaluate(batches, verbose=False)
+        assert np.isfinite(logs["scalar_metric"])
+
     def test_validation_data(self):
         x, y = _toy_classification()
         trainer = Trainer(MLP(hidden=16, num_classes=4))

@@ -548,3 +548,62 @@ class TestSharedStudy:
         # The late worker still sees the full study history.
         best = t2.get_best_hyperparameters(1)
         assert best[0].get("units") == 32
+
+
+class TestLoadTrainerGCS:
+    """load_trainer must accept the gs:// layout DistributingCloudTuner
+    itself writes (round-2 gap: a NotImplementedError guard broke the
+    tuner's only model-recovery path for real trials). orbax restores
+    gs:// natively via tensorstore, so the wiring — spec read through
+    the storage seam, the UNchanged gs:// URI handed to
+    checkpoint.restore — is what this pins."""
+
+    def test_gs_path_reaches_checkpoint_restore(self, monkeypatch):
+        import pickle
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import Trainer
+        from cloud_tpu.tuner import tuner as tuner_module
+
+        def hypermodel(hp):
+            return Trainer(MLP(hidden=hp.get("units"), num_classes=4),
+                           optimizer="adam")
+
+        fake = FakeVizier(max_suggestions=1)
+        monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "p")
+        tuner = DistributingCloudTuner(
+            hypermodel, remote_dir="gs://bkt/tuning",
+            project_id="p", region="us-central1",
+            objective=Objective("accuracy", "max"),
+            hyperparameters=_search_space(),
+            max_trials=1, study_id="s_gcs",
+            service_client=fake.service)
+
+        # The spec the remote worker would have written for the trial.
+        spec_trainer = hypermodel(_search_space())
+        spec = tuner_module.cloud_fit_client.make_spec(spec_trainer)
+
+        reads, restores = [], []
+
+        def fake_read_bytes(path):
+            reads.append(path)
+            return pickle.dumps(spec)
+
+        def fake_restore(directory, target, step=None):
+            restores.append(directory)
+            return target
+
+        monkeypatch.setattr(tuner_module.storage, "read_bytes",
+                            fake_read_bytes)
+        monkeypatch.setattr(
+            "cloud_tpu.training.checkpoint.restore", fake_restore)
+
+        trial = mock.MagicMock()
+        trial.trial_id = "7"
+        trainer = tuner.load_trainer(
+            trial, np.zeros((1, 8), np.float32))
+        assert trainer.state is not None
+        assert reads == ["gs://bkt/tuning/7/{}".format(
+            tuner_module.cloud_fit_client.SPEC_FILE)]
+        assert restores == ["gs://bkt/tuning/7/{}".format(
+            tuner_module.cloud_fit_remote.OUTPUT_DIR)]

@@ -127,8 +127,6 @@ class TestUlyssesAttention:
         q = jnp.zeros((1, 8, 2, 4))
         with pytest.raises(ValueError, match="Unknown"):
             sp_attention("rings", q, q, q)
-        with pytest.raises(NotImplementedError, match="mask"):
-            sp_attention("ring", q, q, q, mask=jnp.ones((1, 8), bool))
 
     def test_dp_composition(self):
         """Batch sharded on dp AND sequence on sp in one call."""
@@ -222,3 +220,94 @@ class TestUlyssesInModels:
         prompt = jnp.zeros((1, 4), jnp.int32)
         with pytest.raises(NotImplementedError):
             generate(model, {}, prompt, max_new_tokens=2, temperature=0)
+
+
+class TestUlyssesPaddingMask:
+    """Key masks on the Ulysses path: chunks are all-gathered back to
+    the full [B, S] mask for the local full-sequence kernel."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_prefix_mask_matches_reference(self, sp_mesh, causal):
+        q, k, v = _rand_qkv()
+        mask = jnp.asarray(np.arange(32)[None, :] < np.array([[32], [20]]))
+        out = ulysses_attention(q, k, v, mesh=sp_mesh, causal=causal,
+                                mask=mask)
+        expected = mha_reference(q, k, v, causal=causal, mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_mask_with_gqa_grouped_kv(self, sp_mesh):
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.normal(size=(2, 32, 4, 8)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 32, 2, 8)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 32, 2, 8)).astype(np.float32))
+        mask = jnp.asarray(np.arange(32)[None, :] < np.array([[24], [32]]))
+        out = ulysses_attention(q, k, v, mesh=sp_mesh, causal=True,
+                                mask=mask)
+        expected = mha_reference(q, k, v, causal=True, mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_mask_gradients_match_reference(self, sp_mesh):
+        q, k, v = _rand_qkv(seq=16)
+        mask = jnp.asarray(np.arange(16)[None, :] < np.array([[16], [9]]))
+
+        def uly_loss(q, k, v):
+            return ulysses_attention(q, k, v, mesh=sp_mesh, causal=True,
+                                     mask=mask).sum()
+
+        def ref_loss(q, k, v):
+            return mha_reference(q, k, v, causal=True, mask=mask).sum()
+
+        g_u = jax.grad(uly_loss, argnums=(0, 1, 2))(q, k, v)
+        g_r = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_u, g_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_sp_attention_dispatch_forwards_mask(self, sp_mesh):
+        from cloud_tpu.parallel import sp_attention
+        q, k, v = _rand_qkv()
+        mask = jnp.asarray(np.arange(32)[None, :] < np.array([[32], [20]]))
+        for impl in ("ring", "ulysses"):
+            out = sp_attention(impl, q, k, v, causal=True, mask=mask)
+            expected = mha_reference(q, k, v, causal=True, mask=mask)
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(expected),
+                                       atol=2e-5, rtol=2e-5)
+
+    def test_bad_mask_shape_rejected(self, sp_mesh):
+        q, k, v = _rand_qkv()
+        with pytest.raises(ValueError, match="mask"):
+            ulysses_attention(q, k, v, mesh=sp_mesh,
+                              mask=jnp.ones((2, 8), dtype=bool))
+
+
+class TestModelPaddedSequenceParallel:
+    """Padded batches must stay on the sp path end-to-end through the
+    model families (round-2 gap: NotImplementedError fell them off)."""
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_transformer_lm_padded_matches_reference_impl(self, impl):
+        from cloud_tpu.models import TransformerLM
+
+        devices = np.array(jax.devices()[:4]).reshape(1, 4)
+        with Mesh(devices, ("dp", "sp")):
+            model_kw = dict(vocab_size=64, d_model=32, num_heads=4,
+                            num_layers=1, max_seq_len=32,
+                            compute_dtype=jnp.float32)
+            tokens = jnp.asarray(
+                np.random.default_rng(0).integers(0, 64, size=(2, 32)),
+                dtype=jnp.int32)
+            mask = jnp.asarray(
+                np.arange(32)[None, :] < np.array([[32], [20]]))
+            sp_model = TransformerLM(attention_impl=impl, **model_kw)
+            ref_model = TransformerLM(attention_impl="reference",
+                                      **model_kw)
+            params = sp_model.init(jax.random.PRNGKey(0), tokens,
+                                   mask=mask)
+            out_sp = sp_model.apply(params, tokens, mask=mask)
+            out_ref = ref_model.apply(params, tokens, mask=mask)
+            np.testing.assert_allclose(np.asarray(out_sp),
+                                       np.asarray(out_ref),
+                                       atol=2e-4, rtol=2e-4)
