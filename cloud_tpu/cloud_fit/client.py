@@ -133,11 +133,20 @@ def dataset_spec(x):
                 "factory to module scope and parameterize it via "
                 "factory_kwargs.".format(ds.factory))
         try:
-            json.dumps(ds.factory_kwargs)
+            roundtrip = json.loads(json.dumps(ds.factory_kwargs))
         except (TypeError, ValueError):
             raise ValueError(
                 "factory_kwargs must be JSON-serializable to ship "
                 "through cloud_fit; got {!r}.".format(ds.factory_kwargs))
+        if roundtrip != ds.factory_kwargs:
+            # Values that *serialize* but come back different (tuples
+            # -> lists) would make the factory behave differently on
+            # the worker than in the local run the user validated.
+            raise ValueError(
+                "factory_kwargs must survive a JSON round-trip "
+                "unchanged (tuples become lists); got {!r} -> {!r}. "
+                "Use lists/dicts/scalars only.".format(
+                    ds.factory_kwargs, roundtrip))
         spec.update(kind="generator", factory=path,
                     factory_kwargs=ds.factory_kwargs,
                     steps_per_epoch=ds.steps_per_epoch)

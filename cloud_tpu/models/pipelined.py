@@ -77,8 +77,9 @@ class PipelinedLM:
                  max_seq_len=2048, num_microbatches=4,
                  compute_dtype=jnp.bfloat16, pp_axis="pp"):
         if d_model % num_heads:
-            raise ValueError("d_model {} must divide num_heads {}."
-                             .format(d_model, num_heads))
+            raise ValueError(
+                "d_model {} must be divisible by num_heads {}."
+                .format(d_model, num_heads))
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.num_heads = num_heads

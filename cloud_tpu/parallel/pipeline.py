@@ -147,6 +147,16 @@ def pipeline_apply(stage_fn, stacked_params, x, num_microbatches,
                       if sharding_lib.DATA_AXIS in mesh.axis_names
                       else None)
         if batch_axis is not None and micro_b % mesh.shape[batch_axis]:
+            # Falling back to replication is correct but duplicates the
+            # whole schedule on every dp group — say so instead of
+            # silently burning dp-fold compute.
+            import logging
+            logging.getLogger("cloud_tpu").warning(
+                "pipeline_apply: microbatch size %d does not divide the "
+                "'%s' axis (size %d); running the pipeline REPLICATED "
+                "across it. Raise the batch or lower num_microbatches "
+                "to restore data parallelism.",
+                micro_b, batch_axis, mesh.shape[batch_axis])
             batch_axis = None
     elif batch_axis is not None:
         if batch_axis not in mesh.axis_names:

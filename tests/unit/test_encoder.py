@@ -6,6 +6,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
+
 from cloud_tpu.models import (TransformerEncoder, tensor_parallel_rules)
 from cloud_tpu.parallel import runtime
 from cloud_tpu.training import Trainer

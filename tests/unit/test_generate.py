@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
+
 from cloud_tpu.models import TransformerLM, generate
 
 

@@ -12,6 +12,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
+
 from cloud_tpu.models import (LlamaLM, generate,
                               llama_tensor_parallel_rules)
 from cloud_tpu.models.llama import apply_rope, repeat_kv

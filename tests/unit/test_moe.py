@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cloud_tpu.models.moe import MoEMLP, expert_parallel_rules

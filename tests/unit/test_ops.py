@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
+
 from cloud_tpu.ops import attention, flash_attention, mha_reference
 
 TOL = 2e-5

@@ -8,6 +8,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
 from jax.sharding import Mesh
 
 from cloud_tpu.parallel import runtime

@@ -10,6 +10,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
+
 from cloud_tpu.models import MLP, ConvNet, TransformerLM, ResNet18
 from cloud_tpu.models import tensor_parallel_rules
 from cloud_tpu.parallel import runtime

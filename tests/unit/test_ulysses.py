@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
+
+pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
 from jax.sharding import Mesh
 
 from cloud_tpu.ops import mha_reference
