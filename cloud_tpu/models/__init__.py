@@ -5,6 +5,7 @@ from cloud_tpu.models.resnet import (ResNet, ResNet18, ResNet34, ResNet50,
                                      ResNet101, ResNet152)
 from cloud_tpu.models.moe import MoEMLP, expert_parallel_rules
 from cloud_tpu.models.pipelined import PipelinedLM, pipelined_lm_rules
+from cloud_tpu.models.hf_import import import_hf_llama
 from cloud_tpu.models.transformer import (TransformerEncoder,
                                           TransformerLM, generate,
                                           tensor_parallel_rules)

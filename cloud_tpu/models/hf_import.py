@@ -1,0 +1,201 @@
+"""Import HuggingFace Llama-family checkpoints into `LlamaLM`.
+
+The bridge from the open-checkpoint ecosystem to this framework's
+TPU-native Llama implementation (no reference equivalent — the
+reference loads Keras SavedModels only, SURVEY §2.1 #18-19). Converts a
+`transformers` `LlamaForCausalLM` (or its raw state_dict + config) into
+the flax param pytree `cloud_tpu.models.LlamaLM` expects, building the
+model with `rope_style="rotate_half"` — the pairing the checkpoint's
+q/k projections were trained against (llama.py:apply_rope).
+
+Layout mapping (HF torch [out, in] row-major vs flax [in, out(+split)]):
+
+    model.embed_tokens.weight [V, d]      -> embed/embedding [V, d]
+    layers.i.input_layernorm.weight       -> block_i/norm_attn/scale
+    layers.i.self_attn.q_proj.weight      -> block_i/attention/query/
+        [H*hd, d]                            kernel [d, H, hd] (T+reshape)
+    layers.i.self_attn.{k,v}_proj.weight  -> key/value kernels
+        [Hkv*hd, d]                          [d, Hkv, hd]
+    layers.i.self_attn.o_proj.weight      -> block_i/attention/out/
+        [d, H*hd]                            kernel [H, hd, d]
+    layers.i.post_attention_layernorm     -> block_i/norm_mlp/scale
+    layers.i.mlp.{gate,up}_proj.weight    -> block_i/mlp/{gate,up}/
+        [f, d]                               kernel [d, f]
+    layers.i.mlp.down_proj.weight [d, f]  -> block_i/mlp/down/kernel [f, d]
+    model.norm.weight                     -> norm_final/scale
+    lm_head.weight [V, d]                 -> lm_head/kernel [d, V]
+        (falls back to tied embed_tokens when absent)
+
+Works offline: only numpy/jax are required for the conversion itself;
+`transformers`/`torch` are touched only to read the input model.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from cloud_tpu.models.llama import LlamaLM
+
+
+def _to_numpy(tensor):
+    """torch tensor (any dtype/device) -> float32 numpy array."""
+    if hasattr(tensor, "detach"):
+        tensor = tensor.detach()
+        if hasattr(tensor, "float"):
+            tensor = tensor.float()
+        if hasattr(tensor, "cpu"):
+            tensor = tensor.cpu()
+        return np.asarray(tensor)
+    return np.asarray(tensor, dtype=np.float32)
+
+
+def import_hf_llama(model=None, state_dict=None, config=None,
+                    compute_dtype=jnp.bfloat16, attention_impl="auto",
+                    max_seq_len=None):
+    """Converts an HF Llama-family model to (LlamaLM, variables).
+
+    Args:
+        model: A `transformers.LlamaForCausalLM`-like module (anything
+            with `.config` and `.state_dict()`); OR pass
+            `state_dict` + `config` explicitly.
+        state_dict: Mapping of HF parameter names to tensors/arrays.
+        config: HF config object or dict with hidden_size,
+            num_attention_heads, num_key_value_heads,
+            intermediate_size, num_hidden_layers, vocab_size,
+            rope_theta, rms_norm_eps, max_position_embeddings.
+        compute_dtype: LlamaLM compute dtype (params stay f32; bf16
+            compute is the TPU default).
+        attention_impl: Forwarded to LlamaLM.
+        max_seq_len: Override the checkpoint's max_position_embeddings
+            (e.g. to cap decode-cache memory).
+
+    Returns:
+        (model, variables): an un-initialized `LlamaLM` configured to
+        match the checkpoint (rotate-half RoPE, checkpoint theta) and
+        the `{"params": ...}` variables dict for `model.apply`.
+    """
+    if model is not None:
+        state_dict = {k: v for k, v in model.state_dict().items()}
+        config = model.config
+    if state_dict is None or config is None:
+        raise ValueError("Pass either `model` or both `state_dict` "
+                         "and `config`.")
+
+    def cfg(name, default=None):
+        if isinstance(config, dict):
+            value = config.get(name, default)
+        else:
+            value = getattr(config, name, default)
+        if value is None and default is None:
+            raise ValueError("HF config is missing {!r}.".format(name))
+        return value
+
+    d_model = cfg("hidden_size")
+    heads = cfg("num_attention_heads")
+    kv_heads = cfg("num_key_value_heads", heads)
+    layers = cfg("num_hidden_layers")
+    head_dim = d_model // heads
+
+    window = cfg("sliding_window", False)
+    horizon = max_seq_len or cfg("max_position_embeddings", 2048)
+    if window and window < horizon:
+        # Mistral-style checkpoints were trained with sliding-window
+        # attention; LlamaLM's full causal attention would attend to
+        # tokens the checkpoint never saw for sequences past the
+        # window — silently wrong logits. Importing is fine when usage
+        # stays within the window.
+        raise NotImplementedError(
+            "This checkpoint uses sliding-window attention "
+            "(window={}), which LlamaLM does not implement; pass "
+            "max_seq_len <= {} to import for within-window use."
+            .format(window, window))
+
+    rope_scaling = cfg("rope_scaling", False)
+    if rope_scaling:
+        # Llama-3.1-style frequency scaling changes the rotation math,
+        # not just the layout; importing would silently mis-rotate the
+        # low-frequency components.
+        raise NotImplementedError(
+            "This checkpoint uses rope_scaling={!r}, which "
+            "import_hf_llama does not implement; only plain "
+            "theta-parameterized RoPE imports.".format(rope_scaling))
+
+    consumed = set()
+
+    def take(name):
+        if name not in state_dict:
+            raise KeyError(
+                "HF state_dict is missing {!r} (have e.g. {}).".format(
+                    name, sorted(state_dict)[:5]))
+        consumed.add(name)
+        return _to_numpy(state_dict[name])
+
+    params = {
+        "embed": {"embedding": take("model.embed_tokens.weight")},
+        "norm_final": {"scale": take("model.norm.weight")},
+    }
+    if "lm_head.weight" in state_dict:
+        head_w = take("lm_head.weight").T  # [V, d] -> [d, V]
+    else:
+        # Tied embeddings (e.g. Gemma-style / tie_word_embeddings).
+        head_w = params["embed"]["embedding"].T.copy()
+    params["lm_head"] = {"kernel": head_w}
+
+    for i in range(layers):
+        hf = "model.layers.{}.".format(i)
+
+        def proj(name, n_heads):
+            # [n*hd, d] row-major -> [d, n, hd] flax DenseGeneral.
+            w = take(hf + "self_attn.{}_proj.weight".format(name))
+            return w.reshape(n_heads, head_dim, d_model).transpose(2, 0, 1)
+
+        o = take(hf + "self_attn.o_proj.weight")  # [d, H*hd]
+        params["block_%d" % i] = {
+            "norm_attn": {"scale": take(hf + "input_layernorm.weight")},
+            "norm_mlp": {
+                "scale": take(hf + "post_attention_layernorm.weight")},
+            "attention": {
+                "query": {"kernel": proj("q", heads)},
+                "key": {"kernel": proj("k", kv_heads)},
+                "value": {"kernel": proj("v", kv_heads)},
+                "out": {"kernel": o.T.reshape(heads, head_dim, d_model)},
+            },
+            "mlp": {
+                "gate": {"kernel": take(hf + "mlp.gate_proj.weight").T},
+                "up": {"kernel": take(hf + "mlp.up_proj.weight").T},
+                "down": {"kernel": take(hf + "mlp.down_proj.weight").T},
+            },
+        }
+
+    # Every parameter in the checkpoint must have landed somewhere:
+    # silently dropping e.g. Qwen-style q/k/v biases would produce a
+    # model whose logits are wrong with nothing flagging it. (Non-
+    # parameter buffers like rotary inv_freq tables are derivable and
+    # skipped.)
+    leftover = sorted(
+        name for name in state_dict
+        if name not in consumed and "rotary_emb" not in name)
+    if leftover:
+        raise ValueError(
+            "HF state_dict has parameters this importer does not map "
+            "(the imported model would silently diverge): {}".format(
+                leftover[:8]))
+
+    lm = LlamaLM(
+        vocab_size=cfg("vocab_size"),
+        num_layers=layers,
+        num_heads=heads,
+        num_kv_heads=kv_heads,
+        d_model=d_model,
+        d_ff=cfg("intermediate_size"),
+        max_seq_len=max_seq_len or cfg("max_position_embeddings", 2048),
+        rope_theta=float(cfg("rope_theta", 10000.0)),
+        rope_style="rotate_half",
+        norm_eps=float(cfg("rms_norm_eps", 1e-6)),
+        compute_dtype=compute_dtype,
+        attention_impl=attention_impl,
+    )
+    return lm, {"params": params}
+
+
+__all__ = ["import_hf_llama"]

@@ -24,15 +24,14 @@ names, same "cache" collection shape conventions), so `generate()` —
 the jitted prefill + `lax.scan` decode loop in
 `cloud_tpu/models/transformer.py` — drives it unchanged.
 
-RoPE convention: `apply_rope` rotates INTERLEAVED (even, odd) feature
-pairs — the GPT-NeoX layout — not Llama's rotate-half (first half vs
-second half). Self-consistent for from-scratch training (the two are
-related by a fixed permutation of head_dim features, which the learned
-q/k projections absorb), but weights are NOT layout-compatible with
-real Llama/Mistral checkpoints as-is: importing one requires permuting
-the q/k projection output features from rotate-half order
-`[0..D/2, D/2..D]` to interleaved order `[0, D/2, 1, D/2+1, ...]`
-(per head), or swapping `apply_rope` for a rotate-half variant.
+RoPE convention: the default `rope_style="interleaved"` rotates
+(even, odd) feature pairs — the GPT-NeoX layout. Real Llama/Mistral
+checkpoints were trained against the rotate-half pairing (first half
+vs second half); the two are related by a fixed permutation of
+head_dim features, which from-scratch training absorbs into the
+learned q/k projections. To run imported weights, build the model with
+`rope_style="rotate_half"` — `models.hf_import.import_hf_llama` does
+this for you and converts HF param layouts to this module's.
 """
 
 from typing import Optional
@@ -46,13 +45,23 @@ from jax.sharding import PartitionSpec as P
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
 
 
-def apply_rope(x, positions, theta: float = 10000.0):
+def apply_rope(x, positions, theta: float = 10000.0,
+               style: str = "interleaved"):
     """Rotary position embedding over the last (head_dim) axis.
 
     x: [B, S, H, D] (D even); positions: [S] or [B, S] int32.
-    Returns x with each (even, odd) feature pair rotated by
-    pos * theta^(-2i/D) — f32 rotation math regardless of input dtype
-    (bf16 angles at position ~10k would quantize to whole radians).
+    Rotates feature pairs by pos * theta^(-2i/D) — f32 rotation math
+    regardless of input dtype (bf16 angles at position ~10k would
+    quantize to whole radians).
+
+    style selects which features pair up (the two conventions are
+    related by a fixed permutation of head_dim features):
+      - "interleaved": (even, odd) pairs — the GPT-NeoX layout, this
+        framework's from-scratch default.
+      - "rotate_half": (i, i + D/2) pairs — the Llama/HF layout;
+        REQUIRED for weights imported from real Llama/Mistral
+        checkpoints (`models.hf_import`), whose q/k projections were
+        trained against this pairing.
     """
     head_dim = x.shape[-1]
     if head_dim % 2:
@@ -64,10 +73,21 @@ def apply_rope(x, positions, theta: float = 10000.0):
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
     cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, D/2]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1 = x[..., 0::2].astype(jnp.float32)
-    x2 = x[..., 1::2].astype(jnp.float32)
-    rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
-                        axis=-1).reshape(x.shape)
+    if style == "interleaved":
+        x1 = x[..., 0::2].astype(jnp.float32)
+        x2 = x[..., 1::2].astype(jnp.float32)
+        rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                            axis=-1).reshape(x.shape)
+    elif style == "rotate_half":
+        half = head_dim // 2
+        x1 = x[..., :half].astype(jnp.float32)
+        x2 = x[..., half:].astype(jnp.float32)
+        rotated = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    else:
+        raise ValueError(
+            "Unknown RoPE style {!r}; expected 'interleaved' or "
+            "'rotate_half'.".format(style))
     return rotated.astype(x.dtype)
 
 
@@ -84,6 +104,7 @@ class GQAttention(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"  # auto | flash | reference | ring | ulysses
     rope_theta: float = 10000.0
+    rope_style: str = "interleaved"  # 'rotate_half' for HF-layout weights
     decode: bool = False
     cache_len: int = 0
 
@@ -108,8 +129,8 @@ class GQAttention(nn.Module):
             out = self._decode_attention(q, k, v)
         else:
             positions = jnp.arange(x.shape[1])
-            q = apply_rope(q, positions, self.rope_theta)
-            k = apply_rope(k, positions, self.rope_theta)
+            q = apply_rope(q, positions, self.rope_theta, self.rope_style)
+            k = apply_rope(k, positions, self.rope_theta, self.rope_style)
             if self.attention_impl in SEQUENCE_PARALLEL_IMPLS:
                 # RoPE composes with sequence parallelism for free: the
                 # rotation above ran on the *global* [B, S, H, D] arrays
@@ -155,8 +176,8 @@ class GQAttention(nn.Module):
 
         idx = index.value
         positions = idx + jnp.arange(seq)
-        q = apply_rope(q, positions, self.rope_theta)
-        k = apply_rope(k, positions, self.rope_theta)
+        q = apply_rope(q, positions, self.rope_theta, self.rope_style)
+        k = apply_rope(k, positions, self.rope_theta, self.rope_style)
 
         cached_k.value = lax.dynamic_update_slice(
             cached_k.value, k.astype(self.compute_dtype), (0, idx, 0, 0))
@@ -203,21 +224,26 @@ class LlamaBlock(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
     rope_theta: float = 10000.0
+    rope_style: str = "interleaved"
+    norm_eps: float = 1e-6
     dropout_rate: float = 0.0
     decode: bool = False
     cache_len: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
-        y = nn.RMSNorm(dtype=self.compute_dtype, name="norm_attn")(x)
+        y = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
+                       name="norm_attn")(x)
         y = GQAttention(self.num_heads, self.num_kv_heads,
                         self.compute_dtype, self.attention_impl,
-                        self.rope_theta, decode=self.decode,
+                        self.rope_theta, rope_style=self.rope_style,
+                        decode=self.decode,
                         cache_len=self.cache_len, name="attention")(y, mask)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         x = x + y
-        y = nn.RMSNorm(dtype=self.compute_dtype, name="norm_mlp")(x)
+        y = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
+                       name="norm_mlp")(x)
         y = SwiGLU(self.d_ff, self.compute_dtype, name="mlp")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
@@ -240,6 +266,8 @@ class LlamaLM(nn.Module):
     d_ff: int = 1408  # ~2/3 * 4 * d_model, the SwiGLU convention
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
+    rope_style: str = "interleaved"  # 'rotate_half' for HF-layout weights
+    norm_eps: float = 1e-6  # HF rms_norm_eps (Llama-2/Mistral use 1e-5)
     dropout_rate: float = 0.0
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
@@ -258,11 +286,13 @@ class LlamaLM(nn.Module):
         for i in range(self.num_layers):
             x = LlamaBlock(self.num_heads, num_kv, self.d_ff,
                            self.compute_dtype, self.attention_impl,
-                           self.rope_theta, self.dropout_rate,
+                           self.rope_theta, self.rope_style,
+                           self.norm_eps, self.dropout_rate,
                            decode=self.decode,
                            cache_len=self.max_seq_len,
                            name="block_%d" % i)(x, mask, deterministic)
-        x = nn.RMSNorm(dtype=self.compute_dtype, name="norm_final")(x)
+        x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
+                       name="norm_final")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False,
                           dtype=self.compute_dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)

@@ -1,0 +1,149 @@
+"""HF Llama checkpoint import: logits parity against the torch model.
+
+The strongest possible check of the layout mapping + rotate-half RoPE:
+a randomly-initialized `transformers.LlamaForCausalLM` and the imported
+`LlamaLM` must produce the same logits on the same tokens (CPU, f32).
+"""
+
+import numpy as np
+import pytest
+
+# torch/transformers are imported lazily inside the tests: the slow
+# marker deselects these tests in the fast tier, but module-level
+# imports would still run at collection time and cost ~10s of torch
+# import on every fast-tier run.
+pytestmark = pytest.mark.slow
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cloud_tpu.models.hf_import import import_hf_llama  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def torch():
+    return pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def transformers():
+    return pytest.importorskip("transformers")
+
+
+def _tiny_hf_llama(transformers, torch, num_kv_heads=2, **overrides):
+    kwargs = dict(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=num_kv_heads,
+        max_position_embeddings=32,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        attention_bias=False,
+        tie_word_embeddings=False,
+    )
+    kwargs.update(overrides)
+    config = transformers.LlamaConfig(**kwargs)
+    torch.manual_seed(0)
+    return transformers.LlamaForCausalLM(config)
+
+
+class TestHFImport:
+
+    @pytest.mark.parametrize("num_kv_heads", [4, 2])
+    def test_logits_match_torch(self, transformers, torch, num_kv_heads):
+        hf = _tiny_hf_llama(transformers, torch, num_kv_heads).eval()
+        tokens = np.random.default_rng(0).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.rope_style == "rotate_half"
+        assert lm.num_kv_heads == num_kv_heads
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_generate_drives_imported_model(self, transformers, torch):
+        from cloud_tpu.models import generate
+
+        hf = _tiny_hf_llama(transformers, torch).eval()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32,
+                                        max_seq_len=24)
+        prompt = jnp.asarray(
+            np.random.default_rng(1).integers(0, 64, size=(2, 8)),
+            jnp.int32)
+        out = generate(lm, variables["params"], prompt, 8,
+                       rng=jax.random.PRNGKey(0), temperature=0.0)
+        assert out.shape == (2, 16)
+        # Greedy continuation must match torch's greedy decode up to
+        # the first EOS (config eos=2): after it HF pads with
+        # pad_token_id while our generate repeats eos_token — both
+        # valid, different fillers.
+        with torch.no_grad():
+            hf_out = hf.generate(
+                torch.tensor(np.asarray(prompt)), max_new_tokens=8,
+                do_sample=False, use_cache=True,
+                pad_token_id=0).numpy()
+        ours = np.asarray(out)
+        for row in range(ours.shape[0]):
+            eos = np.where(hf_out[row] == 2)[0]
+            upto = int(eos[0]) + 1 if len(eos) else hf_out.shape[1]
+            np.testing.assert_array_equal(ours[row, :upto],
+                                          hf_out[row, :upto])
+
+    def test_tied_embeddings_fall_back(self, transformers, torch):
+        hf = _tiny_hf_llama(transformers, torch)
+        sd = {k: v for k, v in hf.state_dict().items()
+              if k != "lm_head.weight"}
+        lm, variables = import_hf_llama(state_dict=sd, config=hf.config,
+                                        compute_dtype=jnp.float32)
+        np.testing.assert_array_equal(
+            variables["params"]["lm_head"]["kernel"],
+            variables["params"]["embed"]["embedding"].T)
+
+    def test_missing_key_is_loud(self, transformers, torch):
+        hf = _tiny_hf_llama(transformers, torch)
+        sd = {k: v for k, v in hf.state_dict().items()
+              if "q_proj" not in k}
+        with pytest.raises(KeyError, match="q_proj"):
+            import_hf_llama(state_dict=sd, config=hf.config)
+
+    def test_rms_norm_eps_honored(self, transformers, torch):
+        """Llama-2/Mistral checkpoints use rms_norm_eps=1e-5; the
+        importer must carry it (flax default is 1e-6) or logits drift."""
+        hf = _tiny_hf_llama(transformers, torch, rms_norm_eps=1e-5).eval()
+        tokens = np.random.default_rng(2).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.norm_eps == pytest.approx(1e-5)
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_rope_scaling_rejected(self, transformers, torch):
+        hf = _tiny_hf_llama(transformers, torch)
+        hf.config.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            import_hf_llama(hf)
+
+    def test_unmapped_bias_params_rejected(self, transformers, torch):
+        """Checkpoints with q/k/v biases (Qwen-style) must fail loudly,
+        not silently drop the biases."""
+        hf = _tiny_hf_llama(transformers, torch)
+        sd = {k: v for k, v in hf.state_dict().items()}
+        sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(32)
+        with pytest.raises(ValueError, match="bias"):
+            import_hf_llama(state_dict=sd, config=hf.config)
+
+    def test_sliding_window_rejected(self, transformers, torch):
+        hf = _tiny_hf_llama(transformers, torch)
+        hf.config.sliding_window = 8  # < max_position_embeddings=32
+        with pytest.raises(NotImplementedError, match="sliding"):
+            import_hf_llama(hf)
+        # Within-window use imports fine.
+        lm, _ = import_hf_llama(hf, max_seq_len=8)
+        assert lm.max_seq_len == 8
