@@ -95,6 +95,16 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     kv_heads = cfg("num_key_value_heads", heads)
     layers = cfg("num_hidden_layers")
     head_dim = d_model // heads
+    explicit_head_dim = cfg("head_dim", False)
+    if explicit_head_dim and explicit_head_dim != head_dim:
+        # Mistral-Nemo-style decoupled head_dim: GQAttention derives
+        # head_dim from d_model // num_heads, so these checkpoints
+        # cannot map — reject clearly instead of dying in a reshape.
+        raise NotImplementedError(
+            "This checkpoint uses an explicit head_dim={} != "
+            "hidden_size//num_attention_heads={}, which LlamaLM's "
+            "attention does not support.".format(
+                explicit_head_dim, head_dim))
 
     window = cfg("sliding_window", False)
     horizon = max_seq_len or cfg("max_position_embeddings", 2048)
@@ -188,7 +198,7 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         num_kv_heads=kv_heads,
         d_model=d_model,
         d_ff=cfg("intermediate_size"),
-        max_seq_len=max_seq_len or cfg("max_position_embeddings", 2048),
+        max_seq_len=horizon,
         rope_theta=float(cfg("rope_theta", 10000.0)),
         rope_style="rotate_half",
         norm_eps=float(cfg("rms_norm_eps", 1e-6)),

@@ -10,6 +10,7 @@ import importlib.util
 import os
 import sys
 
+import numpy as np
 import pytest
 
 EXAMPLES_DIR = os.path.join(
@@ -70,3 +71,12 @@ def test_text_classification_example(capsys):
     # Misleading pad tails make high accuracy possible only when
     # masking excludes padding from attention and pooling.
     assert history["accuracy"][-1] > 0.9
+
+
+def test_pipelined_lm_example(monkeypatch, capsys):
+    mod = _load("pipelined_lm_training")
+    monkeypatch.setattr(mod, "SEQ_LEN", 16)
+    monkeypatch.setattr(mod, "VOCAB", 64)
+    history = mod.main()
+    assert "final loss" in capsys.readouterr().out
+    assert np.isfinite(history["loss"][-1])

@@ -88,9 +88,15 @@ class TestHFImport:
                 do_sample=False, use_cache=True,
                 pad_token_id=0).numpy()
         ours = np.asarray(out)
+        prompt_len = prompt.shape[1]
         for row in range(ours.shape[0]):
-            eos = np.where(hf_out[row] == 2)[0]
-            upto = int(eos[0]) + 1 if len(eos) else hf_out.shape[1]
+            # EOS search starts AFTER the prompt — a prompt that
+            # happens to contain token 2 must not truncate the check
+            # before any generated token is compared.
+            eos = np.where(hf_out[row, prompt_len:] == 2)[0]
+            upto = (prompt_len + int(eos[0]) + 1 if len(eos)
+                    else hf_out.shape[1])
+            assert upto > prompt_len
             np.testing.assert_array_equal(ours[row, :upto],
                                           hf_out[row, :upto])
 
