@@ -1,6 +1,7 @@
 from cloud_tpu.training.callbacks import (Callback, EarlyStopping,
                                           LambdaCallback, MetricsLogger,
-                                          ModelCheckpoint, read_metrics_log)
+                                          ModelCheckpoint, TensorBoard,
+                                          read_metrics_log)
 from cloud_tpu.training.data import (ArrayDataset, GeneratorDataset,
                                      NpzShardDataset, ThreadedDataset,
                                      prefetch_to_device)

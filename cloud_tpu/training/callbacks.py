@@ -185,6 +185,40 @@ class MetricsLogger(Callback):
                              (json.dumps(record) + "\n").encode("utf-8"))
 
 
+class TensorBoard(Callback):
+    """Writes per-epoch scalars as real TensorBoard event files.
+
+    Event-file COMPAT next to the primary JSONL channel (MetricsLogger):
+    the reference's whole metric readback rides TensorBoard event files
+    on GCS (reference tuner/tuner.py:532-560, tf_utils.py:27-51), and
+    any TensorBoard pointed at `log_dir` renders these curves. The wire
+    formats are hand-encoded in `utils.events` — no TensorFlow
+    dependency. Chief-only writes, like every output channel here.
+    """
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+        self._writer = None
+
+    def on_train_begin(self):
+        from cloud_tpu.utils import events
+
+        if jax.process_index() != 0:
+            return
+        self._writer = events.EventFileWriter(self.log_dir)
+
+    def on_epoch_end(self, epoch, logs):
+        if self._writer is None:
+            return
+        self._writer.add_scalars(
+            epoch, {"epoch_" + k: float(v) for k, v in logs.items()})
+        self._writer.flush()
+
+    def on_train_end(self, history):
+        if self._writer is not None:
+            self._writer.close()
+
+
 def read_metrics_log(path):
     """Parses a MetricsLogger JSONL stream into a list of epoch records."""
     from cloud_tpu.utils import storage

@@ -277,13 +277,22 @@ class CloudTuner:
         trainer = self.hypermodel(trial.hyperparameters)
         trial_dir = storage.join(self.directory, str(trial.trial_id))
         callbacks = list(fit_kwargs.pop("callbacks", []))
+        # Per-trial channels replace any user-supplied equivalents —
+        # the reference's callback surgery (tuner.py:470-487): strip,
+        # then re-add rooted at <dir>/<trial_id>/.
         callbacks = [c for c in callbacks
-                     if not isinstance(c, callbacks_lib.MetricsLogger)]
+                     if not isinstance(c, (callbacks_lib.MetricsLogger,
+                                           callbacks_lib.TensorBoard))]
         if not storage.is_gcs_path(trial_dir):
             callbacks.append(callbacks_lib.ModelCheckpoint(
                 storage.join(trial_dir, "checkpoint")))
         callbacks.append(callbacks_lib.MetricsLogger(
             storage.join(trial_dir, "logs", "metrics.jsonl")))
+        # Event-file compat beside the JSONL channel: TensorBoard
+        # pointed at <dir>/<trial_id>/logs shows the trial's curves
+        # (the reference's only channel, tuner.py:581-593).
+        callbacks.append(callbacks_lib.TensorBoard(
+            storage.join(trial_dir, "logs")))
         callbacks.append(_VizierReporter(self.oracle, trial))
 
         return trainer.fit(x, y, callbacks=callbacks, **fit_kwargs)

@@ -44,6 +44,7 @@ def test_mnist_ctl_example(capsys):
     assert "epoch 1 loss" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_long_context_example(monkeypatch, capsys):
     mod = _load("transformer_long_context")
     monkeypatch.setattr(mod, "SEQ_LEN", 128)
@@ -66,6 +67,7 @@ def test_tuner_search_example(capsys):
     assert "best hidden=" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_text_classification_example(capsys):
     history = _load("text_classification").main()
     # Misleading pad tails make high accuracy possible only when
@@ -73,6 +75,7 @@ def test_text_classification_example(capsys):
     assert history["accuracy"][-1] > 0.9
 
 
+@pytest.mark.slow
 def test_pipelined_lm_example(monkeypatch, capsys):
     mod = _load("pipelined_lm_training")
     monkeypatch.setattr(mod, "SEQ_LEN", 16)

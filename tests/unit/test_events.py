@@ -1,0 +1,75 @@
+"""TensorBoard event-file writer/reader (hand-encoded wire formats)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from cloud_tpu.utils import events
+
+
+class TestCRC32C:
+    def test_known_vectors(self):
+        # Castagnoli CRC test vectors (RFC 3720 / TFRecord suites).
+        assert events.crc32c(b"") == 0
+        assert events.crc32c(b"123456789") == 0xE3069283
+        assert events.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+class TestRoundTrip:
+    def test_writer_reader_round_trip(self, tmp_path):
+        w = events.EventFileWriter(str(tmp_path))
+        w.add_scalars(0, {"epoch_loss": 1.5, "epoch_accuracy": 0.25})
+        w.add_scalars(1, {"epoch_loss": 1.0, "epoch_accuracy": 0.5})
+        w.close()
+        got = events.read_events(w.path)
+        assert [step for step, _ in got] == [0, 1]
+        assert got[0][1]["epoch_loss"] == pytest.approx(1.5)
+        assert got[1][1]["epoch_accuracy"] == pytest.approx(0.5)
+
+    def test_incremental_flushes_append(self, tmp_path):
+        w = events.EventFileWriter(str(tmp_path))
+        w.add_scalars(0, {"loss": 3.0})
+        w.flush()
+        w.add_scalars(1, {"loss": 2.0})
+        w.flush()
+        got = events.read_events(w.path)
+        assert len(got) == 2
+
+    def test_corruption_detected(self, tmp_path):
+        w = events.EventFileWriter(str(tmp_path))
+        w.add_scalars(0, {"loss": 3.0})
+        w.close()
+        data = bytearray(open(w.path, "rb").read())
+        data[-6] ^= 0xFF  # flip a payload byte
+        open(w.path, "wb").write(bytes(data))
+        with pytest.raises(ValueError, match="crc"):
+            events.read_events(w.path)
+
+    def test_file_version_header_first_record(self, tmp_path):
+        w = events.EventFileWriter(str(tmp_path))
+        w.close()
+        data = open(w.path, "rb").read()
+        (length,) = struct.unpack("<Q", data[:8])
+        payload = data[12:12 + length]
+        assert b"brain.Event:2" in payload
+
+
+class TestTensorBoardCallback:
+    def test_fit_writes_event_file(self, tmp_path):
+        import glob
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import TensorBoard, Trainer
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=64).astype(np.int32)
+        trainer = Trainer(MLP(hidden=8, num_classes=4))
+        trainer.fit(x, y, epochs=2, batch_size=32, verbose=False,
+                    callbacks=[TensorBoard(str(tmp_path))])
+        files = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+        assert len(files) == 1
+        got = events.read_events(files[0])
+        assert [step for step, _ in got] == [0, 1]
+        assert all("epoch_loss" in scalars for _, scalars in got)
