@@ -29,10 +29,14 @@ class ArrayDataset:
             to stay aligned).
         drop_remainder: Drop the tail batch (True keeps shapes static for
             XLA; False pads the tail by wrapping to the start).
+        sample_weight: Optional [num_examples] per-example weights
+            (the Keras `fit(sample_weight=)` contract); when set,
+            batches are (x, y, w) triples and the Trainer weights the
+            loss/metrics accordingly.
     """
 
     def __init__(self, x, y=None, batch_size=32, shuffle=False, seed=0,
-                 drop_remainder=True):
+                 drop_remainder=True, sample_weight=None):
         self.x = x
         self.y = y
         leaves = jax.tree_util.tree_leaves(x)
@@ -43,6 +47,14 @@ class ArrayDataset:
             raise ValueError(
                 "x has {} examples but y has {}.".format(
                     self.num_examples, y.shape[0]))
+        if sample_weight is not None:
+            sample_weight = np.asarray(sample_weight, np.float32)
+            if sample_weight.shape != (self.num_examples,):
+                raise ValueError(
+                    "sample_weight must be [num_examples]={}; got "
+                    "shape {}.".format((self.num_examples,),
+                                       sample_weight.shape))
+        self.sample_weight = sample_weight
         if batch_size <= 0:
             raise ValueError("batch_size must be positive.")
         self.batch_size = batch_size
@@ -77,7 +89,10 @@ class ArrayDataset:
                 idx = np.concatenate(
                     [idx, np.resize(order, self.batch_size - len(idx))])
             xb = jax.tree_util.tree_map(lambda a: a[idx], self.x)
-            if self.y is None:
+            if self.sample_weight is not None:
+                yield xb, (None if self.y is None else self.y[idx]), \
+                    self.sample_weight[idx]
+            elif self.y is None:
                 yield xb
             else:
                 yield xb, self.y[idx]

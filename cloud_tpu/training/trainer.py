@@ -93,8 +93,30 @@ def _accuracy(outputs, labels):
     return (preds == labels).astype(jnp.float32)
 
 
+def _top5_accuracy(outputs, labels):
+    """Per-example top-5 hit rate (ImageNet's second headline metric)."""
+    top5 = jax.lax.top_k(outputs, 5)[1]            # [B..., 5]
+    return jnp.any(top5 == labels[..., None],
+                   axis=-1).astype(jnp.float32)
+
+
+def _mae_metric(outputs, labels):
+    v = jnp.abs(outputs - labels)
+    return v.reshape(v.shape[0], -1).mean(axis=1)
+
+
+def _mse_metric(outputs, labels):
+    v = jnp.square(outputs - labels)
+    return v.reshape(v.shape[0], -1).mean(axis=1)
+
+
 METRICS = {
     "accuracy": _accuracy,
+    "top5_accuracy": _top5_accuracy,
+    "mae": _mae_metric,
+    "mean_absolute_error": _mae_metric,
+    "mse": _mse_metric,
+    "mean_squared_error": _mse_metric,
 }
 
 OPTIMIZERS = {
@@ -107,6 +129,27 @@ OPTIMIZERS = {
     "lamb": lambda: optax.lamb(1e-3),
     "lion": lambda: optax.lion(1e-4),
 }
+
+
+def _per_example_view(v, batch_dim):
+    """Collapse any non-batch dims (e.g. per-token losses) to one value
+    per example so a per-example mask/weight applies cleanly."""
+    v = jnp.asarray(v)
+    if v.ndim > 1:
+        return jnp.mean(v.reshape(batch_dim, -1), axis=1)
+    return v
+
+
+def _weighted_mean(v, weights):
+    """sum(v*w) / sum(w), safe on all-zero weights.
+
+    The tiny (1e-9, not 1.0) floor keeps the identity
+    `weighted_mean * sum(w) == sum(v*w)` exact for ANY positive weight
+    sum — evaluate() re-multiplies by sum(w) when aggregating across
+    batches, so a 1.0 floor would silently scale batches whose total
+    weight is below one. All-zero weights give 0, not nan.
+    """
+    return jnp.sum(v * weights) / jnp.maximum(jnp.sum(weights), 1e-9)
 
 
 def _emit_runtime_metrics(steps, examples, elapsed_secs):
@@ -443,7 +486,11 @@ class Trainer:
 
     # -- jitted steps ---------------------------------------------------
 
-    def _make_train_step(self):
+    def _make_train_step(self, weighted=False):
+        """weighted: batches are (x, y, sample_weight) triples — the
+        loss is the weighted batch mean (Keras sum-over-batch-size
+        semantics: mean(per_example * w)) and per-example metrics are
+        weighted means (sum(v*w)/sum(w))."""
         metric_fns = self.metric_fns
         loss_fn = self.loss_fn
         optimizer = self.optimizer
@@ -454,9 +501,17 @@ class Trainer:
 
         aux_loss_weight = self.aux_loss_weight
         sows_losses = self._sows_losses
+        # Scalar metrics that can't take weights, recorded at trace
+        # time (fit() checks after the first step on the weighted path).
+        train_scalar_unmasked = self._train_scalar_unmasked = set()
 
         def train_step(state, batch):
-            x, y = batch
+            if weighted:
+                x, y, w = batch
+                w = w.astype(jnp.float32)
+            else:
+                x, y = batch
+                w = None
             step_rng = jax.random.fold_in(state.rng, state.step)
             rngs = ({k: jax.random.fold_in(step_rng, i)
                      for i, k in enumerate(rng_keys)} or None)
@@ -473,7 +528,14 @@ class Trainer:
                     outputs = self._apply(params, x, rngs=rngs,
                                           **train_kwargs)
                     new_vars = state.extra_vars
-                loss = jnp.mean(loss_fn(outputs, y))
+                per_example = loss_fn(outputs, y)
+                if w is not None:
+                    # Weighted Keras semantics: collapse any non-batch
+                    # dims per example, then mean(per_example * w)
+                    # (sum-over-batch-size, NOT normalized by sum(w)).
+                    per_example = _per_example_view(per_example,
+                                                    w.shape[0]) * w
+                loss = jnp.mean(per_example)
                 new_vars = dict(new_vars)
                 sown = new_vars.pop("losses", None)
                 if sown is not None:
@@ -498,26 +560,53 @@ class Trainer:
             logs = {"loss": loss}
             for name, fn in metric_fns.items():
                 # Mean-reduce: metric fns may return per-example values
-                # (built-ins do) or a scalar; train logs are batch means.
-                # Mask-aware metrics (fn(outputs, y, mask=...), the
-                # padded-eval contract) get an all-ones mask — train
-                # batches are never padded.
+                # (built-ins do) or a scalar; train logs are batch means
+                # (weighted means under sample_weight). Mask-aware
+                # metrics (fn(outputs, y, mask=...), the padded-eval
+                # contract) get the weights as the mask — or all-ones,
+                # train batches are never padded.
+                lead = jax.tree_util.tree_leaves(outputs)[0].shape[0]
+                mask = w if w is not None else jnp.ones((lead,),
+                                                        jnp.float32)
                 if train_mask_aware[name]:
-                    lead = jax.tree_util.tree_leaves(outputs)[0].shape[0]
-                    v = fn(outputs, y, mask=jnp.ones((lead,),
-                                                     jnp.float32))
+                    # Same contract as eval: per-example returns get
+                    # the weighted mean; scalars are already weighted.
+                    v = jnp.asarray(fn(outputs, y, mask=mask))
+                    if v.ndim >= 1:
+                        logs[name] = _weighted_mean(
+                            _per_example_view(v, lead), mask)
+                    else:
+                        logs[name] = v
+                    continue
+                v = jnp.asarray(fn(outputs, y))
+                if v.ndim >= 1:
+                    logs[name] = _weighted_mean(
+                        _per_example_view(v, lead), mask)
                 else:
-                    v = fn(outputs, y)
-                logs[name] = jnp.mean(v)
+                    # Scalar metric with no way to apply weights:
+                    # recorded at trace time; fit() raises on the
+                    # weighted path instead of logging an unweighted
+                    # number (mirror of evaluate()'s guard).
+                    if weighted:
+                        train_scalar_unmasked.add(name)
+                    logs[name] = jnp.mean(v)
+            if weighted:
+                # For exact epoch-level aggregation: per-batch weighted
+                # means must be re-weighted by their batch weight sums
+                # (a plain mean of ratios is biased when batch sums
+                # differ). Stripped from user-facing logs in
+                # _fit_epochs.
+                logs["_batch_weight"] = jnp.sum(w)
             return new_state, logs
 
         if self._mesh is None:
             return jax.jit(train_step, donate_argnums=0)
         batch_sharding = sharding_lib.batch_sharding(self._mesh)
+        batch_in = ((batch_sharding,) * 3 if weighted
+                    else (batch_sharding, batch_sharding))
         return jax.jit(
             train_step,
-            in_shardings=(self._state_sharding,
-                          (batch_sharding, batch_sharding)),
+            in_shardings=(self._state_sharding, batch_in),
             out_shardings=(self._state_sharding, None),
             donate_argnums=0)
 
@@ -548,31 +637,23 @@ class Trainer:
         # instead of silently averaging padded duplicates in.
         scalar_unmasked = self._scalar_unmasked_metrics = set()
 
-        def _per_example(v, batch_dim):
-            # Collapse any non-batch dims (e.g. per-token losses) to one
-            # value per example so the valid-mask applies cleanly.
-            v = jnp.asarray(v)
-            if v.ndim > 1:
-                return jnp.mean(v.reshape(batch_dim, -1), axis=1)
-            return v
-
         def eval_step(state, batch):
-            # mask flags real examples; padded tail duplicates (wrapped
-            # by ArrayDataset for static shapes) carry zero weight, so
-            # metrics are exact example-weighted means.
+            # mask flags real examples (times any sample weights);
+            # padded tail duplicates (wrapped by ArrayDataset for
+            # static shapes) carry zero weight, so metrics are exact
+            # example-weighted means.
             x, y, mask = batch
             outputs = self._apply(state.params, x,
                                   extra_vars=state.extra_vars,
                                   **eval_kwargs)
-            n = jnp.maximum(jnp.sum(mask), 1.0)
-            per_ex = _per_example(loss_fn(outputs, y), mask.shape[0])
-            logs = {"loss": jnp.sum(per_ex * mask) / n}
+            per_ex = _per_example_view(loss_fn(outputs, y), mask.shape[0])
+            logs = {"loss": _weighted_mean(per_ex, mask)}
             for name, fn in metric_fns.items():
                 if mask_aware[name]:
                     v = jnp.asarray(fn(outputs, y, mask=mask))
                     if v.ndim >= 1:
-                        v = _per_example(v, mask.shape[0])
-                        logs[name] = jnp.sum(v * mask) / n
+                        logs[name] = _weighted_mean(
+                            _per_example_view(v, mask.shape[0]), mask)
                     else:
                         # Scalar from a mask-aware fn: it already
                         # weighted out the padded rows.
@@ -580,12 +661,12 @@ class Trainer:
                     continue
                 v = jnp.asarray(fn(outputs, y))
                 if v.ndim >= 1:
-                    v = _per_example(v, mask.shape[0])
-                    logs[name] = jnp.sum(v * mask) / n
+                    logs[name] = _weighted_mean(
+                        _per_example_view(v, mask.shape[0]), mask)
                 else:
                     # Scalar custom metric with no way to apply the
-                    # valid-mask: correct on full batches only.
-                    # evaluate() raises if a padded batch shows up.
+                    # valid-mask: correct on full unweighted batches
+                    # only. evaluate() raises otherwise.
                     scalar_unmasked.add(name)
                     logs[name] = v
             return logs
@@ -661,7 +742,8 @@ class Trainer:
             steps_per_epoch=None,
             verbose=True,
             resume_from=None,
-            prefetch=2):
+            prefetch=2,
+            sample_weight=None):
         """Trains the model; returns a history dict of per-epoch logs.
 
         prefetch: Device read-ahead depth — `prefetch` batches are kept
@@ -677,9 +759,41 @@ class Trainer:
         does not support for remote tuner trials, reference
         tuner/tuner.py:562-567). Missing/empty directories are ignored,
         so a preemption-restart loop can always pass it.
+
+        sample_weight: Optional [num_examples] per-example weights
+        (Keras `fit(sample_weight=)`): the loss becomes
+        mean(per_example * w) and per-example metrics weighted means.
+        Array inputs only; `validation_data` may be (x, y, w) too.
         """
+        if sample_weight is not None and not (
+                hasattr(x, "shape") or isinstance(x, (dict, list, tuple))):
+            # Pre-built datasets ignore as_dataset kwargs — silently
+            # dropping the weights would train unweighted.
+            raise ValueError(
+                "sample_weight= needs raw array inputs; pre-built "
+                "datasets carry their own weights via "
+                "ArrayDataset(sample_weight=...).")
+        if (validation_data is not None and len(validation_data) == 3
+                and jax.process_count() > 1):
+            # evaluate() would reject this at the END of epoch 1 —
+            # hours into a real pod run. Fail before training starts.
+            raise NotImplementedError(
+                "Weighted validation_data=(x, y, w) is single-process "
+                "for now; drop the weights or evaluate separately.")
+        ds_kwargs = {}
+        if sample_weight is not None:
+            ds_kwargs["sample_weight"] = sample_weight
         dataset = data_lib.as_dataset(x, y, batch_size=batch_size,
-                                      shuffle=shuffle, seed=self.seed)
+                                      shuffle=shuffle, seed=self.seed,
+                                      **ds_kwargs)
+        if (sample_weight is not None
+                and not isinstance(dataset, data_lib.ArrayDataset)):
+            raise ValueError(
+                "sample_weight= needs array inputs (datasets carry "
+                "their own weights by yielding (x, y, w) via "
+                "ArrayDataset(sample_weight=...)).")
+        weighted = (isinstance(dataset, data_lib.ArrayDataset)
+                    and dataset.sample_weight is not None)
         if steps_per_epoch is None:
             # Dataset-level cap (e.g. GeneratorDataset over an unbounded
             # stream) applies when the caller sets none.
@@ -696,8 +810,11 @@ class Trainer:
                                                     self.state)
                 logger.info("Resumed training from %s at step %d.",
                             resume_from, int(self.state.step))
-        if self._jit_train_step is None:
-            self._jit_train_step = self._make_train_step()
+        if (self._jit_train_step is None
+                or getattr(self, "_train_step_weighted", None) != weighted):
+            self._jit_train_step = self._make_train_step(
+                weighted=weighted)
+            self._train_step_weighted = weighted
 
         history = {}
         self.stop_training = False
@@ -750,12 +867,40 @@ class Trainer:
             for batch_examples, batch in feeder:
                 examples += batch_examples
                 self.state, logs = self._jit_train_step(self.state, batch)
+                if (count == 0 and epoch == 0
+                        and getattr(self, "_train_scalar_unmasked", None)):
+                    # Populated during the trace that just ran: a
+                    # scalar metric can't be sample-weighted — fail
+                    # loudly like evaluate() does, instead of logging
+                    # unweighted numbers for the whole run.
+                    raise ValueError(
+                        "Custom metrics {} return a scalar and cannot "
+                        "apply sample_weight. Give them a mask-aware "
+                        "signature fn(outputs, y, mask=...) or return "
+                        "per-example values.".format(
+                            sorted(self._train_scalar_unmasked)))
                 # Keep logs as device arrays: no host sync inside the hot
                 # loop (async dispatch overlaps host batching with the
                 # device step); convert once per epoch below.
                 step_logs.append(logs)
                 count += 1
-            if step_logs:
+            if step_logs and "_batch_weight" in step_logs[0]:
+                # Weighted fit: epoch metrics re-weight each batch's
+                # weighted mean by that batch's weight sum (exact over
+                # the epoch); the loss keeps Keras sum-over-batch-size
+                # semantics (plain mean over equal-size batches).
+                ws = jnp.stack([l["_batch_weight"] for l in step_logs])
+                total_w = jnp.maximum(jnp.sum(ws), 1e-9)
+                logs = {}
+                for k in step_logs[0]:
+                    if k == "_batch_weight":
+                        continue
+                    vals = jnp.stack([l[k] for l in step_logs])
+                    if k == "loss":
+                        logs[k] = float(jnp.mean(vals))
+                    else:
+                        logs[k] = float(jnp.sum(vals * ws) / total_w)
+            elif step_logs:
                 stacked = jax.tree_util.tree_map(
                     lambda *xs: jnp.mean(jnp.stack(xs)), *step_logs)
                 logs = {k: float(v) for k, v in stacked.items()}
@@ -766,10 +911,17 @@ class Trainer:
             _emit_runtime_metrics(count, examples, elapsed)
 
             if validation_data is not None:
-                val_logs = self.evaluate(*validation_data,
+                # Keras-style (x, y) or (x, y, sample_weight).
+                if len(validation_data) == 3:
+                    val_x, val_y, val_sw = validation_data
+                else:
+                    val_x, val_y = validation_data
+                    val_sw = None
+                val_logs = self.evaluate(val_x, val_y,
                                          batch_size=batch_size,
                                          verbose=False,
-                                         prefetch=prefetch)
+                                         prefetch=prefetch,
+                                         sample_weight=val_sw)
                 logs.update({"val_" + k: v for k, v in val_logs.items()})
 
             for k, v in logs.items():
@@ -827,7 +979,8 @@ class Trainer:
         return self.state
 
     def evaluate(self, x, y=None, batch_size=32, verbose=True,
-                 steps=None, prefetch=2, use_ema=False):
+                 steps=None, prefetch=2, use_ema=False,
+                 sample_weight=None):
         """Returns exact example-weighted mean loss/metrics.
 
         Tail batches are padded by wrapping (never dropped) so shapes
@@ -845,14 +998,40 @@ class Trainer:
         stream) applies, mirroring fit(). `prefetch` is the device
         read-ahead depth (0 = synchronous), mirroring fit(); fit()
         forwards its own value to the per-epoch validation pass.
+
+        `sample_weight`: optional [num_examples] per-example weights;
+        every reported value becomes the weighted mean
+        sum(v_i * w_i) / sum(w_i) over the dataset (weights compose
+        with the tail-padding mask). Array inputs, single process.
         """
         if self.state is None:
             raise RuntimeError("Model is not built; call fit() first or "
                                "build() with a sample batch.")
         if self._jit_eval_step is None:
             self._jit_eval_step = self._make_eval_step()
+        if sample_weight is not None and not (
+                hasattr(x, "shape") or isinstance(x, (dict, list, tuple))):
+            raise ValueError(
+                "sample_weight= needs raw array inputs; pre-built "
+                "datasets carry their own weights via "
+                "ArrayDataset(sample_weight=...).")
+        ds_kwargs = {}
+        if sample_weight is not None:
+            ds_kwargs["sample_weight"] = sample_weight
         dataset = data_lib.as_dataset(x, y, batch_size=batch_size,
-                                      drop_remainder=False)
+                                      drop_remainder=False, **ds_kwargs)
+        if (sample_weight is not None
+                and not isinstance(dataset, data_lib.ArrayDataset)):
+            raise ValueError(
+                "sample_weight= needs array inputs (wrap the dataset "
+                "in ArrayDataset(sample_weight=...) instead).")
+        weighted_eval = (isinstance(dataset, data_lib.ArrayDataset)
+                         and dataset.sample_weight is not None)
+        if weighted_eval and jax.process_count() > 1:
+            raise NotImplementedError(
+                "Weighted evaluate is single-process for now (the "
+                "global batch weight is not derivable from a local "
+                "shard).")
         if steps is None:
             steps = getattr(dataset, "steps_per_epoch", None)
         num_examples = getattr(dataset, "num_examples", None)
@@ -860,13 +1039,21 @@ class Trainer:
         process_count = jax.process_count()
         process_index = jax.process_index()
         def masked_batches():
-            """(real_example_count, (x, y, valid-mask)) per batch."""
+            """(aggregation_weight, padded, (x, y, mask)) per batch —
+            `mask` is the valid-row mask times any per-example weights
+            (the eval step's masked means are then weighted means),
+            and `aggregation_weight` is the batch's share of the final
+            example-weighted (or sample-weighted) average."""
             for i, batch in enumerate(self._epoch_batches(dataset)):
                 if steps is not None and i >= steps:
                     break
-                # Same unpacking the train step applies: any 2-sequence
-                # is (x, y); anything else is unlabeled input.
-                if isinstance(batch, (tuple, list)) and len(batch) == 2:
+                # Same unpacking the train step applies: a 3-sequence
+                # is (x, y, sample_weight), a 2-sequence is (x, y);
+                # anything else is unlabeled input.
+                wb = None
+                if isinstance(batch, (tuple, list)) and len(batch) == 3:
+                    xb, yb, wb = batch
+                elif isinstance(batch, (tuple, list)) and len(batch) == 2:
                     xb, yb = batch
                 else:
                     xb, yb = batch, None
@@ -885,40 +1072,45 @@ class Trainer:
                           if process_count > 1 else 0)
                 mask = ((np.arange(local_b) + offset) < real).astype(
                     np.float32)
-                yield real, (xb, yb, mask)
+                padded = real < local_b * process_count
+                if wb is not None:
+                    mask = mask * np.asarray(wb, np.float32)
+                    agg = float(mask.sum())
+                else:
+                    agg = float(real)
+                yield agg, padded, (xb, yb, mask)
 
         feeder = data_lib.prefetch_to_device(
             masked_batches(), size=prefetch,
-            feed=lambda item: (item[0], self._feed(item[1])))
+            feed=lambda item: (item[0], item[1], self._feed(item[2])))
         eval_state = self._eval_state(use_ema)
         totals, weight = {}, 0.0
-        for real, fed in feeder:
+        for agg, padded, fed in feeder:
             logs = self._jit_eval_step(eval_state, fed)
             # Padding only ever happens on the ArrayDataset path
             # (num_examples known, tail wrapped); datasets that just
             # yield a short final batch (e.g. shard tails) are short,
             # not padded — their mask is all-ones and every metric is
-            # exact.
-            if (num_examples is not None and global_bs is not None
-                    and real < global_bs
+            # exact. A scalar metric that can't take the mask is also
+            # wrong under sample weights, padded or not.
+            if ((padded or weighted_eval)
                     and self._scalar_unmasked_metrics):
-                # A padded tail batch would silently fold duplicated
-                # rows into these metrics' batch means.
                 raise ValueError(
                     "Custom metrics {} return a scalar and cannot be "
-                    "masked, but this eval batch is padded ({} real of "
-                    "{} rows). Give the metric a mask-aware signature "
-                    "fn(outputs, y, mask=...) (weight rows by mask), "
-                    "return per-example values instead, or pick a batch "
-                    "size that divides the dataset.".format(
-                        sorted(self._scalar_unmasked_metrics), real,
-                        global_bs))
-            weight += real
+                    "masked, but this evaluation needs per-row "
+                    "weighting ({}). Give the metric a mask-aware "
+                    "signature fn(outputs, y, mask=...) (weight rows "
+                    "by mask), or return per-example values "
+                    "instead.".format(
+                        sorted(self._scalar_unmasked_metrics),
+                        "sample_weight" if weighted_eval
+                        else "padded tail batch"))
+            weight += agg
             for k, v in logs.items():
                 # Device-side accumulation: no host sync per batch (one
                 # tunnel round-trip per eval batch otherwise); the
                 # float() conversion below is the only barrier.
-                totals[k] = totals.get(k, 0.0) + v * real
+                totals[k] = totals.get(k, 0.0) + v * agg
         if weight == 0.0:
             raise ValueError("evaluate() received an empty dataset.")
         logs = {k: float(v) / weight for k, v in totals.items()}

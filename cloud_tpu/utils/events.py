@@ -30,6 +30,7 @@ import time
 from cloud_tpu.utils import storage
 
 _CRC_TABLE = []
+_WRITER_COUNT = 0
 
 
 def _crc32c_table():
@@ -123,11 +124,16 @@ class EventFileWriter:
         self.log_dir = str(log_dir)
         if not storage.is_gcs_path(self.log_dir):
             os.makedirs(self.log_dir, exist_ok=True)
-        name = "events.out.tfevents.{:.0f}.{}".format(
-            time.time(), socket.gethostname())
+        # ts.host.pid.counter: same uniqueness recipe as TF's own
+        # writers — two writers in the same second (fast tests,
+        # back-to-back fits into one dir) must not interleave streams.
+        global _WRITER_COUNT
+        _WRITER_COUNT += 1
+        name = "events.out.tfevents.{:.0f}.{}.{}.{}".format(
+            time.time(), socket.gethostname(), os.getpid(),
+            _WRITER_COUNT)
         self.path = storage.join(self.log_dir, name)
         self._buffer = bytearray(_frame(encode_file_version()))
-        self._flushed = 0
         self.flush()
 
     def add_scalars(self, step, scalars, wall_time=None):
@@ -135,10 +141,11 @@ class EventFileWriter:
             encode_scalar_event(step, scalars, wall_time=wall_time)))
 
     def flush(self):
-        delta = bytes(self._buffer[self._flushed:])
-        if delta:
-            storage.append_bytes(self.path, delta)
-        self._flushed = len(self._buffer)
+        # Pending frames only: the buffer is cleared once appended, so
+        # writer memory stays bounded however long the run.
+        if self._buffer:
+            storage.append_bytes(self.path, bytes(self._buffer))
+            self._buffer = bytearray()
 
     def close(self):
         self.flush()
@@ -191,8 +198,16 @@ def read_events(path):
     events = []
     pos = 0
     while pos < len(data):
+        if pos + 12 > len(data):
+            raise ValueError(
+                "Truncated event file (partial record header): "
+                "{}".format(path))
         header = data[pos:pos + 8]
         (length,) = struct.unpack("<Q", header)
+        if pos + 16 + length > len(data):
+            raise ValueError(
+                "Truncated event file (partial record payload): "
+                "{}".format(path))
         (header_crc,) = struct.unpack("<I", data[pos + 8:pos + 12])
         if _masked_crc(header) != header_crc:
             raise ValueError("Corrupt event file (header crc): "

@@ -8,7 +8,6 @@ Shapes are minimal and attention uses the jnp reference path.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 
 def _tokens(b=2, s=8, vocab=32, seed=0):

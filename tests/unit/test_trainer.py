@@ -944,3 +944,195 @@ class TestEMA:
         assert np.isfinite(logs["loss"])
         preds = trainer.predict(x[:8], batch_size=8, use_ema=True)
         assert preds.shape == (8, 4)
+
+
+class TestSampleWeight:
+    """Keras `sample_weight` parity: weighted loss in fit, weighted
+    means in evaluate, (x, y, w) validation_data."""
+
+    def test_zero_weight_excludes_examples(self):
+        """Examples with weight 0 must not influence training: corrupt
+        half the labels, zero-weight them, and the model still learns
+        the clean mapping."""
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=256)
+        y_corrupt = y.copy()
+        y_corrupt[128:] = (y[128:] + 1) % 4  # wrong labels
+        w = np.ones(256, np.float32)
+        w[128:] = 0.0
+        trainer = Trainer(MLP(hidden=32, num_classes=4,
+                              compute_dtype=jnp.float32),
+                          optimizer=optax.adam(1e-2))
+        trainer.fit(x, y_corrupt, epochs=8, batch_size=64,
+                    sample_weight=w, verbose=False)
+        # Accuracy against the CLEAN labels on the corrupted half must
+        # beat chance comfortably (the zero-weighted wrong labels never
+        # pulled the model away), and accuracy against the CORRUPTED
+        # labels there must stay near chance (they were never trained).
+        clean = trainer.evaluate(x[128:], y[128:], batch_size=64,
+                                 verbose=False)
+        corrupt = trainer.evaluate(x[128:], y_corrupt[128:],
+                                   batch_size=64, verbose=False)
+        assert clean["accuracy"] > 0.6
+        assert clean["accuracy"] > corrupt["accuracy"] + 0.2
+
+    def test_evaluate_weighted_mean_exact(self):
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=96)
+        rng = np.random.default_rng(1)
+        w = rng.uniform(0.1, 2.0, size=96).astype(np.float32)
+        trainer = Trainer(MLP(hidden=16, num_classes=4,
+                              compute_dtype=jnp.float32))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        logs = trainer.evaluate(x, y, batch_size=32, sample_weight=w,
+                                verbose=False)
+        logits = trainer.predict(x, batch_size=32)
+        per_ex = np.asarray(
+            optax.softmax_cross_entropy_with_integer_labels(
+                jnp.asarray(logits), jnp.asarray(y)))
+        expected_loss = float((per_ex * w).sum() / w.sum())
+        hits = (np.argmax(logits, -1) == y).astype(np.float32)
+        expected_acc = float((hits * w).sum() / w.sum())
+        assert logs["loss"] == pytest.approx(expected_loss, rel=1e-5)
+        assert logs["accuracy"] == pytest.approx(expected_acc, rel=1e-5)
+
+    def test_weighted_eval_exact_with_padded_tail(self):
+        """Weights compose with the tail-padding mask: 33 examples at
+        batch 32 still give the exact weighted mean."""
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=33)
+        w = np.linspace(0.5, 1.5, 33).astype(np.float32)
+        trainer = Trainer(MLP(hidden=16, num_classes=4,
+                              compute_dtype=jnp.float32))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        logs = trainer.evaluate(x, y, batch_size=32, sample_weight=w,
+                                verbose=False)
+        logits = trainer.predict(x, batch_size=32)
+        per_ex = np.asarray(
+            optax.softmax_cross_entropy_with_integer_labels(
+                jnp.asarray(logits), jnp.asarray(y)))
+        assert logs["loss"] == pytest.approx(
+            float((per_ex * w).sum() / w.sum()), rel=1e-5)
+
+    def test_validation_data_triple(self):
+        x, y = _toy_classification(n=128)
+        w = np.ones(64, np.float32)
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        history = trainer.fit(x[:64], y[:64], epochs=1, batch_size=32,
+                              validation_data=(x[64:], y[64:], w),
+                              verbose=False)
+        assert "val_loss" in history
+
+    def test_weights_on_dp_mesh(self):
+        runtime.initialize(strategy="tpu_slice")
+        x, y = _toy_classification()
+        w = np.ones(256, np.float32)
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          optimizer=optax.adam(1e-2))
+        history = trainer.fit(x, y, epochs=2, batch_size=64,
+                              sample_weight=w, verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
+
+    def test_sample_weight_needs_arrays(self):
+        trainer = Trainer(MLP(hidden=8, num_classes=4))
+        batches = [(np.zeros((4, 8), np.float32),
+                    np.zeros(4, np.int32))]
+        with pytest.raises(ValueError, match="sample_weight"):
+            trainer.fit(batches, epochs=1, verbose=False,
+                        sample_weight=np.ones(4, np.float32))
+
+
+class TestMetricRegistry:
+    def test_top5_and_regression_metrics(self):
+        import jax.numpy as jnp
+
+        from cloud_tpu.training.trainer import METRICS
+
+        logits = jnp.asarray([[0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 9.0],
+                              [9.0, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8]])
+        labels = jnp.asarray([3, 1])
+        top5 = np.asarray(METRICS["top5_accuracy"](logits, labels))
+        # label 3 is in row 0's top-5 (indices 7,6,5,4,3); label 1 is
+        # NOT in row 1's top-5 (indices 0,7,6,5,4).
+        np.testing.assert_array_equal(top5, [1.0, 0.0])
+
+        pred = jnp.asarray([[1.0, 2.0], [3.0, 5.0]])
+        target = jnp.asarray([[1.0, 4.0], [3.0, 1.0]])
+        np.testing.assert_allclose(
+            np.asarray(METRICS["mae"](pred, target)), [1.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(METRICS["mse"](pred, target)), [2.0, 8.0])
+
+
+class TestSampleWeightGuards:
+    def test_prebuilt_dataset_with_sample_weight_rejected(self):
+        from cloud_tpu.training import ArrayDataset
+
+        x, y = _toy_classification(n=64)
+        ds = ArrayDataset(x, y, batch_size=32)
+        trainer = Trainer(MLP(hidden=8, num_classes=4))
+        with pytest.raises(ValueError, match="pre-built"):
+            trainer.fit(ds, epochs=1, verbose=False,
+                        sample_weight=np.ones(64, np.float32))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        with pytest.raises(ValueError, match="pre-built"):
+            trainer.evaluate(ds, sample_weight=np.ones(64, np.float32),
+                             verbose=False)
+
+    def test_scalar_metric_raises_under_weighted_fit(self):
+        import jax.numpy as jnp
+
+        def scalar_m(outputs, y):
+            return jnp.mean(jnp.argmax(outputs, -1) == y)
+
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          metrics=(scalar_m,))
+        with pytest.raises(ValueError, match="scalar_m"):
+            trainer.fit(x, y, epochs=1, batch_size=32, verbose=False,
+                        sample_weight=np.ones(64, np.float32))
+
+    def test_tiny_weights_stay_exact(self):
+        """Batch weight sums below 1.0 must not scale the result (the
+        aggregation identity weighted_mean * sum(w) == sum(v*w))."""
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=64)
+        w = np.full(64, 1.0 / 128.0, np.float32)  # batch sum = 0.25
+        trainer = Trainer(MLP(hidden=16, num_classes=4,
+                              compute_dtype=jnp.float32))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        logs = trainer.evaluate(x, y, batch_size=32, sample_weight=w,
+                                verbose=False)
+        unweighted = trainer.evaluate(x, y, batch_size=32,
+                                      verbose=False)
+        # Uniform weights, however tiny, must equal the unweighted mean.
+        assert logs["loss"] == pytest.approx(unweighted["loss"],
+                                             rel=1e-4)
+
+
+class TestWeightedEpochAggregation:
+    def test_epoch_metrics_weight_exact_across_batches(self):
+        """Per-batch weighted means re-weight by batch weight sums: a
+        heavy batch dominates the epoch metric, a near-zero-weight
+        batch barely moves it (a plain mean of ratios would say 0.5)."""
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=64)
+        w = np.ones(64, np.float32)
+        w[32:] = 1e-3  # second batch nearly weightless
+        trainer = Trainer(MLP(hidden=16, num_classes=4,
+                              compute_dtype=jnp.float32),
+                          optimizer=optax.sgd(0.0))  # frozen params
+        history = trainer.fit(x, y, epochs=1, batch_size=32,
+                              shuffle=False, sample_weight=w,
+                              verbose=False)
+        logs = trainer.evaluate(x, y, batch_size=32, sample_weight=w,
+                                verbose=False)
+        # Frozen params: the epoch train accuracy must equal evaluate's
+        # exact weighted mean over the same data/weights.
+        assert history["accuracy"][0] == pytest.approx(
+            logs["accuracy"], rel=1e-4)
