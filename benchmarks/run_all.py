@@ -350,7 +350,9 @@ def config9_generate_decode():
                               num_heads=12, d_model=768, d_ff=3072,
                               max_seq_len=prompt_len + new_tokens)
     else:
-        B, prompt_len, new_tokens = 2, 32, 16
+        # Long decode, short prompt: the decode signal must dominate
+        # prefill timing noise for the subtraction below to be stable.
+        B, prompt_len, new_tokens = 2, 16, 96
         model = TransformerLM(vocab_size=256, num_layers=2, num_heads=4,
                               d_model=64, d_ff=128,
                               max_seq_len=prompt_len + new_tokens,
@@ -371,25 +373,40 @@ def config9_generate_decode():
 
     run(new_tokens)  # compile the full prefill + decode executables
     run(1)           # compile the prefill + single-sample variant
+
+    def best_of(n, reps=3):
+        # min-of-N: the noise-robust latency estimator — a loaded host
+        # once timed run(1) slower than run(new_tokens), producing an
+        # absurd decode rate from the difference of two noisy numbers.
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run(n)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
     # run(1) is prefill + one sampled token (generate(0) short-circuits
     # to the prompt without touching the model); the scan cost of the
     # remaining new_tokens - 1 steps is the decode-rate measurement.
-    t0 = time.perf_counter()
-    run(1)
-    prefill_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    run(new_tokens)
-    total_s = time.perf_counter() - t0
-    decode_s = max(total_s - prefill_s, 1e-9)
+    prefill_s = best_of(1)
+    total_s = best_of(new_tokens)
+    decode_s = total_s - prefill_s
     decode_tokens = new_tokens - 1
-    tokens_per_sec = B * decode_tokens / decode_s
-    return {"metric": "generate_decode_tokens_per_sec",
-            "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
-            "batch": B, "prompt_len": prompt_len,
-            "new_tokens": new_tokens,
-            "prefill_plus_first_token_ms": round(prefill_s * 1e3, 2),
-            "decode_ms_per_token": round(
-                decode_s * 1e3 / decode_tokens, 3)}
+    record = {"metric": "generate_decode_tokens_per_sec",
+              "unit": "tokens/sec",
+              "batch": B, "prompt_len": prompt_len,
+              "new_tokens": new_tokens,
+              "prefill_plus_first_token_ms": round(prefill_s * 1e3, 2)}
+    if decode_s < 1e-4:
+        # Even min-of-N couldn't separate the two on this host: report
+        # the failure instead of a differenced-noise number.
+        record.update(value=0.0, error="decode time not separable "
+                      "from prefill (noisy host?)")
+        return record
+    record.update(
+        value=round(B * decode_tokens / decode_s, 1),
+        decode_ms_per_token=round(decode_s * 1e3 / decode_tokens, 3))
+    return record
 
 
 CONFIGS = {1: config1_mnist, 2: config2_resnet50, 3: config3_dp_pod_shape,

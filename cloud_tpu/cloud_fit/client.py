@@ -220,6 +220,8 @@ def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
         if validation_data is not None:
             arrays = {"val_x": np.asarray(validation_data[0]),
                       "val_y": np.asarray(validation_data[1])}
+            if len(validation_data) == 3:
+                arrays["val_w"] = np.asarray(validation_data[2])
             buf = io.BytesIO()
             np.savez_compressed(buf, **arrays)
             storage.write_bytes(storage.join(remote_dir, DATA_FILE),
@@ -236,6 +238,9 @@ def serialize_assets(remote_dir, trainer, x, y=None, validation_data=None,
     if validation_data is not None:
         arrays["val_x"] = np.asarray(validation_data[0])
         arrays["val_y"] = np.asarray(validation_data[1])
+        if len(validation_data) == 3:
+            # (x, y, sample_weight) validation triples survive the trip.
+            arrays["val_w"] = np.asarray(validation_data[2])
     buf = io.BytesIO()
     np.savez_compressed(buf, **arrays)
     storage.write_bytes(storage.join(remote_dir, DATA_FILE),

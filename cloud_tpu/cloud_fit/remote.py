@@ -98,8 +98,10 @@ def run(remote_dir, distribution_strategy="tpu_slice"):
         x = arrays["x"]
         y = arrays["y"] if "y" in arrays.files else None
     if arrays is not None and "val_x" in arrays.files:
-        fit_kwargs.setdefault(
-            "validation_data", (arrays["val_x"], arrays["val_y"]))
+        val = (arrays["val_x"], arrays["val_y"])
+        if "val_w" in arrays.files:
+            val = val + (arrays["val_w"],)
+        fit_kwargs.setdefault("validation_data", val)
 
     history = trainer.fit(x, y, **fit_kwargs)
 

@@ -94,9 +94,13 @@ def _accuracy(outputs, labels):
 
 
 def _top5_accuracy(outputs, labels):
-    """Per-example top-5 hit rate (ImageNet's second headline metric)."""
-    top5 = jax.lax.top_k(outputs, 5)[1]            # [B..., 5]
-    return jnp.any(top5 == labels[..., None],
+    """Per-example top-5 hit rate (ImageNet's second headline metric).
+
+    k clamps to the class count (Keras TopKCategoricalAccuracy
+    behavior: fewer than 5 classes means every example hits)."""
+    k = min(5, outputs.shape[-1])
+    topk = jax.lax.top_k(outputs, k)[1]            # [B..., k]
+    return jnp.any(topk == labels[..., None],
                    axis=-1).astype(jnp.float32)
 
 
@@ -743,7 +747,8 @@ class Trainer:
             verbose=True,
             resume_from=None,
             prefetch=2,
-            sample_weight=None):
+            sample_weight=None,
+            class_weight=None):
         """Trains the model; returns a history dict of per-epoch logs.
 
         prefetch: Device read-ahead depth — `prefetch` batches are kept
@@ -764,7 +769,25 @@ class Trainer:
         (Keras `fit(sample_weight=)`): the loss becomes
         mean(per_example * w) and per-example metrics weighted means.
         Array inputs only; `validation_data` may be (x, y, w) too.
+
+        class_weight: Optional {label: weight} dict (Keras
+        `fit(class_weight=)`) for imbalanced classification — sugar
+        for a per-example sample_weight derived from integer labels
+        `y` (multiplies into any explicit sample_weight). Labels
+        absent from the dict weigh 1.0.
         """
+        if class_weight is not None:
+            if y is None or not hasattr(y, "shape") or np.asarray(
+                    y).ndim != 1:
+                raise ValueError(
+                    "class_weight= needs 1-D integer labels `y`.")
+            labels = np.asarray(y)
+            cw = np.ones(labels.shape[0], np.float32)
+            for label, weight in class_weight.items():
+                cw[labels == label] = float(weight)
+            sample_weight = (cw if sample_weight is None
+                             else np.asarray(sample_weight,
+                                             np.float32) * cw)
         if sample_weight is not None and not (
                 hasattr(x, "shape") or isinstance(x, (dict, list, tuple))):
             # Pre-built datasets ignore as_dataset kwargs — silently
@@ -810,11 +833,21 @@ class Trainer:
                                                     self.state)
                 logger.info("Resumed training from %s at step %d.",
                             resume_from, int(self.state.step))
-        if (self._jit_train_step is None
-                or getattr(self, "_train_step_weighted", None) != weighted):
-            self._jit_train_step = self._make_train_step(
-                weighted=weighted)
-            self._train_step_weighted = weighted
+        # Two-slot cache: alternating weighted/unweighted fits reuse
+        # each compiled variant instead of re-tracing on every flip.
+        # Each slot carries its scalar-unmasked set (written by that
+        # variant's trace), so switching variants re-points the guard
+        # _fit_epochs reads rather than leaking the other slot's names.
+        cache = getattr(self, "_train_step_cache", None)
+        if cache is None:
+            cache = self._train_step_cache = {}
+            if self._jit_train_step is not None:
+                cache[False] = (self._jit_train_step, set())
+        if weighted not in cache:
+            step = self._make_train_step(weighted=weighted)
+            cache[weighted] = (step, self._train_scalar_unmasked)
+        self._jit_train_step, scalar_set = cache[weighted]
+        self._train_scalar_unmasked = scalar_set if weighted else set()
 
         history = {}
         self.stop_training = False
@@ -1112,6 +1145,10 @@ class Trainer:
                 # float() conversion below is the only barrier.
                 totals[k] = totals.get(k, 0.0) + v * agg
         if weight == 0.0:
+            if weighted_eval:
+                raise ValueError(
+                    "evaluate(): total sample_weight is zero — no "
+                    "example carries weight, so no mean exists.")
             raise ValueError("evaluate() received an empty dataset.")
         logs = {k: float(v) / weight for k, v in totals.items()}
         if verbose and jax.process_index() == 0:

@@ -1136,3 +1136,96 @@ class TestWeightedEpochAggregation:
         # exact weighted mean over the same data/weights.
         assert history["accuracy"][0] == pytest.approx(
             logs["accuracy"], rel=1e-4)
+
+
+class TestClassWeight:
+    def test_class_weight_matches_equivalent_sample_weight(self):
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=128)
+        cw = {0: 2.0, 2: 0.5}
+        sw = np.ones(128, np.float32)
+        sw[y == 0] = 2.0
+        sw[y == 2] = 0.5
+        a = Trainer(MLP(hidden=16, num_classes=4,
+                        compute_dtype=jnp.float32),
+                    optimizer=optax.adam(1e-2), seed=0)
+        b = Trainer(MLP(hidden=16, num_classes=4,
+                        compute_dtype=jnp.float32),
+                    optimizer=optax.adam(1e-2), seed=0)
+        ha = a.fit(x, y, epochs=2, batch_size=32, shuffle=False,
+                   class_weight=cw, verbose=False)
+        hb = b.fit(x, y, epochs=2, batch_size=32, shuffle=False,
+                   sample_weight=sw, verbose=False)
+        np.testing.assert_allclose(ha["loss"], hb["loss"], rtol=1e-6)
+
+    def test_class_weight_composes_with_sample_weight(self):
+        x, y = _toy_classification(n=64)
+        sw = np.full(64, 0.5, np.float32)
+        trainer = Trainer(MLP(hidden=8, num_classes=4))
+        history = trainer.fit(x, y, epochs=1, batch_size=32,
+                              class_weight={1: 3.0}, sample_weight=sw,
+                              verbose=False)
+        assert np.isfinite(history["loss"][0])
+
+    def test_class_weight_needs_labels(self):
+        x, _ = _toy_classification(n=32)
+        trainer = Trainer(MLP(hidden=8, num_classes=4))
+        with pytest.raises(ValueError, match="class_weight"):
+            trainer.fit(x, None, epochs=1, verbose=False,
+                        class_weight={0: 2.0})
+
+
+class TestWeightedFitReviewRegressions:
+    def test_alternating_weighted_unweighted_fits(self):
+        """Both train-step variants cache; a weighted fit after an
+        unweighted one (and back) works and the scalar guard doesn't
+        leak across variants."""
+        x, y = _toy_classification(n=64)
+        w = np.ones(64, np.float32)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          optimizer=optax.adam(1e-2))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        trainer.fit(x, y, epochs=1, batch_size=32, sample_weight=w,
+                    verbose=False)
+        h = trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        assert np.isfinite(h["loss"][0])
+        assert set(trainer._train_step_cache) == {False, True}
+
+    def test_top5_clamps_to_class_count(self):
+        import jax.numpy as jnp
+
+        from cloud_tpu.training.trainer import METRICS
+
+        logits = jnp.asarray([[0.1, 0.9], [0.9, 0.1]])
+        labels = jnp.asarray([0, 1])
+        # 2 classes < 5: every example is a top-k hit by definition.
+        np.testing.assert_array_equal(
+            np.asarray(METRICS["top5_accuracy"](logits, labels)),
+            [1.0, 1.0])
+
+    def test_zero_total_weight_message(self):
+        x, y = _toy_classification(n=32)
+        trainer = Trainer(MLP(hidden=8, num_classes=4))
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        with pytest.raises(ValueError, match="sample_weight is zero"):
+            trainer.evaluate(x, y, batch_size=32, verbose=False,
+                             sample_weight=np.zeros(32, np.float32))
+
+    def test_cloud_fit_ships_validation_weights(self, tmp_path):
+        from cloud_tpu.cloud_fit import client, remote
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=64).astype(np.int32)
+        vw = np.ones(32, np.float32)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          optimizer="adam",
+                          loss="sparse_categorical_crossentropy",
+                          metrics=("accuracy",))
+        client.serialize_assets(
+            str(tmp_path), trainer, x, y,
+            validation_data=(x[:32], y[:32], vw), epochs=1,
+            batch_size=32)
+        history = remote.run(str(tmp_path), "one_device")
+        assert "val_loss" in history
