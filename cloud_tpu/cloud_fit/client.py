@@ -301,6 +301,14 @@ def cloud_fit(trainer,
             "{} is not supported. Must be one of {}.".format(
                 distribution_strategy,
                 utils.SUPPORTED_DISTRIBUTION_STRATEGIES))
+    if (validation_data is not None and len(validation_data) == 3
+            and distribution_strategy in ("tpu_pod", "multi_worker")):
+        # Trainer.fit would raise this on the pod AFTER provisioning —
+        # fail at submission time instead (same pattern as the local
+        # shard-path check below).
+        raise NotImplementedError(
+            "Weighted validation_data=(x, y, w) is single-process for "
+            "now; drop the weights or evaluate separately.")
 
     serialize_assets(remote_dir, trainer, x, y, validation_data,
                      **fit_kwargs)

@@ -38,7 +38,9 @@ class ArrayDataset:
     def __init__(self, x, y=None, batch_size=32, shuffle=False, seed=0,
                  drop_remainder=True, sample_weight=None):
         self.x = x
-        self.y = y
+        # Keras accepts plain-list labels; indexing below needs arrays.
+        self.y = None if y is None else np.asarray(y)
+        y = self.y
         leaves = jax.tree_util.tree_leaves(x)
         if not leaves:
             raise ValueError("Empty dataset.")

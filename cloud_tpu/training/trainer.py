@@ -777,11 +777,10 @@ class Trainer:
         absent from the dict weigh 1.0.
         """
         if class_weight is not None:
-            if y is None or not hasattr(y, "shape") or np.asarray(
-                    y).ndim != 1:
+            labels = None if y is None else np.asarray(y)
+            if labels is None or labels.ndim != 1:
                 raise ValueError(
                     "class_weight= needs 1-D integer labels `y`.")
-            labels = np.asarray(y)
             cw = np.ones(labels.shape[0], np.float32)
             for label, weight in class_weight.items():
                 cw[labels == label] = float(weight)
@@ -841,8 +840,6 @@ class Trainer:
         cache = getattr(self, "_train_step_cache", None)
         if cache is None:
             cache = self._train_step_cache = {}
-            if self._jit_train_step is not None:
-                cache[False] = (self._jit_train_step, set())
         if weighted not in cache:
             step = self._make_train_step(weighted=weighted)
             cache[weighted] = (step, self._train_scalar_unmasked)

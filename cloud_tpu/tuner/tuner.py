@@ -313,6 +313,26 @@ class CloudTuner:
         return [t.hyperparameters
                 for t in self.get_best_trials(num_trials)]
 
+    def results_summary(self, num_trials=10):
+        """Logs the top trials (KerasTuner's `results_summary` shape):
+        rank, trial id, objective value, and hyperparameter values."""
+        objective = self.oracle.objective
+        trials = self.get_best_trials(num_trials)
+        lines = ["Results summary ({} best of study {!r}, "
+                 "objective {} [{}]):".format(
+                     len(trials), self.oracle.study_id,
+                     objective.name, objective.direction)]
+        for rank, trial in enumerate(trials, start=1):
+            lines.append("  #{} trial {}: {} = {}".format(
+                rank, trial.trial_id, objective.name,
+                getattr(trial, "score", None)))
+            for name, value in sorted(
+                    trial.hyperparameters.values.items()):
+                lines.append("      {}: {}".format(name, value))
+        text = "\n".join(lines)
+        logger.info("%s", text)
+        return text
+
 
 class DistributingCloudTuner(CloudTuner):
     """Tuner whose trials each train remotely on a TPU slice via

@@ -1229,3 +1229,12 @@ class TestWeightedFitReviewRegressions:
             batch_size=32)
         history = remote.run(str(tmp_path), "one_device")
         assert "val_loss" in history
+
+    def test_class_weight_accepts_list_labels(self):
+        x, _ = _toy_classification(n=32)
+        y_list = [int(v) for v in np.random.default_rng(0).integers(
+            0, 4, size=32)]
+        trainer = Trainer(MLP(hidden=8, num_classes=4))
+        history = trainer.fit(x, y_list, epochs=1, batch_size=32,
+                              class_weight={0: 2.0}, verbose=False)
+        assert np.isfinite(history["loss"][0])

@@ -607,3 +607,31 @@ class TestLoadTrainerGCS:
             tuner_module.cloud_fit_client.SPEC_FILE)]
         assert restores == ["gs://bkt/tuning/7/{}".format(
             tuner_module.cloud_fit_remote.OUTPUT_DIR)]
+
+
+class TestResultsSummary:
+    def test_results_summary_lists_best_trials(self, tmp_path):
+        fake = FakeVizier(max_suggestions=2)
+
+        def hypermodel(hp):
+            from cloud_tpu.models import MLP
+            from cloud_tpu.training import Trainer
+
+            return Trainer(MLP(hidden=hp.get("units"), num_classes=4),
+                           optimizer="adam")
+
+        tuner = CloudTuner(hypermodel, directory=str(tmp_path),
+                           objective=Objective("accuracy", "max"),
+                           hyperparameters=_search_space(),
+                           max_trials=2, study_id="s_summary",
+                           project_id="p", region="r",
+                           service_client=fake.service)
+        x = np.random.default_rng(0).normal(
+            size=(64, 8)).astype(np.float32)
+        y = np.random.default_rng(0).integers(
+            0, 4, size=64).astype(np.int32)
+        tuner.search(x=x, y=y, epochs=1, batch_size=32)
+        text = tuner.results_summary(num_trials=2)
+        assert "Results summary" in text
+        assert "accuracy" in text
+        assert "units" in text
