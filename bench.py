@@ -360,7 +360,27 @@ def worker():
         eval_kwargs={"train": False},
         metrics=())
     trainer.build(x)
-    step_fn = trainer._make_train_step()
+
+    # In-graph multi-step (steps_per_execution): BENCH_SPE optimizer
+    # steps per dispatch via lax.scan over the SAME resident batch —
+    # on the tunneled chip every dispatch costs a ~66ms round-trip
+    # (PERF.md), so amortizing it across the chunk measures the chip,
+    # not the tunnel. BENCH_SPE=1 preserves the round-2 methodology.
+    spe = max(int(os.environ.get("BENCH_SPE", 1)), 1)
+    if spe > 1:
+        inner = trainer._make_train_step_body()
+
+        def chunk_fn(state, batch):
+            def body(s, _):
+                s, logs = inner(s, batch)
+                return s, logs
+
+            state, logs = jax.lax.scan(body, state, None, length=spe)
+            return state, {k: v[-1] for k, v in logs.items()}
+
+        step_fn = jax.jit(chunk_fn, donate_argnums=0)
+    else:
+        step_fn = trainer._make_train_step()
 
     batch = trainer._feed((x, y))
     state = trainer.state
@@ -394,7 +414,7 @@ def worker():
         chunk_times.append(time.perf_counter() - t0)
     median_elapsed = sorted(chunk_times)[len(chunk_times) // 2]
 
-    images_per_sec = BATCH * CHUNK / median_elapsed
+    images_per_sec = BATCH * CHUNK * spe / median_elapsed
     tflops = images_per_sec * RESNET50_GFLOPS_PER_IMAGE / 1000.0
     record = {
         "metric": _metric_name(),
@@ -403,13 +423,15 @@ def worker():
         "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
         "method": "median_chunk",
         "chunk": CHUNK,
-        "steps": max(TIMED_STEPS // CHUNK, 1) * CHUNK,
+        "steps": max(TIMED_STEPS // CHUNK, 1) * CHUNK * spe,
         "batch": BATCH,
         "image": IMAGE,
         "platform": jax.default_backend(),
         "tflops": round(tflops, 1),
         "pct_peak": round(100.0 * tflops / V5E_PEAK_TFLOPS, 1),
     }
+    if spe > 1:
+        record["steps_per_execution"] = spe
     if s2d:
         record["stem"] = "space_to_depth"
     if os.environ.get("BENCH_SKIP_KERNEL_PARITY", "0") != "1":

@@ -302,7 +302,8 @@ def cloud_fit(trainer,
                 distribution_strategy,
                 utils.SUPPORTED_DISTRIBUTION_STRATEGIES))
     if (validation_data is not None and len(validation_data) == 3
-            and distribution_strategy in ("tpu_pod", "multi_worker")):
+            and distribution_strategy in ("tpu_pod", "multi_worker",
+                                          "multi_slice")):
         # Trainer.fit would raise this on the pod AFTER provisioning —
         # fail at submission time instead (same pattern as the local
         # shard-path check below).

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from cloud_tpu.parallel import runtime
 from cloud_tpu.parallel import sharding as sharding_lib
 from cloud_tpu.training import data as data_lib
@@ -261,7 +263,8 @@ class Trainer:
                  remat=False,
                  zero1=False,
                  fsdp=False,
-                 ema_decay=None):
+                 ema_decay=None,
+                 steps_per_execution=1):
         """Constructor.
 
         Args:
@@ -305,6 +308,14 @@ class Trainer:
                 all-gathers weights at use and reduce-scatters grads.
                 Implies the zero1 moment layout (moments follow their
                 params). No-op without a mesh or a >1-sized "dp" axis.
+            steps_per_execution: Run N optimizer steps per XLA
+                executable call (Keras `steps_per_execution`): fit
+                stacks N host batches and a `lax.scan` executes them in
+                ONE dispatch — the host-overhead amortizer for
+                fast steps and high-latency links (the tunneled chip
+                pays ~66ms per dispatch, PERF.md). Single-process;
+                leftover batches at epoch end run through the
+                single-step path.
             ema_decay: Track an exponential moving average of the
                 parameters (e.g. 0.999): `ema_params` exposes the
                 shadow, and evaluate/predict take `use_ema=True` to
@@ -339,6 +350,11 @@ class Trainer:
             # applied updates (zero updates on accumulation micro-steps
             # just decay toward unchanged params — harmless smoothing).
             optimizer = optax.chain(optimizer, _param_ema(ema_decay))
+        self.steps_per_execution = int(steps_per_execution)
+        if self.steps_per_execution < 1:
+            raise ValueError(
+                "steps_per_execution must be >= 1; got {}.".format(
+                    steps_per_execution))
         self.gradient_accumulation_steps = int(gradient_accumulation_steps)
         if self.gradient_accumulation_steps > 1:
             optimizer = optax.MultiSteps(
@@ -490,8 +506,12 @@ class Trainer:
 
     # -- jitted steps ---------------------------------------------------
 
-    def _make_train_step(self, weighted=False):
-        """weighted: batches are (x, y, sample_weight) triples — the
+    def _make_train_step_body(self, weighted=False):
+        """The raw (unjitted) train step closure — the single source of
+        truth shared by the jitted single-step path and the
+        steps_per_execution scan.
+
+        weighted: batches are (x, y, sample_weight) triples — the
         loss is the weighted batch mean (Keras sum-over-batch-size
         semantics: mean(per_example * w)) and per-example metrics are
         weighted means (sum(v*w)/sum(w))."""
@@ -603,6 +623,10 @@ class Trainer:
                 logs["_batch_weight"] = jnp.sum(w)
             return new_state, logs
 
+        return train_step
+
+    def _make_train_step(self, weighted=False):
+        train_step = self._make_train_step_body(weighted=weighted)
         if self._mesh is None:
             return jax.jit(train_step, donate_argnums=0)
         batch_sharding = sharding_lib.batch_sharding(self._mesh)
@@ -610,6 +634,58 @@ class Trainer:
                     else (batch_sharding, batch_sharding))
         return jax.jit(
             train_step,
+            in_shardings=(self._state_sharding, batch_in),
+            out_shardings=(self._state_sharding, None),
+            donate_argnums=0)
+
+    def _make_multi_train_step(self, num_steps, weighted=False):
+        """ONE XLA executable running `num_steps` optimizer steps via
+        `lax.scan` over a leading step axis of stacked batches
+        ([num_steps, B, ...] leaves) — Keras `steps_per_execution`,
+        TPU-first: per-step host dispatch (66ms round-trips on the
+        tunneled chip, PERF.md) amortizes across the whole group, and
+        XLA can overlap the next step's transfers with compute.
+
+        Returns (state, logs) with each log the mean over the group
+        (weighted runs also return summed "_batch_weight" so epoch
+        aggregation stays exact).
+        """
+        del num_steps  # shape comes from the stacked batch leaves
+        inner = self._make_train_step_body(weighted=weighted)
+
+        def multi_step(state, batches):
+            def body(s, batch):
+                s, logs = inner(s, batch)
+                return s, logs
+
+            state, logs_seq = jax.lax.scan(body, state, batches)
+            if "_batch_weight" in logs_seq:
+                # Weighted group: each step's metric is a weighted mean
+                # over that step's batch; the group value re-weights by
+                # the per-step weight sums (same identity the epoch
+                # aggregation uses). Loss keeps sum-over-batch-size
+                # semantics (plain mean).
+                ws = logs_seq["_batch_weight"]
+                logs = {}
+                for k, v in logs_seq.items():
+                    if k == "_batch_weight":
+                        continue
+                    logs[k] = (jnp.mean(v) if k == "loss"
+                               else _weighted_mean(v, ws))
+                logs["_batch_weight"] = jnp.sum(ws)
+            else:
+                logs = {k: jnp.mean(v) for k, v in logs_seq.items()}
+            return state, logs
+
+        if self._mesh is None:
+            return jax.jit(multi_step, donate_argnums=0)
+        batch_sharding = sharding_lib.batch_sharding(self._mesh)
+        stacked = NamedSharding(
+            self._mesh, P(None, *batch_sharding.spec))
+        batch_in = ((stacked,) * 3 if weighted
+                    else (stacked, stacked))
+        return jax.jit(
+            multi_step,
             in_shardings=(self._state_sharding, batch_in),
             out_shardings=(self._state_sharding, None),
             donate_argnums=0)
@@ -718,6 +794,50 @@ class Trainer:
                 and hasattr(dataset, "process_local_view")):
             return dataset.process_local_view()
         return iter(dataset)
+
+    def _grouped_host_batches(self, batches, limit, spe):
+        """Yields ("multi", n, stacked_group) for each full group of
+        `spe` host batches and ("single", n, batch) for the leftovers —
+        the steps_per_execution input shape."""
+
+        def count(batch):
+            lead = next((l for l in jax.tree_util.tree_leaves(batch)
+                         if getattr(l, "shape", ())), None)
+            return int(lead.shape[0]) if lead is not None else 0
+
+        group = []
+        for i, batch in enumerate(batches):
+            if limit is not None and i >= limit:
+                break
+            if group and count(batch) != count(group[0]):
+                # Ragged batch (e.g. drop_remainder=False tails):
+                # np.stack can't group it — flush what we have as
+                # singles and keep going.
+                for b in group:
+                    yield "single", count(b), b
+                group = []
+            group.append(batch)
+            if len(group) == spe:
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *group)
+                yield "multi", sum(count(b) for b in group), stacked
+                group = []
+        for batch in group:
+            yield "single", count(batch), batch
+
+    def _feed_grouped(self, item):
+        """Feed for the steps_per_execution path: stacked groups get
+        the [None, dp, ...] layout the multi-step jit expects; leftover
+        singles use the ordinary feed."""
+        kind, _, batch = item
+        if kind == "single":
+            return self._feed(batch)
+        if self._mesh is None:
+            return jax.device_put(batch)
+        bs = sharding_lib.batch_sharding(self._mesh)
+        stacked = NamedSharding(self._mesh, P(None, *bs.spec))
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, stacked), batch)
 
     def _prefetch_batches(self, batches, limit=None, size=2):
         """Yields (local_example_count, device_batch) with `size` batches
@@ -846,6 +966,21 @@ class Trainer:
         self._jit_train_step, scalar_set = cache[weighted]
         self._train_scalar_unmasked = scalar_set if weighted else set()
 
+        spe = self.steps_per_execution
+        if spe > 1 and jax.process_count() > 1:
+            raise NotImplementedError(
+                "steps_per_execution > 1 is single-process for now "
+                "(stacked multi-host shard assembly is not wired).")
+        self._jit_multi_step = None
+        if spe > 1:
+            mcache = getattr(self, "_multi_step_cache", None)
+            if mcache is None:
+                mcache = self._multi_step_cache = {}
+            if weighted not in mcache:
+                mcache[weighted] = self._make_multi_train_step(
+                    spe, weighted=weighted)
+            self._jit_multi_step = mcache[weighted]
+
         history = {}
         self.stop_training = False
         # Visible to callbacks at on_train_begin (e.g. ProfilerCallback
@@ -891,6 +1026,57 @@ class Trainer:
             count = 0
             examples = 0
             t0 = time.time()
+            spe = self.steps_per_execution
+            multi_step = getattr(self, "_jit_multi_step", None)
+            if spe > 1 and multi_step is not None:
+                feeder = data_lib.prefetch_to_device(
+                    self._grouped_host_batches(
+                        self._epoch_batches(dataset), steps_per_epoch,
+                        spe),
+                    size=prefetch,
+                    feed=lambda item: (item[0], item[1],
+                                       self._feed_grouped(item)))
+                first = True
+                for kind, batch_examples, fed in feeder:
+                    examples += batch_examples
+                    if kind == "multi":
+                        self.state, logs = multi_step(self.state, fed)
+                        if "_batch_weight" in logs:
+                            # The group log already carries the GROUP
+                            # weight sum: append once (duplicating
+                            # would double-weight groups vs leftover
+                            # singles in the epoch re-weighting).
+                            step_logs.append(logs)
+                        else:
+                            # Unweighted epoch mean is a per-step mean:
+                            # the group mean stands for `spe` steps.
+                            step_logs.extend([logs] * spe)
+                        count += spe
+                    else:
+                        self.state, logs = self._jit_train_step(
+                            self.state, fed)
+                        step_logs.append(logs)
+                        count += 1
+                    if (first and epoch == 0
+                            and getattr(self, "_train_scalar_unmasked",
+                                        None)):
+                        # Same loud failure as the single-step path: a
+                        # scalar metric can't be sample-weighted.
+                        raise ValueError(
+                            "Custom metrics {} return a scalar and "
+                            "cannot apply sample_weight. Give them a "
+                            "mask-aware signature "
+                            "fn(outputs, y, mask=...) or return "
+                            "per-example values.".format(
+                                sorted(self._train_scalar_unmasked)))
+                    first = False
+                self._post_epoch_logs(step_logs, count, examples, t0,
+                                      epoch, validation_data,
+                                      batch_size, callbacks, history,
+                                      verbose, prefetch)
+                if self.stop_training:
+                    break
+                continue
             feeder = self._prefetch_batches(
                 self._epoch_batches(dataset), limit=steps_per_epoch,
                 size=prefetch)
@@ -914,55 +1100,63 @@ class Trainer:
                 # device step); convert once per epoch below.
                 step_logs.append(logs)
                 count += 1
-            if step_logs and "_batch_weight" in step_logs[0]:
-                # Weighted fit: epoch metrics re-weight each batch's
-                # weighted mean by that batch's weight sum (exact over
-                # the epoch); the loss keeps Keras sum-over-batch-size
-                # semantics (plain mean over equal-size batches).
-                ws = jnp.stack([l["_batch_weight"] for l in step_logs])
-                total_w = jnp.maximum(jnp.sum(ws), 1e-9)
-                logs = {}
-                for k in step_logs[0]:
-                    if k == "_batch_weight":
-                        continue
-                    vals = jnp.stack([l[k] for l in step_logs])
-                    if k == "loss":
-                        logs[k] = float(jnp.mean(vals))
-                    else:
-                        logs[k] = float(jnp.sum(vals * ws) / total_w)
-            elif step_logs:
-                stacked = jax.tree_util.tree_map(
-                    lambda *xs: jnp.mean(jnp.stack(xs)), *step_logs)
-                logs = {k: float(v) for k, v in stacked.items()}
-            else:
-                logs = {}
-            elapsed = max(time.time() - t0, 1e-9)
-            logs["steps_per_sec"] = count / elapsed
-            _emit_runtime_metrics(count, examples, elapsed)
-
-            if validation_data is not None:
-                # Keras-style (x, y) or (x, y, sample_weight).
-                if len(validation_data) == 3:
-                    val_x, val_y, val_sw = validation_data
-                else:
-                    val_x, val_y = validation_data
-                    val_sw = None
-                val_logs = self.evaluate(val_x, val_y,
-                                         batch_size=batch_size,
-                                         verbose=False,
-                                         prefetch=prefetch,
-                                         sample_weight=val_sw)
-                logs.update({"val_" + k: v for k, v in val_logs.items()})
-
-            for k, v in logs.items():
-                history.setdefault(k, []).append(v)
-            if verbose and jax.process_index() == 0:
-                logger.info("epoch %d: %s", epoch, {
-                    k: round(v, 4) for k, v in logs.items()})
-            for cb in callbacks:
-                cb.on_epoch_end(epoch, logs)
+            self._post_epoch_logs(step_logs, count, examples, t0, epoch,
+                                  validation_data, batch_size, callbacks,
+                                  history, verbose, prefetch)
             if self.stop_training:
                 break
+
+    def _post_epoch_logs(self, step_logs, count, examples, t0, epoch,
+                         validation_data, batch_size, callbacks, history,
+                         verbose, prefetch):
+        """Epoch-end: aggregate step logs, validate, notify callbacks."""
+        if step_logs and "_batch_weight" in step_logs[0]:
+            # Weighted fit: epoch metrics re-weight each batch's
+            # weighted mean by that batch's weight sum (exact over
+            # the epoch); the loss keeps Keras sum-over-batch-size
+            # semantics (plain mean over equal-size batches).
+            ws = jnp.stack([l["_batch_weight"] for l in step_logs])
+            total_w = jnp.maximum(jnp.sum(ws), 1e-9)
+            logs = {}
+            for k in step_logs[0]:
+                if k == "_batch_weight":
+                    continue
+                vals = jnp.stack([l[k] for l in step_logs])
+                if k == "loss":
+                    logs[k] = float(jnp.mean(vals))
+                else:
+                    logs[k] = float(jnp.sum(vals * ws) / total_w)
+        elif step_logs:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.mean(jnp.stack(xs)), *step_logs)
+            logs = {k: float(v) for k, v in stacked.items()}
+        else:
+            logs = {}
+        elapsed = max(time.time() - t0, 1e-9)
+        logs["steps_per_sec"] = count / elapsed
+        _emit_runtime_metrics(count, examples, elapsed)
+
+        if validation_data is not None:
+            # Keras-style (x, y) or (x, y, sample_weight).
+            if len(validation_data) == 3:
+                val_x, val_y, val_sw = validation_data
+            else:
+                val_x, val_y = validation_data
+                val_sw = None
+            val_logs = self.evaluate(val_x, val_y,
+                                     batch_size=batch_size,
+                                     verbose=False,
+                                     prefetch=prefetch,
+                                     sample_weight=val_sw)
+            logs.update({"val_" + k: v for k, v in val_logs.items()})
+
+        for k, v in logs.items():
+            history.setdefault(k, []).append(v)
+        if verbose and jax.process_index() == 0:
+            logger.info("epoch %d: %s", epoch, {
+                k: round(v, 4) for k, v in logs.items()})
+        for cb in callbacks:
+            cb.on_epoch_end(epoch, logs)
 
     @property
     def ema_params(self):

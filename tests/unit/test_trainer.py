@@ -1238,3 +1238,118 @@ class TestWeightedFitReviewRegressions:
         history = trainer.fit(x, y_list, epochs=1, batch_size=32,
                               class_weight={0: 2.0}, verbose=False)
         assert np.isfinite(history["loss"][0])
+
+
+class TestStepsPerExecution:
+    """Keras steps_per_execution: N optimizer steps per XLA dispatch
+    via lax.scan over stacked batches."""
+
+    def test_matches_single_step_exactly(self):
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=192)
+        a = Trainer(MLP(hidden=16, num_classes=4,
+                        compute_dtype=jnp.float32),
+                    optimizer=optax.adam(1e-2), seed=0,
+                    steps_per_execution=3)
+        b = Trainer(MLP(hidden=16, num_classes=4,
+                        compute_dtype=jnp.float32),
+                    optimizer=optax.adam(1e-2), seed=0)
+        ha = a.fit(x, y, epochs=3, batch_size=32, shuffle=False,
+                   verbose=False)
+        hb = b.fit(x, y, epochs=3, batch_size=32, shuffle=False,
+                   verbose=False)
+        np.testing.assert_allclose(ha["loss"], hb["loss"], rtol=1e-5)
+        assert int(a.state.step) == int(b.state.step) == 18
+
+    def test_leftover_batches_run_singly(self):
+        # 5 batches/epoch with spe=2: two groups + one single.
+        x, y = _toy_classification(n=160)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          optimizer=optax.adam(1e-2),
+                          steps_per_execution=2)
+        trainer.fit(x, y, epochs=1, batch_size=32, shuffle=False,
+                    verbose=False)
+        assert int(trainer.state.step) == 5
+
+    def test_on_dp_mesh(self):
+        runtime.initialize(strategy="tpu_slice")
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          optimizer=optax.adam(1e-2),
+                          steps_per_execution=2)
+        history = trainer.fit(x, y, epochs=2, batch_size=64,
+                              verbose=False)
+        assert history["loss"][-1] < history["loss"][0]
+
+    def test_with_sample_weight(self):
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=128)
+        w = np.linspace(0.5, 1.5, 128).astype(np.float32)
+        a = Trainer(MLP(hidden=16, num_classes=4,
+                        compute_dtype=jnp.float32),
+                    optimizer=optax.adam(1e-2), seed=0,
+                    steps_per_execution=2)
+        b = Trainer(MLP(hidden=16, num_classes=4,
+                        compute_dtype=jnp.float32),
+                    optimizer=optax.adam(1e-2), seed=0)
+        ha = a.fit(x, y, epochs=2, batch_size=32, shuffle=False,
+                   sample_weight=w, verbose=False)
+        hb = b.fit(x, y, epochs=2, batch_size=32, shuffle=False,
+                   sample_weight=w, verbose=False)
+        np.testing.assert_allclose(ha["loss"], hb["loss"], rtol=1e-5)
+        np.testing.assert_allclose(ha["accuracy"], hb["accuracy"],
+                                   rtol=1e-5)
+
+    def test_invalid_value_rejected(self):
+        with pytest.raises(ValueError, match="steps_per_execution"):
+            Trainer(MLP(hidden=8, num_classes=4),
+                    steps_per_execution=0)
+
+    def test_weighted_spe_with_leftover_exact(self):
+        """Group + leftover single under sample_weight: frozen params
+        make the epoch metric comparable to evaluate's exact weighted
+        mean (group weights must not double-count)."""
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=96)  # 3 batches: 1 group + 1 single
+        w = np.linspace(0.2, 2.0, 96).astype(np.float32)
+        trainer = Trainer(MLP(hidden=16, num_classes=4,
+                              compute_dtype=jnp.float32),
+                          optimizer=optax.sgd(0.0),  # frozen
+                          steps_per_execution=2)
+        history = trainer.fit(x, y, epochs=1, batch_size=32,
+                              shuffle=False, sample_weight=w,
+                              verbose=False)
+        logs = trainer.evaluate(x, y, batch_size=32, sample_weight=w,
+                                verbose=False)
+        assert history["accuracy"][0] == pytest.approx(
+            logs["accuracy"], rel=1e-4)
+
+    def test_ragged_tail_inside_group_runs_singly(self):
+        """A custom iterable yielding batches 32,32,32,16 with spe=2:
+        the ragged 16-row batch can't stack into a group — it (and any
+        group-in-progress) must run through the single-step path
+        instead of crashing np.stack."""
+        x, y = _toy_classification(n=112)
+        batches = [(x[i:i + 32], y[i:i + 32]) for i in (0, 32, 64)]
+        batches.append((x[96:], y[96:]))  # ragged 16-row tail
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          optimizer=optax.adam(1e-2),
+                          steps_per_execution=2)
+        trainer.fit(batches, epochs=1, verbose=False)
+        assert int(trainer.state.step) == 4
+
+    def test_scalar_metric_raises_under_weighted_spe(self):
+        import jax.numpy as jnp
+
+        def scalar_m(outputs, y):
+            return jnp.mean(jnp.argmax(outputs, -1) == y)
+
+        x, y = _toy_classification(n=128)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          metrics=(scalar_m,), steps_per_execution=2)
+        with pytest.raises(ValueError, match="scalar_m"):
+            trainer.fit(x, y, epochs=1, batch_size=32, verbose=False,
+                        sample_weight=np.ones(128, np.float32))
