@@ -94,15 +94,20 @@ def shard_batch(batch, mesh=None, axis=DATA_AXIS):
         lambda x: jax.device_put(x, sharding), batch)
 
 
-def make_global_batch(local_batch, mesh=None, axis=DATA_AXIS):
+def make_global_batch(local_batch, mesh=None, axis=DATA_AXIS,
+                      sharding=None):
     """Assembles a global array from per-process local batches.
 
     On multi-host pods each process holds 1/num_processes of the global
     batch (the analogue of `tf.distribute` per-worker dataset sharding,
     reference cloud_fit/remote.py:84-88 delegates this to the strategy).
+    `sharding` overrides the default batch layout (e.g. the
+    steps_per_execution path assembles [spe, B, ...] stacks under
+    P(None, dp)).
     """
-    mesh = _resolve_mesh(mesh)
-    sharding = batch_sharding(mesh, axis)
+    if sharding is None:
+        mesh = _resolve_mesh(mesh)
+        sharding = batch_sharding(mesh, axis)
     return jax.tree_util.tree_map(
         lambda x: jax.make_array_from_process_local_data(sharding, x),
         local_batch)

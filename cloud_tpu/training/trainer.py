@@ -313,8 +313,9 @@ class Trainer:
                 stacks N host batches and a `lax.scan` executes them in
                 ONE dispatch — the host-overhead amortizer for
                 fast steps and high-latency links (the tunneled chip
-                pays ~66ms per dispatch, PERF.md). Single-process;
-                leftover batches at epoch end run through the
+                pays ~66ms per dispatch, PERF.md). Works on multi-host
+                pods (local groups assemble into global stacked
+                arrays); leftover/ragged batches run through the
                 single-step path.
             ema_decay: Track an exponential moving average of the
                 parameters (e.g. 0.999): `ema_params` exposes the
@@ -828,7 +829,10 @@ class Trainer:
     def _feed_grouped(self, item):
         """Feed for the steps_per_execution path: stacked groups get
         the [None, dp, ...] layout the multi-step jit expects; leftover
-        singles use the ordinary feed."""
+        singles use the ordinary feed. On multi-host pods the stacked
+        group holds this process's LOCAL batches; the global array is
+        assembled across processes like make_global_batch, one stacking
+        level up."""
         kind, _, batch = item
         if kind == "single":
             return self._feed(batch)
@@ -836,6 +840,9 @@ class Trainer:
             return jax.device_put(batch)
         bs = sharding_lib.batch_sharding(self._mesh)
         stacked = NamedSharding(self._mesh, P(None, *bs.spec))
+        if jax.process_count() > 1:
+            return sharding_lib.make_global_batch(batch,
+                                                  sharding=stacked)
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(a, stacked), batch)
 
@@ -967,10 +974,6 @@ class Trainer:
         self._train_scalar_unmasked = scalar_set if weighted else set()
 
         spe = self.steps_per_execution
-        if spe > 1 and jax.process_count() > 1:
-            raise NotImplementedError(
-                "steps_per_execution > 1 is single-process for now "
-                "(stacked multi-host shard assembly is not wired).")
         self._jit_multi_step = None
         if spe > 1:
             mcache = getattr(self, "_multi_step_cache", None)

@@ -48,11 +48,23 @@ def main():
                       optimizer=optax.sgd(0.1))
     history = trainer.fit(x, y, epochs=2, batch_size=32, shuffle=False,
                           verbose=False)
+
+    # steps_per_execution on the pod: local groups assemble into
+    # global stacked arrays; the loss trajectory must match exactly.
+    # spe=3 over 4 batches/epoch: one full group + one LEFTOVER single
+    # step, so the mixed multi/single dispatch runs multi-host too.
+    spe_trainer = Trainer(MLP(hidden=16, num_classes=4,
+                              compute_dtype=jnp.float32),
+                          optimizer=optax.sgd(0.1),
+                          steps_per_execution=3)
+    spe_history = spe_trainer.fit(x, y, epochs=2, batch_size=32,
+                                  shuffle=False, verbose=False)
     print(json.dumps({
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
         "num_devices": len(jax.devices()),
         "loss": history["loss"],
+        "spe_loss": spe_history["loss"],
     }))
 
 

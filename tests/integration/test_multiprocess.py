@@ -103,6 +103,13 @@ def test_two_process_pod_matches_single_process():
     np.testing.assert_allclose(outs[0]["loss"], history["loss"],
                                rtol=1e-5)
 
+    # steps_per_execution on the pod (local groups -> global stacked
+    # arrays) must match the single-step pod run exactly.
+    np.testing.assert_allclose(outs[0]["spe_loss"], outs[0]["loss"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(outs[0]["spe_loss"], outs[1]["spe_loss"],
+                               rtol=1e-6)
+
 
 @pytest.mark.parametrize("bad_id", [0])
 def test_worker_requires_peer(bad_id):
