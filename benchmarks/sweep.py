@@ -5,9 +5,10 @@ BASELINE.md config 2) by running the bench worker across a grid. Each
 point runs in its own bounded subprocess (the tunneled backend can hang
 — a stuck point must not take the sweep down), emits one JSON line, and
 the sweep ends with a summary line naming the best config and how to
-pin it (BENCH_BATCH / BENCH_S2D env for bench.py).
+pin it (BENCH_BATCH / BENCH_S2D / BENCH_SPE env for bench.py).
 
 Usage: python benchmarks/sweep.py [--batches 128,256,512] [--s2d 0,1]
+       [--spe 1,5]
 """
 
 import argparse
@@ -20,11 +21,12 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(_REPO_ROOT, "bench.py")
 
 
-def run_point(batch, s2d, timeout):
+def run_point(batch, s2d, spe, timeout):
     env = dict(
         os.environ,
         BENCH_BATCH=str(batch),
         BENCH_S2D=str(s2d),
+        BENCH_SPE=str(spe),
         # The parity smoke belongs to the flagship bench.py run, not to
         # every sweep point (~30s apiece); the worker's persistent
         # compilation cache (benchmarks/.jax_cache) still makes repeat
@@ -36,19 +38,19 @@ def run_point(batch, s2d, timeout):
             [sys.executable, BENCH, "--worker"], capture_output=True,
             text=True, timeout=timeout, env=env, cwd=_REPO_ROOT)
     except subprocess.TimeoutExpired:
-        return {"batch": batch, "s2d": s2d,
+        return {"batch": batch, "s2d": s2d, "spe": spe,
                 "error": "hung past {:.0f}s".format(timeout)}
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
                 record = json.loads(line)
-                record.update({"batch": batch, "s2d": s2d})
+                record.update({"batch": batch, "s2d": s2d, "spe": spe})
                 return record
             except ValueError:
                 break
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-    return {"batch": batch, "s2d": s2d,
+    return {"batch": batch, "s2d": s2d, "spe": spe,
             "error": tail[-1] if tail else "rc={}".format(proc.returncode)}
 
 
@@ -56,17 +58,22 @@ def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batches", default="128,256,512")
     parser.add_argument("--s2d", default="0,1")
+    # In-graph multi-step (steps_per_execution): on the tunneled chip
+    # per-dispatch overhead is ~66ms (PERF.md), so spe=5 separates chip
+    # throughput from dispatch; both points recorded for the contrast.
+    parser.add_argument("--spe", default="1,5")
     parser.add_argument("--timeout", type=float, default=480.0)
     args = parser.parse_args(argv)
 
     best = None
-    for s2d in [int(v) for v in args.s2d.split(",")]:
-        for batch in [int(v) for v in args.batches.split(",")]:
-            record = run_point(batch, s2d, args.timeout)
-            print(json.dumps(record), flush=True)
-            if "error" not in record and (
-                    best is None or record["value"] > best["value"]):
-                best = record
+    for spe in [int(v) for v in args.spe.split(",")]:
+        for s2d in [int(v) for v in args.s2d.split(",")]:
+            for batch in [int(v) for v in args.batches.split(",")]:
+                record = run_point(batch, s2d, spe, args.timeout)
+                print(json.dumps(record), flush=True)
+                if "error" not in record and (
+                        best is None or record["value"] > best["value"]):
+                    best = record
     if best is None:
         print(json.dumps({"sweep": "failed",
                           "hint": "backend unreachable for every point"}))
@@ -75,7 +82,8 @@ def main(argv=None):
         "sweep": "best",
         "value": best["value"],
         "unit": best.get("unit", "images/sec"),
-        "pin": {"BENCH_BATCH": best["batch"], "BENCH_S2D": best["s2d"]},
+        "pin": {"BENCH_BATCH": best["batch"], "BENCH_S2D": best["s2d"],
+                "BENCH_SPE": best["spe"]},
     }))
     return 0
 
