@@ -80,16 +80,26 @@ def _improved(value, best, mode, min_delta=0.0):
 
 
 class EarlyStopping(Callback):
-    """Stops training when a monitored metric stops improving."""
+    """Stops training when a monitored metric stops improving.
+
+    restore_best_weights: Keras parity — keep a device-resident copy of
+    the parameters AND the extra variable collections (e.g. BatchNorm
+    statistics) from the best epoch and put them back into the train
+    state when training ends (whether stopped early or the epoch budget
+    ran out with a best epoch recorded). Costs one extra copy of those
+    buffers in HBM while training runs.
+    """
 
     def __init__(self, monitor="val_loss", patience=0, min_delta=0.0,
-                 mode="auto"):
+                 mode="auto", restore_best_weights=False):
         self.monitor = monitor
         self.patience = patience
         self.min_delta = abs(min_delta)
         self.mode = _resolve_mode(mode, monitor)
+        self.restore_best_weights = bool(restore_best_weights)
         self.best = None
         self.wait = 0
+        self._best_state = None
 
     def _improved(self, value):
         return _improved(value, self.best, self.mode, self.min_delta)
@@ -97,6 +107,19 @@ class EarlyStopping(Callback):
     def on_train_begin(self):
         self.best = None
         self.wait = 0
+        self._best_state = None
+
+    def _snapshot_state(self):
+        import jax.numpy as jnp
+
+        # A REAL copy: the live buffers are donated to the next step.
+        # Params AND extra_vars (BatchNorm statistics etc.) — restoring
+        # best weights against last-epoch BN stats would pair tensors
+        # from different models.
+        copy = lambda tree: jax.tree_util.tree_map(
+            lambda p: jnp.array(p, copy=True), tree)
+        self._best_state = (copy(self.trainer.state.params),
+                            copy(self.trainer.state.extra_vars))
 
     def on_epoch_end(self, epoch, logs):
         value = logs.get(self.monitor)
@@ -105,10 +128,22 @@ class EarlyStopping(Callback):
         if self._improved(value):
             self.best = value
             self.wait = 0
+            if self.restore_best_weights:
+                self._snapshot_state()
         else:
             self.wait += 1
             if self.wait > self.patience:
                 self.trainer.stop_training = True
+
+    def on_train_end(self, history):
+        if self.restore_best_weights and self._best_state is not None:
+            from cloud_tpu.training.trainer import TrainState
+
+            best_params, best_extra = self._best_state
+            s = self.trainer.state
+            self.trainer.state = TrainState(
+                s.step, best_params, s.opt_state, s.rng, best_extra)
+            self._best_state = None
 
 
 class ModelCheckpoint(Callback):

@@ -1353,3 +1353,123 @@ class TestStepsPerExecution:
         with pytest.raises(ValueError, match="scalar_m"):
             trainer.fit(x, y, epochs=1, batch_size=32, verbose=False,
                         sample_weight=np.ones(128, np.float32))
+
+
+class TestEarlyStoppingRestore:
+    def test_restore_best_weights(self):
+        """Params revert to the best-epoch snapshot when a later epoch
+        is worse (deterministically forced via a metric schedule)."""
+        import jax
+        import jax.numpy as jnp
+
+        from cloud_tpu.training import EarlyStopping
+
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=8, num_classes=4,
+                              compute_dtype=jnp.float32),
+                          optimizer=optax.adam(5e-2))
+        from cloud_tpu.training import Callback
+
+        es = EarlyStopping(monitor="fake", patience=0,
+                           restore_best_weights=True)
+        schedule = iter([1.0, 5.0, 5.0])  # best at epoch 0, then worse
+
+        class FakeMetric(Callback):
+            def on_epoch_end(self, epoch, logs):
+                logs["fake"] = next(schedule)
+
+        fake = FakeMetric()
+        snapshots = {}
+
+        class Snap(Callback):
+            def on_epoch_end(self, epoch, logs):
+                snapshots[epoch] = jax.tree_util.tree_map(
+                    lambda p: np.asarray(p),
+                    self.trainer.state.params)
+
+        # Order: snapshot -> fake metric -> early stopping.
+        trainer.fit(x, y, epochs=3, batch_size=32, verbose=False,
+                    callbacks=[Snap(), fake, es])
+        # Stopped after epoch 1 (patience 0, epoch1 worse than epoch0)
+        # and restored epoch-0 params.
+        final = jax.tree_util.tree_map(lambda p: np.asarray(p),
+                                       trainer.state.params)
+        flat_final = jax.tree_util.tree_leaves(final)
+        flat_best = jax.tree_util.tree_leaves(snapshots[0])
+        flat_last = jax.tree_util.tree_leaves(snapshots[max(snapshots)])
+        for a, b in zip(flat_final, flat_best):
+            np.testing.assert_array_equal(a, b)
+        # And they differ from the last epoch's (training moved them).
+        assert any(not np.array_equal(a, b)
+                   for a, b in zip(flat_final, flat_last))
+
+    def test_no_restore_keeps_last_weights(self):
+        import jax
+
+        from cloud_tpu.training import Callback, EarlyStopping
+
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          optimizer=optax.adam(5e-2))
+        es = EarlyStopping(monitor="loss", patience=0)
+        last = {}
+
+        class Snap(Callback):
+            def on_epoch_end(self, epoch, logs):
+                last["params"] = jax.tree_util.tree_map(
+                    lambda p: np.asarray(p),
+                    self.trainer.state.params)
+
+        trainer.fit(x, y, epochs=2, batch_size=32, verbose=False,
+                    callbacks=[Snap(), es])
+        assert es._best_state is None
+        # Without restore_best_weights the final state IS the last
+        # epoch's state, untouched by on_train_end.
+        for a, b in zip(
+                jax.tree_util.tree_leaves(last["params"]),
+                jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                    lambda p: np.asarray(p), trainer.state.params))):
+            np.testing.assert_array_equal(a, b)
+
+    def test_restores_batch_stats_with_weights(self):
+        """BatchNorm statistics (extra_vars) revert with the weights —
+        best-epoch params against last-epoch BN stats would be tensors
+        from two different models."""
+        import jax
+
+        from cloud_tpu.models import ResNet
+        from cloud_tpu.models.resnet import BasicBlock
+        from cloud_tpu.training import Callback, EarlyStopping
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 16, 16, 3)).astype(np.float32)
+        y = rng.integers(0, 4, size=32).astype(np.int32)
+        import jax.numpy as jnp
+        trainer = Trainer(ResNet(stage_sizes=(1,), block=BasicBlock,
+                                 num_filters=8, num_classes=4,
+                                 compute_dtype=jnp.float32),
+                          optimizer=optax.sgd(1e-1),
+                          train_kwargs={"train": True},
+                          eval_kwargs={"train": False}, metrics=())
+        es = EarlyStopping(monitor="fake", patience=0,
+                           restore_best_weights=True)
+        schedule = iter([1.0, 5.0, 5.0])
+        stats = {}
+
+        class Fake(Callback):
+            def on_epoch_end(self, epoch, logs):
+                stats[epoch] = jax.tree_util.tree_map(
+                    lambda p: np.asarray(p),
+                    self.trainer.state.extra_vars)
+                logs["fake"] = next(schedule)
+
+        trainer.fit(x, y, epochs=3, batch_size=16, verbose=False,
+                    callbacks=[Fake(), es])
+        final = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+            lambda p: np.asarray(p), trainer.state.extra_vars))
+        best = jax.tree_util.tree_leaves(stats[0])
+        last = jax.tree_util.tree_leaves(stats[max(stats)])
+        for a, b in zip(final, best):
+            np.testing.assert_array_equal(a, b)
+        assert any(not np.array_equal(a, b)
+                   for a, b in zip(final, last))
