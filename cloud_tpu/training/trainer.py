@@ -577,8 +577,22 @@ class Trainer:
                 compute = compute_loss
             (loss, (outputs, new_vars)), grads = jax.value_and_grad(
                 compute, has_aux=True)(state.params)
-            updates, new_opt_state = optimizer.update(
-                grads, state.opt_state, state.params)
+            if isinstance(optimizer, (optax.GradientTransformationExtraArgs,
+                                      optax.MultiSteps)):
+                # The extra-args protocol carries the step's loss to
+                # loss-aware transforms (optax.contrib.reduce_on_plateau
+                # chained after the base optimizer). In current optax
+                # every built-in optimizer is ExtraArgs-typed and simply
+                # ignores unknown extras, so this is the COMMON branch;
+                # MultiSteps (grad accumulation) forwards **extra_args
+                # to its inner chain. Only raw custom
+                # GradientTransformations (e.g. _param_ema) take the
+                # plain call below.
+                updates, new_opt_state = optimizer.update(
+                    grads, state.opt_state, state.params, value=loss)
+            else:
+                updates, new_opt_state = optimizer.update(
+                    grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = TrainState(state.step + 1, new_params,
                                    new_opt_state, state.rng, new_vars)

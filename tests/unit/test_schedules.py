@@ -87,3 +87,52 @@ class TestLabelSmoothing:
         with pytest.raises(TypeError, match="factory"):
             Trainer(MLP(hidden=8, num_classes=4),
                     loss=sparse_categorical_crossentropy)
+
+
+class TestReduceOnPlateau:
+    def test_plateau_transform_receives_loss(self):
+        """optax.contrib.reduce_on_plateau chained after the base
+        optimizer gets the step loss through the extra-args protocol
+        and shrinks its scale once the (frozen) loss plateaus."""
+        import jax
+        import numpy as np
+        import optax
+        import optax.contrib
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import Trainer
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=64).astype(np.int32)
+        # sgd(0.0): loss frozen -> guaranteed plateau -> scale decays.
+        opt = optax.chain(
+            optax.sgd(0.0),
+            optax.contrib.reduce_on_plateau(factor=0.5, patience=1,
+                                            cooldown=0))
+        trainer = Trainer(MLP(hidden=8, num_classes=4), optimizer=opt)
+        trainer.fit(x, y, epochs=4, batch_size=32, shuffle=False,
+                    verbose=False)
+        plateau_state = trainer.state.opt_state[-1]
+        assert float(plateau_state.scale) < 1.0
+
+    def test_plateau_composes_with_gradient_accumulation(self):
+        """MultiSteps forwards the loss to the inner loss-aware chain."""
+        import numpy as np
+        import optax
+        import optax.contrib
+
+        from cloud_tpu.models import MLP
+        from cloud_tpu.training import Trainer
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=64).astype(np.int32)
+        opt = optax.chain(
+            optax.sgd(0.0),
+            optax.contrib.reduce_on_plateau(factor=0.5, patience=1))
+        trainer = Trainer(MLP(hidden=8, num_classes=4), optimizer=opt,
+                          gradient_accumulation_steps=2)
+        history = trainer.fit(x, y, epochs=2, batch_size=32,
+                              shuffle=False, verbose=False)
+        assert np.isfinite(history["loss"][-1])
