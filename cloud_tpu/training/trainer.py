@@ -1175,6 +1175,48 @@ class Trainer:
         for cb in callbacks:
             cb.on_epoch_end(epoch, logs)
 
+    def summary(self, print_fn=None):
+        """Keras `model.summary()` parity: per-top-level-module
+        parameter counts plus totals (params and, when present, extra
+        variable collections like BatchNorm stats). Returns the text.
+        Requires a built model (fit() or build())."""
+        if self.state is None:
+            raise RuntimeError("Model is not built; call fit() first or "
+                               "build() with a sample batch.")
+
+        def count(tree):
+            return sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(tree))
+
+        def nbytes(tree):
+            return sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                       for l in jax.tree_util.tree_leaves(tree))
+
+        params = self.state.params
+        rows = []
+        if isinstance(params, dict):
+            for name in sorted(params):
+                rows.append((name, count(params[name])))
+        total = count(params)
+        width = max([len(n) for n, _ in rows]
+                    + [len("Extra vars (e.g. BN stats)")])
+        lines = ["{:<{w}}  {:>14}".format("Module", "Params", w=width),
+                 "-" * (width + 16)]
+        for name, n in rows:
+            lines.append("{:<{w}}  {:>14,}".format(name, n, w=width))
+        lines.append("-" * (width + 16))
+        lines.append("{:<{w}}  {:>14,}".format("Total params", total,
+                                               w=width))
+        lines.append("{:<{w}}  {:>14}".format(
+            "Param bytes", "{:,}".format(nbytes(params)), w=width))
+        extra = count(self.state.extra_vars)
+        if extra:
+            lines.append("{:<{w}}  {:>14,}".format(
+                "Extra vars (e.g. BN stats)", extra, w=width))
+        text = "\n".join(lines)
+        (print_fn or (lambda t: logger.info("%s", t)))(text)
+        return text
+
     @property
     def ema_params(self):
         """The EMA shadow parameters (requires `ema_decay=`)."""

@@ -1473,3 +1473,35 @@ class TestEarlyStoppingRestore:
             np.testing.assert_array_equal(a, b)
         assert any(not np.array_equal(a, b)
                    for a, b in zip(final, last))
+
+
+class TestSummary:
+    def test_summary_counts_params(self):
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=8, num_classes=4))
+        with pytest.raises(RuntimeError, match="not built"):
+            trainer.summary()
+        trainer.build(x)
+        out = []
+        text = trainer.summary(print_fn=out.append)
+        assert out and out[0] == text
+        # MLP(hidden=8, num_classes=4) on 8-dim input:
+        # Dense_0: 8*8+8 = 72; Dense_1: 8*4+4 = 36 -> 108 total.
+        assert "Total params" in text
+        assert "108" in text
+
+    def test_summary_reports_extra_vars(self):
+        from cloud_tpu.models import ResNet
+        from cloud_tpu.models.resnet import BasicBlock
+
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 16, 16, 3)).astype(np.float32)
+        trainer = Trainer(ResNet(stage_sizes=(1,), block=BasicBlock,
+                                 num_filters=8, num_classes=4,
+                                 compute_dtype=jnp.float32),
+                          train_kwargs={"train": True},
+                          eval_kwargs={"train": False}, metrics=())
+        trainer.build(x)
+        text = trainer.summary(print_fn=lambda t: None)
+        assert "Extra vars" in text
