@@ -6,4 +6,4 @@ packaging and by the client telemetry user-agent header
 (cloud_tpu/utils/google_api_client.py).
 """
 
-__version__ = "0.1.0.dev"
+__version__ = "0.3.0.dev"
