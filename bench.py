@@ -72,6 +72,41 @@ RETRY_DELAY_S = float(os.environ.get("BENCH_RETRY_DELAY", 10))
 
 METRIC = "resnet50_train_images_per_sec_per_chip"
 
+# The in-flight probe/worker child and the emitted-record flag, shared
+# with the SIGTERM handler: on early termination the child must die
+# with us (an orphaned worker would keep the shared tunnel busy), and
+# exactly one JSON line may ever be printed.
+_INFLIGHT = None
+_EMITTED = False
+
+
+def _bounded_run(args, timeout):
+    """subprocess.run equivalent that records the child for the SIGTERM
+    handler. Raises subprocess.TimeoutExpired like subprocess.run."""
+    global _INFLIGHT
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=_HERE)
+    _INFLIGHT = proc
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        stdout, stderr = proc.communicate()
+        raise subprocess.TimeoutExpired(args, timeout, output=stdout,
+                                        stderr=stderr)
+    finally:
+        _INFLIGHT = None
+    return subprocess.CompletedProcess(args, proc.returncode, stdout,
+                                       stderr)
+
+
+def _print_record(record):
+    global _EMITTED
+    if _EMITTED:
+        return
+    _EMITTED = True
+    print(json.dumps(record), flush=True)
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 LAST_GREEN_PATH = os.environ.get(
     "BENCH_LAST_GREEN", os.path.join(_HERE, "benchmarks",
@@ -105,9 +140,7 @@ def _probe_backend(timeout=None):
             "x = jax.jit(lambda v: v + 1)(1.0); x.block_until_ready(); "
             "print('PROBE_OK', jax.default_backend(), len(jax.devices()))")
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout, cwd=_HERE)
+        proc = _bounded_run([sys.executable, "-c", code], timeout)
     except subprocess.TimeoutExpired:
         return False, "backend probe hung past {:.0f}s".format(timeout)
     except OSError as e:
@@ -137,9 +170,9 @@ def _run_worker(timeout=None):
         return None
 
     try:
-        proc = subprocess.run(
+        proc = _bounded_run(
             [sys.executable, os.path.abspath(__file__), "--worker"],
-            capture_output=True, text=True, timeout=timeout, cwd=_HERE)
+            timeout)
     except subprocess.TimeoutExpired as e:
         # The worker prints the throughput record BEFORE the kernel
         # smoke: a smoke that hangs on the tunnel must not discard a
@@ -194,6 +227,26 @@ def _load_last_green():
     return record
 
 
+def _emit_fallback(last_err, extra=None):
+    """The never-empty exit: cached green (marked stale) or error JSON."""
+    cached = _load_last_green()
+    if cached is not None:
+        stale = dict(cached)
+        stale["stale"] = True
+        stale["stale_reason"] = last_err
+        _print_record(stale)
+        return
+    record = {
+        "metric": _metric_name(),
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+        "error": last_err,
+    }
+    record.update(extra or {})
+    _print_record(record)
+
+
 def main():
     start = time.monotonic()
 
@@ -203,6 +256,31 @@ def main():
     last_err = "no attempts made"
     probes = 0
     measurements = 0
+
+    # A driver whose outer `timeout` is SHORTER than BENCH_DEADLINE
+    # sends SIGTERM before the loop's own fallback would print — the
+    # one path that could leave the record empty. Catch it, emit the
+    # fallback JSON, exit clean.
+    import signal
+
+    def _terminated(signum, frame):
+        del signum, frame
+        child = _INFLIGHT
+        if child is not None:
+            try:
+                child.kill()
+            except OSError:
+                pass
+        if not _EMITTED:
+            _emit_fallback(
+                last_err + " (terminated by outer timeout at "
+                "t+{:.0f}s)".format(time.monotonic() - start))
+        os._exit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _terminated)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
     while True:
         if measurements >= MAX_MEASUREMENTS:
             # No further measurement can ever launch; don't burn the
@@ -244,7 +322,7 @@ def main():
             # forced-CPU CI run must not shadow the last green TPU run.
             if record.get("platform") == "tpu" and parity_ok:
                 _save_last_green(record)
-            print(json.dumps(record))
+            _print_record(record)
             return
         last_err = err
         print("# measurement attempt {} failed: {}".format(
@@ -253,22 +331,8 @@ def main():
         # before re-probing so a deterministically-failing worker can't
         # spin the whole window.
         time.sleep(min(RETRY_DELAY_S, max(remaining() - 10, 0)))
-    cached = _load_last_green()
-    if cached is not None:
-        stale = dict(cached)
-        stale["stale"] = True
-        stale["stale_reason"] = last_err
-        print(json.dumps(stale))
-        return
-    print(json.dumps({
-        "metric": _metric_name(),
-        "value": 0.0,
-        "unit": "images/sec",
-        "vs_baseline": 0.0,
-        "error": last_err,
-        "probes": probes,
-        "measurement_attempts": measurements,
-    }))
+    _emit_fallback(last_err, extra={
+        "probes": probes, "measurement_attempts": measurements})
 
 
 def _kernel_parity_smoke(jax):

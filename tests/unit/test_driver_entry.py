@@ -132,6 +132,12 @@ class ProbeBoundedTest(unittest.TestCase):
 class BenchJsonContractTest(unittest.TestCase):
     """bench.py must print exactly one JSON line, success or failure."""
 
+    def _extract_single_json(self, stdout, context=""):
+        json_lines = [ln for ln in stdout.splitlines()
+                      if ln.strip().startswith("{")]
+        self.assertEqual(len(json_lines), 1, stdout + context)
+        return json.loads(json_lines[0])
+
     def _run_bench(self, env_overrides):
         env = dict(os.environ)
         env.update(env_overrides)
@@ -139,10 +145,7 @@ class BenchJsonContractTest(unittest.TestCase):
             [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
             capture_output=True, text=True, timeout=120, env=env,
             cwd=REPO_ROOT)
-        json_lines = [ln for ln in proc.stdout.splitlines()
-                      if ln.strip().startswith("{")]
-        self.assertEqual(len(json_lines), 1, proc.stdout + proc.stderr)
-        return json.loads(json_lines[0])
+        return self._extract_single_json(proc.stdout, proc.stderr)
 
     def test_unreachable_backend_emits_error_json(self):
         # A probe that can never finish in 0.2s + a 3s overall budget:
@@ -180,6 +183,36 @@ class BenchJsonContractTest(unittest.TestCase):
         self.assertEqual(record["value"], 1234.5)
         self.assertTrue(record["stale"])
         self.assertIn("stale_reason", record)
+
+    def test_outer_timeout_sigterm_still_emits_json(self):
+        # A driver whose outer timeout is shorter than BENCH_DEADLINE
+        # SIGTERMs the process; the harness must still print its
+        # fallback JSON (and kill any in-flight child) before dying.
+        import signal
+        import time as time_mod
+
+        env = dict(os.environ)
+        env.update({
+            "BENCH_PROBE_TIMEOUT": "60",  # probe outlives the TERM
+            "BENCH_DEADLINE": "120",
+            "BENCH_LAST_GREEN": os.path.join(
+                tempfile.mkdtemp(), "absent.json"),
+            "JAX_PLATFORMS": "bogus",
+        })
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO_ROOT)
+        try:
+            time_mod.sleep(5)  # inside the first (hung) probe
+            proc.send_signal(signal.SIGTERM)
+            stdout, stderr = proc.communicate(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        record = self._extract_single_json(stdout, stderr)
+        self.assertEqual(record["value"], 0.0)
+        self.assertIn("terminated by outer timeout", record["error"])
 
 
 if __name__ == "__main__":
