@@ -184,6 +184,11 @@ def _run_worker(timeout=None):
         if record is not None:
             record.setdefault("kernel_parity",
                               "timeout past {:.0f}s".format(timeout))
+            # The measurement (and possibly the smoke) completed, but
+            # the process had to be killed: report it, never
+            # green-cache it (same invariant as the rc!=0 path).
+            record["worker_rc"] = "killed after {:.0f}s timeout".format(
+                timeout)
             return record, None
         return None, "measurement hung past {:.0f}s".format(timeout)
     except OSError as e:
@@ -194,10 +199,13 @@ def _run_worker(timeout=None):
             # Throughput line landed but the process then aborted —
             # on TPU that's the Mosaic-compile failure class the
             # kernel smoke exists to surface; don't report it green.
+            # OVERWRITE any kernel_parity the worker printed: even a
+            # passing smoke followed by a teardown crash must not
+            # green-cache a record from a crashed process.
             tail = (proc.stderr or "").strip().splitlines()
-            record.setdefault(
-                "kernel_parity", "crashed rc={}: {}".format(
-                    proc.returncode, tail[-1][:160] if tail else ""))
+            record["kernel_parity"] = "crashed rc={}: {}".format(
+                proc.returncode, tail[-1][:160] if tail else "")
+            record["worker_rc"] = proc.returncode
         return record, None
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()
     return None, "measurement died: {}".format(tail[-1] if tail else
@@ -234,6 +242,17 @@ def _emit_fallback(last_err, extra=None):
         stale = dict(cached)
         stale["stale"] = True
         stale["stale_reason"] = last_err
+        if stale.get("self_reported"):
+            # A hand measurement must fail safe for consumers that read
+            # `value` without checking provenance flags: move the number
+            # to last_green_* keys and zero the headline fields. A
+            # harness-captured green (no self_reported marker) is served
+            # at face value — it was measured by this code.
+            stale["last_green_value"] = stale.get("value", 0.0)
+            stale["last_green_vs_baseline"] = stale.get(
+                "vs_baseline", 0.0)
+            stale["value"] = 0.0
+            stale["vs_baseline"] = 0.0
         _print_record(stale)
         return
     record = {
@@ -319,8 +338,11 @@ def main():
             parity_ok = parity == "ok" or os.environ.get(
                 "BENCH_SKIP_KERNEL_PARITY", "0") == "1"
             # Only a real-TPU number is worth serving stale later; a
-            # forced-CPU CI run must not shadow the last green TPU run.
-            if record.get("platform") == "tpu" and parity_ok:
+            # forced-CPU CI run must not shadow the last green TPU run,
+            # and a record salvaged from a crashed/killed worker
+            # (worker_rc present) must not be replayed as green.
+            if (record.get("platform") == "tpu" and parity_ok
+                    and "worker_rc" not in record):
                 _save_last_green(record)
             _print_record(record)
             return

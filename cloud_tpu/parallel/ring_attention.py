@@ -110,8 +110,14 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
             padding contract of `flash_attention`, sharded with the
             sequence. The mask chunk rotates around the ring alongside
             its k/v chunk. Rows whose keys end up all masked output
-            zeros (flash convention). Any pattern is supported, not
-            just contiguous prefixes.
+            zeros (flash convention): although the finite _NEG_INF
+            makes a fully-masked chunk's softmax a uniform average
+            locally, `_chunk_attention` flags such rows with an lse of
+            −inf, and `_merge` weighs an −inf-lse contribution to
+            exactly zero — so the uniform average never reaches the
+            output (pinned by
+            tests/unit/test_ring_attention.py::test_fully_masked_rows).
+            Any pattern is supported, not just contiguous prefixes.
 
     Returns:
         Local output chunk [B, S_local, H, D], same dtype as q.

@@ -1062,7 +1062,14 @@ class Trainer:
                             # The group log already carries the GROUP
                             # weight sum: append once (duplicating
                             # would double-weight groups vs leftover
-                            # singles in the epoch re-weighting).
+                            # singles in the epoch re-weighting). The
+                            # loss, however, is a plain per-step mean
+                            # (Keras sum-over-batch-size semantics), so
+                            # the entry must record how many steps it
+                            # stands for or a group would count equal
+                            # to one leftover single batch.
+                            logs = dict(logs)
+                            logs["_steps"] = spe
                             step_logs.append(logs)
                         else:
                             # Unweighted epoch mean is a per-step mean:
@@ -1134,13 +1141,20 @@ class Trainer:
             # semantics (plain mean over equal-size batches).
             ws = jnp.stack([l["_batch_weight"] for l in step_logs])
             total_w = jnp.maximum(jnp.sum(ws), 1e-9)
+            # Per-entry step counts: a steps_per_execution group entry
+            # carries the mean over `spe` steps and must weigh `spe`
+            # times a leftover single batch in the per-step loss mean
+            # (mirrors the extend([logs]*spe) semantics of the
+            # unweighted path).
+            ns = jnp.asarray([float(l.get("_steps", 1))
+                              for l in step_logs])
             logs = {}
             for k in step_logs[0]:
-                if k == "_batch_weight":
+                if k in ("_batch_weight", "_steps"):
                     continue
                 vals = jnp.stack([l[k] for l in step_logs])
                 if k == "loss":
-                    logs[k] = float(jnp.mean(vals))
+                    logs[k] = float(jnp.sum(vals * ns) / jnp.sum(ns))
                 else:
                     logs[k] = float(jnp.sum(vals * ws) / total_w)
         elif step_logs:
@@ -1437,17 +1451,28 @@ class Trainer:
             iter(dataset), size=prefetch, feed=self._feed)
         # One-behind gather: batch i's output is pulled to host while
         # batch i+1 computes — transfer overlaps compute without ever
-        # holding more than two batches of outputs in HBM.
+        # holding more than two batches of outputs in HBM. Outputs are
+        # arbitrary pytrees (a tuple/dict-returning model, e.g. MoEMLP's
+        # (out, aux)): transfer and concatenation are per leaf, and the
+        # result keeps the model's output structure.
         outs = []
         pending = None
         predict_state = self._eval_state(use_ema)
         for xb in feeder:
             out = self._jit_predict_step(predict_state, xb)
             if pending is not None:
-                outs.append(np.asarray(pending))
+                outs.append(jax.device_get(pending))
             pending = out
         if pending is not None:
-            outs.append(np.asarray(pending))
-        preds = np.concatenate(outs, axis=0)
+            outs.append(jax.device_get(pending))
         n = jax.tree_util.tree_leaves(x)[0].shape[0]
-        return preds[:n]
+
+        def join(*leaves):
+            # A 0-d leaf (e.g. MoEMLP's scalar aux loss) is per-BATCH,
+            # not per-example: stack into [num_batches] instead of
+            # concatenating along a batch axis it doesn't have.
+            if np.ndim(leaves[0]) == 0:
+                return np.stack(leaves)
+            return np.concatenate(leaves, axis=0)[:n]
+
+        return jax.tree_util.tree_map(join, *outs)

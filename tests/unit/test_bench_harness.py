@@ -1,0 +1,131 @@
+"""Unit tests for bench.py's record-handling logic (no accelerator).
+
+The measurement itself needs hardware; what's pinned here is the
+harness contract around it: stale fallbacks must fail safe for
+consumers that read `value` without checking provenance flags, and a
+crashed worker must never green-cache a "passing" kernel smoke.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import types
+
+import pytest
+
+_BENCH_PATH = os.path.join(os.path.dirname(__file__), "..", "..",
+                           "bench.py")
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    """Fresh bench module per test (module state: _EMITTED, paths)."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.abspath(_BENCH_PATH))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.LAST_GREEN_PATH = str(tmp_path / "last_green.json")
+    return mod
+
+
+def _emitted_record(capsys):
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out, "no JSON line emitted"
+    return json.loads(out[-1])
+
+
+class TestStaleFallback:
+    def test_self_reported_green_zeroed(self, bench, capsys):
+        """A hand-reported cached green is served with value 0.0 and the
+        number moved to last_green_* keys (ADVICE r3: consumers reading
+        `value` must fail safe on non-harness numbers)."""
+        with open(bench.LAST_GREEN_PATH, "w") as f:
+            json.dump({"metric": bench.METRIC, "value": 2452.8,
+                       "unit": "images/sec", "vs_baseline": 7.0,
+                       "self_reported": True,
+                       "source": "hand measurement"}, f)
+        bench._emit_fallback("tunnel down")
+        record = _emitted_record(capsys)
+        assert record["stale"] is True
+        assert record["value"] == 0.0
+        assert record["vs_baseline"] == 0.0
+        assert record["last_green_value"] == 2452.8
+        assert record["last_green_vs_baseline"] == 7.0
+        assert record["self_reported"] is True
+
+    def test_harness_green_served_at_face_value(self, bench, capsys):
+        with open(bench.LAST_GREEN_PATH, "w") as f:
+            json.dump({"metric": bench.METRIC, "value": 3000.0,
+                       "unit": "images/sec", "vs_baseline": 8.57,
+                       "platform": "tpu"}, f)
+        bench._emit_fallback("tunnel down")
+        record = _emitted_record(capsys)
+        assert record["stale"] is True
+        assert record["value"] == 3000.0
+        assert "last_green_value" not in record
+
+    def test_no_cache_emits_error_record(self, bench, capsys):
+        bench._emit_fallback("tunnel down", extra={"probes": 3})
+        record = _emitted_record(capsys)
+        assert record["value"] == 0.0
+        assert record["error"] == "tunnel down"
+        assert record["probes"] == 3
+
+
+class TestCrashedWorker:
+    def test_rc_nonzero_overwrites_kernel_parity(self, bench,
+                                                 monkeypatch):
+        """A worker that prints kernel_parity='ok' then dies non-zero
+        must not be reported (or green-cached) as parity-ok."""
+        record_line = json.dumps({
+            "metric": bench.METRIC, "value": 2000.0, "platform": "tpu",
+            "kernel_parity": "ok"})
+
+        def fake_run(args, timeout):
+            return types.SimpleNamespace(
+                args=args, returncode=134, stdout=record_line + "\n",
+                stderr="Fatal Python error: Aborted\n")
+
+        monkeypatch.setattr(bench, "_bounded_run", fake_run)
+        record, err = bench._run_worker(timeout=5)
+        assert err is None
+        assert record["kernel_parity"].startswith("crashed rc=134")
+        assert record["worker_rc"] == 134
+
+    def test_timeout_marks_salvaged_record(self, bench, monkeypatch):
+        """A record salvaged from a timed-out (killed) worker keeps its
+        measurement and parity string but carries worker_rc, which
+        blocks the green cache — teardown hangs must not produce
+        replayable greens any more than crashes do."""
+        import subprocess
+
+        record_line = json.dumps({
+            "metric": bench.METRIC, "value": 2000.0, "platform": "tpu",
+            "kernel_parity": "ok"})
+
+        def fake_run(args, timeout):
+            raise subprocess.TimeoutExpired(
+                args, timeout, output=record_line + "\n", stderr="")
+
+        monkeypatch.setattr(bench, "_bounded_run", fake_run)
+        record, err = bench._run_worker(timeout=5)
+        assert err is None
+        assert record["kernel_parity"] == "ok"  # the smoke did pass
+        assert record["worker_rc"].startswith("killed after")
+
+    def test_rc_zero_keeps_worker_parity(self, bench, monkeypatch):
+        record_line = json.dumps({
+            "metric": bench.METRIC, "value": 2000.0, "platform": "tpu",
+            "kernel_parity": "ok"})
+
+        def fake_run(args, timeout):
+            return types.SimpleNamespace(
+                args=args, returncode=0, stdout=record_line + "\n",
+                stderr="")
+
+        monkeypatch.setattr(bench, "_bounded_run", fake_run)
+        record, err = bench._run_worker(timeout=5)
+        assert err is None
+        assert record["kernel_parity"] == "ok"
+        assert "worker_rc" not in record

@@ -249,3 +249,43 @@ class TestRingPaddingMask:
             sequence_parallel_attention(
                 q, k, v, mesh=sp_mesh,
                 mask=jnp.ones((2, 16), dtype=bool))
+
+    def test_fully_masked_rows(self, sp_mesh):
+        """Pins the fully-masked-row contract (advisor r3): rows whose
+        keys are ALL masked output exactly zero — the flash convention,
+        NOT the oracle's uniform V-average. The finite _NEG_INF makes
+        each chunk's softmax locally uniform, but the −inf lse sentinel
+        zeroes that contribution in the merge; this asserts the zeros
+        actually survive to the output, and that grads stay finite (and
+        zero) through such rows."""
+        q, k, v = _rand_qkv()
+        mask_np = np.ones((2, 32), bool)
+        mask_np[1, :] = False          # example 1: every key masked
+        mask = jnp.asarray(mask_np)
+
+        out = sequence_parallel_attention(q, k, v, mesh=sp_mesh,
+                                          causal=False, mask=mask)
+        np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
+        # Unmasked example still matches the oracle.
+        expected = mha_reference(q, k, v, causal=False, mask=mask)
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   np.asarray(expected[0]),
+                                   atol=2e-5, rtol=2e-5)
+
+        # Causal corner: masking key 0 fully masks row 0 (it can only
+        # see key 0) while later rows keep valid keys.
+        mask2_np = np.ones((2, 32), bool)
+        mask2_np[0, 0] = False
+        mask2 = jnp.asarray(mask2_np)
+        out2 = sequence_parallel_attention(q, k, v, mesh=sp_mesh,
+                                           causal=True, mask=mask2)
+        np.testing.assert_array_equal(np.asarray(out2[0, 0]), 0.0)
+
+        grads = jax.grad(
+            lambda q, k, v: sequence_parallel_attention(
+                q, k, v, mesh=sp_mesh, causal=False,
+                mask=mask).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for g in grads:
+            assert bool(jnp.all(jnp.isfinite(g)))
+        np.testing.assert_array_equal(np.asarray(grads[0][1]), 0.0)

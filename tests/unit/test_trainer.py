@@ -316,6 +316,45 @@ class TestReviewRegressions:
         preds = trainer.predict(x[:5], batch_size=64)
         assert preds.shape == (5, 4)
 
+    def test_predict_pytree_outputs(self):
+        """A tuple/dict-returning model (e.g. MoE's (out, aux)) must
+        round-trip through predict() with its structure intact and
+        every leaf concatenated/truncated per batch dim (VERDICT r3
+        weak #5: np.asarray over a tuple crashed or mis-stacked)."""
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        class TupleOut(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                h = nn.Dense(4)(x)
+                # `aux` is a 0-d per-batch scalar, the MoEMLP
+                # (out, aux_loss) shape: predict must stack it
+                # per batch, not concatenate per example.
+                return {"logits": h, "pooled": jnp.mean(h, axis=-1),
+                        "aux": jnp.mean(h)}
+
+        x, y = _toy_classification(n=80)
+
+        def loss_fn(outputs, yb):
+            logits = outputs["logits"]
+            one_hot = jax.nn.one_hot(yb, logits.shape[-1])
+            return -jnp.mean(
+                jnp.sum(jax.nn.log_softmax(logits) * one_hot, -1))
+
+        trainer = Trainer(TupleOut(), loss=loss_fn, metrics=())
+        trainer.fit(x, y, epochs=1, batch_size=32, verbose=False)
+        # 80 rows / batch 32 -> 3 batches with a ragged 16-row tail:
+        # leaves must concatenate across batches and truncate to n.
+        preds = trainer.predict(x, batch_size=32)
+        assert set(preds) == {"logits", "pooled", "aux"}
+        assert preds["logits"].shape == (80, 4)
+        assert preds["pooled"].shape == (80,)
+        assert preds["aux"].shape == (3,)  # one scalar per batch
+        np.testing.assert_allclose(
+            preds["pooled"], preds["logits"].mean(-1), rtol=1e-5)
+
     def test_dict_pytree_input(self):
         rng = np.random.default_rng(0)
         x = {"a": rng.normal(size=(64, 4)).astype(np.float32),
@@ -1318,7 +1357,7 @@ class TestStepsPerExecution:
         trainer = Trainer(MLP(hidden=16, num_classes=4,
                               compute_dtype=jnp.float32),
                           optimizer=optax.sgd(0.0),  # frozen
-                          steps_per_execution=2)
+                          steps_per_execution=2, seed=0)
         history = trainer.fit(x, y, epochs=1, batch_size=32,
                               shuffle=False, sample_weight=w,
                               verbose=False)
@@ -1326,6 +1365,17 @@ class TestStepsPerExecution:
                                 verbose=False)
         assert history["accuracy"][0] == pytest.approx(
             logs["accuracy"], rel=1e-4)
+        # The epoch LOSS is a per-step mean: the spe=2 group entry must
+        # count as two steps against the leftover single batch, so the
+        # grouped run must match an identical spe=1 run exactly (same
+        # frozen params, same batches).
+        single = Trainer(MLP(hidden=16, num_classes=4,
+                             compute_dtype=jnp.float32),
+                         optimizer=optax.sgd(0.0), seed=0)
+        h1 = single.fit(x, y, epochs=1, batch_size=32, shuffle=False,
+                        sample_weight=w, verbose=False)
+        assert history["loss"][0] == pytest.approx(h1["loss"][0],
+                                                   rel=1e-5)
 
     def test_ragged_tail_inside_group_runs_singly(self):
         """A custom iterable yielding batches 32,32,32,16 with spe=2:
