@@ -764,6 +764,12 @@ class Trainer:
                     # only. evaluate() raises otherwise.
                     scalar_unmasked.add(name)
                     logs[name] = v
+            # The batch's TOTAL aggregation weight (valid rows x any
+            # sample weights), summed over the GLOBAL mask: on pods the
+            # host only holds a local shard, so this in-graph sum is
+            # the one place the global batch weight exists. evaluate()
+            # pops it before reporting.
+            logs["_batch_weight"] = jnp.sum(mask)
             return logs
 
         if self._mesh is None:
@@ -936,13 +942,6 @@ class Trainer:
                 "sample_weight= needs raw array inputs; pre-built "
                 "datasets carry their own weights via "
                 "ArrayDataset(sample_weight=...).")
-        if (validation_data is not None and len(validation_data) == 3
-                and jax.process_count() > 1):
-            # evaluate() would reject this at the END of epoch 1 —
-            # hours into a real pod run. Fail before training starts.
-            raise NotImplementedError(
-                "Weighted validation_data=(x, y, w) is single-process "
-                "for now; drop the weights or evaluate separately.")
         ds_kwargs = {}
         if sample_weight is not None:
             ds_kwargs["sample_weight"] = sample_weight
@@ -1299,7 +1298,8 @@ class Trainer:
         `sample_weight`: optional [num_examples] per-example weights;
         every reported value becomes the weighted mean
         sum(v_i * w_i) / sum(w_i) over the dataset (weights compose
-        with the tail-padding mask). Array inputs, single process.
+        with the tail-padding mask). Array inputs; works multi-process
+        (the per-batch weight is summed in-graph over the global mask).
         """
         if self.state is None:
             raise RuntimeError("Model is not built; call fit() first or "
@@ -1324,11 +1324,6 @@ class Trainer:
                 "in ArrayDataset(sample_weight=...) instead).")
         weighted_eval = (isinstance(dataset, data_lib.ArrayDataset)
                          and dataset.sample_weight is not None)
-        if weighted_eval and jax.process_count() > 1:
-            raise NotImplementedError(
-                "Weighted evaluate is single-process for now (the "
-                "global batch weight is not derivable from a local "
-                "shard).")
         if steps is None:
             steps = getattr(dataset, "steps_per_epoch", None)
         num_examples = getattr(dataset, "num_examples", None)
@@ -1383,7 +1378,15 @@ class Trainer:
         eval_state = self._eval_state(use_ema)
         totals, weight = {}, 0.0
         for agg, padded, fed in feeder:
-            logs = self._jit_eval_step(eval_state, fed)
+            logs = dict(self._jit_eval_step(eval_state, fed))
+            batch_w = logs.pop("_batch_weight")
+            if weighted_eval:
+                # The host-side `agg` summed only this process's local
+                # mask shard; the in-graph sum covers the GLOBAL mask,
+                # making weighted evaluate exact on pods (round-3 gap:
+                # this path used to raise NotImplementedError under
+                # process_count > 1). Stays a device scalar — no sync.
+                agg = batch_w
             # Padding only ever happens on the ArrayDataset path
             # (num_examples known, tail wrapped); datasets that just
             # yield a short final batch (e.g. shard tails) are short,
@@ -1408,6 +1411,9 @@ class Trainer:
                 # tunnel round-trip per eval batch otherwise); the
                 # float() conversion below is the only barrier.
                 totals[k] = totals.get(k, 0.0) + v * agg
+        # One host sync for the whole evaluation (weighted runs carry
+        # the accumulated weight as a device scalar until here).
+        weight = float(weight)
         if weight == 0.0:
             if weighted_eval:
                 raise ValueError(

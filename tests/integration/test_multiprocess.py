@@ -110,6 +110,40 @@ def test_two_process_pod_matches_single_process():
     np.testing.assert_allclose(outs[0]["spe_loss"], outs[1]["spe_loss"],
                                rtol=1e-6)
 
+    # Weighted (x, y, w) validation + weighted evaluate on the pod:
+    # the in-graph global batch-weight sum must reproduce the
+    # single-process values (VERDICT r3 #4). Same model/data/weights
+    # single-process, with a padded validation tail (90/32).
+    runtime.reset()
+    runtime.initialize(strategy="tpu_slice")
+    try:
+        sw = np.linspace(0.2, 2.0, 128).astype(np.float32)
+        val_n = 90
+        wv_trainer = Trainer(MLP(hidden=16, num_classes=4,
+                                 compute_dtype=jnp.float32),
+                             optimizer=optax.sgd(0.1))
+        wv_history = wv_trainer.fit(
+            x, y, epochs=2, batch_size=32, shuffle=False, verbose=False,
+            sample_weight=sw,
+            validation_data=(x[:val_n], y[:val_n], sw[:val_n]))
+        weighted_eval = wv_trainer.evaluate(
+            x, y, batch_size=32, sample_weight=sw, verbose=False)
+    finally:
+        runtime.reset()
+
+    for rec in outs:
+        np.testing.assert_allclose(rec["wv_loss"], wv_history["loss"],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(rec["wv_val_loss"],
+                                   wv_history["val_loss"], rtol=1e-5)
+        np.testing.assert_allclose(rec["wv_val_accuracy"],
+                                   wv_history["val_accuracy"],
+                                   rtol=1e-5)
+        assert rec["weighted_eval_loss"] == pytest.approx(
+            weighted_eval["loss"], rel=1e-5)
+        assert rec["weighted_eval_accuracy"] == pytest.approx(
+            weighted_eval["accuracy"], rel=1e-5)
+
 
 @pytest.mark.parametrize("bad_id", [0])
 def test_worker_requires_peer(bad_id):

@@ -635,3 +635,143 @@ class TestResultsSummary:
         assert "Results summary" in text
         assert "accuracy" in text
         assert "units" in text
+
+
+# ---------------------------------------------------------------------
+# Offline (pinned) Vizier surface: every REST call the client can make
+# must exist in the bundled discovery document (VERDICT r3 #7 — the
+# fallback guarantee in build_service_client silently rots otherwise;
+# reference bar: the full bundled doc, tuner/constants.py:20-22).
+# ---------------------------------------------------------------------
+
+class _RecordingService:
+    """Chainable googleapiclient-shaped fake that records every
+    (resource_path, method) pair the client traverses."""
+
+    _RESOURCE_NAMES = frozenset(
+        {"projects", "locations", "studies", "trials", "operations"})
+
+    # Canned responses so the client's control flow actually runs all
+    # the way through LRO polling / early-stop / completion branches.
+    _RESPONSES = {
+        "suggest": {"name": "projects/p/locations/r/operations/op1"},
+        "checkEarlyStoppingState": {
+            "name": "projects/p/locations/r/operations/op2"},
+        # One op response serves both LRO consumers: `trials` for
+        # get_suggestions, `shouldStop` True so should_trial_stop
+        # proceeds to call trials.stop as well.
+        "get": {"done": True,
+                "response": {"trials": [], "shouldStop": True}},
+        "list": {"trials": [], "studies": []},
+    }
+
+    def __init__(self, calls, path=()):
+        self._calls = calls
+        self._path = path
+
+    def __getattr__(self, name):
+        def chain(**kwargs):
+            if name in self._RESOURCE_NAMES:
+                return _RecordingService(self._calls,
+                                         self._path + (name,))
+            self._calls.add((self._path, name))
+            response = dict(self._RESPONSES.get(name, {}))
+            request = mock.MagicMock()
+            request.execute.return_value = response
+            return request
+        return chain
+
+
+def _pinned_doc_methods():
+    import json
+
+    with open(optimizer_client.PINNED_DISCOVERY_PATH) as f:
+        doc = json.load(f)
+    methods = {}
+
+    def walk(resources, path):
+        for rname, resource in resources.items():
+            for mname, m in resource.get("methods", {}).items():
+                methods[(path + (rname,), mname)] = m
+            walk(resource.get("resources", {}), path + (rname,))
+
+    walk(doc["resources"], ())
+    return doc, methods
+
+
+class TestPinnedDiscoverySurface:
+    def _exercise_client(self):
+        """Runs EVERY public OptimizerClient entry point against the
+        recording service; returns the set of REST calls made."""
+        calls = set()
+        service = _RecordingService(calls)
+        # create path (studies.create) and load path (studies.get).
+        client = optimizer_client.create_or_load_study(
+            "proj", "region", "study", study_config={"metrics": []},
+            service_client=service)
+        optimizer_client.create_or_load_study(
+            "proj", "region", "study", study_config=None,
+            service_client=service)
+        exercised = {"get_suggestions", "report_intermediate_objective_value",
+                     "should_trial_stop", "complete_trial", "get_trial",
+                     "list_trials", "list_studies", "delete_study"}
+        client.get_suggestions("client0")
+        client.report_intermediate_objective_value(
+            1, 2.0, [{"metric": "accuracy", "value": 0.5}], "1")
+        assert client.should_trial_stop("1") is True  # exercises stop too
+        client.complete_trial("1")
+        client.get_trial("1")
+        client.list_trials()
+        client.list_studies()
+        client.delete_study()
+        # Reflection guard: a NEW public method must be added here (and
+        # thereby have its REST calls checked) before it can ship.
+        public = {name for name in dir(optimizer_client.OptimizerClient)
+                  if not name.startswith("_")
+                  and callable(getattr(optimizer_client.OptimizerClient,
+                                       name))}
+        assert public == exercised, (
+            "public OptimizerClient methods changed; exercise the new "
+            "method(s) in this test: {}".format(
+                sorted(public.symmetric_difference(exercised))))
+        return calls
+
+    def test_every_client_call_is_in_pinned_doc(self):
+        calls = self._exercise_client()
+        _, doc_methods = _pinned_doc_methods()
+        missing = {c for c in calls if c not in doc_methods}
+        assert not missing, (
+            "OptimizerClient calls missing from the pinned discovery "
+            "doc (offline fallback would break): {}".format(
+                sorted(missing)))
+        # Sanity: the recorder actually saw the full expected surface.
+        assert (("projects", "locations", "studies", "trials"),
+                "suggest") in calls
+        assert (("projects", "locations", "operations"), "get") in calls
+        assert (("projects", "locations", "studies", "trials"),
+                "stop") in calls
+
+    def test_pinned_doc_is_structurally_sound(self):
+        doc, methods = _pinned_doc_methods()
+        assert methods, "pinned doc defines no methods"
+        for (path, name), m in methods.items():
+            ident = "ml." + ".".join(path) + "." + name
+            assert m.get("id") == ident, m.get("id")
+            assert m.get("httpMethod") in {"GET", "POST", "DELETE",
+                                           "PATCH", "PUT"}
+            # Every {+param} template var must be declared as a
+            # required path parameter (googleapiclient build_from_
+            # document fails on undeclared template vars).
+            import re
+            for var in re.findall(r"{\+(\w+)}", m.get("path", "")):
+                param = m.get("parameters", {}).get(var)
+                assert param and param.get("location") == "path", (
+                    ident, var)
+        for ref in ("JsonBody", "JsonResponse"):
+            assert ref in doc["schemas"]
+
+    def test_load_pinned_doc_patches_endpoint(self):
+        doc = optimizer_client.load_pinned_discovery_doc(
+            "https://us-central1-ml.googleapis.com")
+        assert doc["rootUrl"] == "https://us-central1-ml.googleapis.com/"
+        assert doc["baseUrl"] == doc["rootUrl"]
