@@ -115,11 +115,14 @@ COMPILE_CACHE_DIR = os.path.join(_HERE, "benchmarks", ".jax_cache")
 
 
 def _metric_name():
-    # The s2d stem is an architecture variant: suffix it so recorded
-    # numbers (including failed runs) stay apples-to-apples per series.
+    # Architecture/feeding variants are suffixed so recorded numbers
+    # (including failed runs) stay apples-to-apples per series.
+    name = METRIC
     if os.environ.get("BENCH_S2D", "0") == "1":
-        return METRIC + "_s2d"
-    return METRIC
+        name += "_s2d"
+    if os.environ.get("BENCH_BF16_INPUT", "0") == "1":
+        name += "_bf16in"
+    return name
 
 
 def _probe_backend(timeout=None):
@@ -437,6 +440,16 @@ def worker():
     rng = np.random.default_rng(0)
     x = rng.normal(size=(BATCH, IMAGE, IMAGE, 3)).astype(np.float32)
     y = rng.integers(0, 1000, size=BATCH).astype(np.int32)
+    bf16_input = os.environ.get("BENCH_BF16_INPUT", "0") == "1"
+    if bf16_input:
+        # Feed bf16. In THIS bench the batch is device-resident and
+        # reused every step, so steady-state H2D is zero either way —
+        # the measured effect is the stem's input HBM read width (the
+        # model casts to compute dtype at the stem regardless,
+        # cloud_tpu/models/resnet.py). A real input pipeline feeding
+        # fresh batches additionally halves its per-step H2D bytes.
+        import ml_dtypes
+        x = x.astype(ml_dtypes.bfloat16)
 
     s2d = os.environ.get("BENCH_S2D", "0") == "1"
     trainer = Trainer(
@@ -520,6 +533,8 @@ def worker():
         record["steps_per_execution"] = spe
     if s2d:
         record["stem"] = "space_to_depth"
+    if bf16_input:
+        record["input_dtype"] = "bfloat16"
     if os.environ.get("BENCH_SKIP_KERNEL_PARITY", "0") != "1":
         # Emit the throughput record FIRST: if the kernel smoke hangs
         # the tunnel, the parent salvages this line from the killed

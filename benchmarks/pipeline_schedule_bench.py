@@ -56,7 +56,6 @@ def measure(pp_stages, num_micro, run_steps, batch, seq, d_model,
     import optax
 
     from cloud_tpu.models import PipelinedLM, pipelined_lm_rules
-    from cloud_tpu.parallel import runtime
     from cloud_tpu.training import Trainer
 
     model = PipelinedLM(vocab_size=vocab, d_model=d_model,
@@ -78,10 +77,11 @@ def measure(pp_stages, num_micro, run_steps, batch, seq, d_model,
 
     # XLA's compiled-buffer analysis: peak = what the allocator actually
     # reserves beyond the live arguments/outputs (the temp term is where
-    # schedule-dependent activation liveness lands).
-    lowered = jax.jit(step.__wrapped__ if hasattr(step, "__wrapped__")
-                      else step).lower(trainer.state, batch_fed)
-    compiled = lowered.compile()
+    # schedule-dependent activation liveness lands). Lower the jitted
+    # step DIRECTLY so donation/shardings are the production ones — a
+    # re-jit of the raw body would drop donate_argnums and measure a
+    # different executable than the one timed below.
+    compiled = step.lower(trainer.state, batch_fed).compile()
     mem = compiled.memory_analysis()
     record = {
         "schedule": "gpipe_remat",
@@ -149,6 +149,7 @@ def main():
     # removing the batch-proportional outputs/carry term (batch is
     # constant across M here, so any steep growth IS schedule overhead).
     if len(records) >= 2:
+        records = sorted(records, key=lambda r: r["num_microbatches"])
         lo, hi = records[0], records[-1]
         growth = (hi["temp_mb"] / lo["temp_mb"]
                   if lo["temp_mb"] else float("inf"))

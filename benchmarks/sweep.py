@@ -8,50 +8,42 @@ the sweep ends with a summary line naming the best config and how to
 pin it (BENCH_BATCH / BENCH_S2D / BENCH_SPE env for bench.py).
 
 Usage: python benchmarks/sweep.py [--batches 128,256,512] [--s2d 0,1]
-       [--spe 1,5]
+       [--spe 1,5] [--bf16-input 0,1]
 """
 
 import argparse
 import json
 import os
-import subprocess
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(_REPO_ROOT, "bench.py")
 
+from _subproc import run_json_point
 
-def run_point(batch, s2d, spe, timeout):
+
+def run_point(batch, s2d, spe, timeout, bf16_input=0):
     env = dict(
         os.environ,
         BENCH_BATCH=str(batch),
         BENCH_S2D=str(s2d),
         BENCH_SPE=str(spe),
+        BENCH_BF16_INPUT=str(bf16_input),
         # The parity smoke belongs to the flagship bench.py run, not to
         # every sweep point (~30s apiece); the worker's persistent
         # compilation cache (benchmarks/.jax_cache) still makes repeat
         # points cheap.
         BENCH_SKIP_KERNEL_PARITY="1",
     )
-    try:
-        proc = subprocess.run(
-            [sys.executable, BENCH, "--worker"], capture_output=True,
-            text=True, timeout=timeout, env=env, cwd=_REPO_ROOT)
-    except subprocess.TimeoutExpired:
-        return {"batch": batch, "s2d": s2d, "spe": spe,
-                "error": "hung past {:.0f}s".format(timeout)}
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                record = json.loads(line)
-                record.update({"batch": batch, "s2d": s2d, "spe": spe})
-                return record
-            except ValueError:
-                break
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-    return {"batch": batch, "s2d": s2d, "spe": spe,
-            "error": tail[-1] if tail else "rc={}".format(proc.returncode)}
+    point = {"batch": batch, "s2d": s2d, "spe": spe}
+    record, err = run_json_point(
+        [sys.executable, BENCH, "--worker"], timeout, _REPO_ROOT,
+        env=env, error_extra=point)
+    if record is None:
+        return err
+    record.update(point)
+    return record
 
 
 def main(argv=None):
@@ -62,18 +54,26 @@ def main(argv=None):
     # per-dispatch overhead is ~66ms (PERF.md), so spe=5 separates chip
     # throughput from dispatch; both points recorded for the contrast.
     parser.add_argument("--spe", default="1,5")
+    # bf16 input feeding: shrinks the stem's input HBM reads here
+    # (the resident batch is never re-uploaded; real pipelines also
+    # halve per-step H2D). Default sweeps both to record the delta.
+    parser.add_argument("--bf16-input", default="0,1")
     parser.add_argument("--timeout", type=float, default=480.0)
     args = parser.parse_args(argv)
 
     best = None
-    for spe in [int(v) for v in args.spe.split(",")]:
-        for s2d in [int(v) for v in args.s2d.split(",")]:
-            for batch in [int(v) for v in args.batches.split(",")]:
-                record = run_point(batch, s2d, spe, args.timeout)
-                print(json.dumps(record), flush=True)
-                if "error" not in record and (
-                        best is None or record["value"] > best["value"]):
-                    best = record
+    for bf16 in [int(v) for v in args.bf16_input.split(",")]:
+        for spe in [int(v) for v in args.spe.split(",")]:
+            for s2d in [int(v) for v in args.s2d.split(",")]:
+                for batch in [int(v) for v in args.batches.split(",")]:
+                    record = run_point(batch, s2d, spe, args.timeout,
+                                       bf16_input=bf16)
+                    record.setdefault("bf16_input", bf16)
+                    print(json.dumps(record), flush=True)
+                    if "error" not in record and (
+                            best is None
+                            or record["value"] > best["value"]):
+                        best = record
     if best is None:
         print(json.dumps({"sweep": "failed",
                           "hint": "backend unreachable for every point"}))
@@ -83,7 +83,8 @@ def main(argv=None):
         "value": best["value"],
         "unit": best.get("unit", "images/sec"),
         "pin": {"BENCH_BATCH": best["batch"], "BENCH_S2D": best["s2d"],
-                "BENCH_SPE": best["spe"]},
+                "BENCH_SPE": best["spe"],
+                "BENCH_BF16_INPUT": best.get("bf16_input", 0)},
     }))
     return 0
 

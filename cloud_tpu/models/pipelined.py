@@ -32,7 +32,7 @@ Design (the shard_map pipelining pattern, scaling-playbook shape):
   transpose the scan. Raise `num_microbatches` to shrink the bubble.
   MEASURED (round 4, benchmarks/pipeline_schedule_bench.py, XLA
   compiled-buffer analysis at pp=4, batch 16): peak temp memory FALLS
-  as M rises — 139.9 MB (M=4) -> 82.1 (M=8) -> 54.0 (M=16) — because
+  as M rises — 146.7 MB (M=4) -> 89.4 (M=8) -> 61.0 (M=16) — because
   live activations scale with the microbatch SIZE (batch/M), the same
   direction 1F1B optimizes; step time also falls (smaller bubble).
   1F1B would add schedule complexity for memory behavior the remat'd
