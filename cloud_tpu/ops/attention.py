@@ -29,6 +29,7 @@ the same code path is exercised everywhere.
 
 import functools
 import math
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -475,7 +476,7 @@ _flash_attention_masked.defvjp(_flash_attention_masked_fwd,
 
 
 def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
-                    block_q=128, block_k=128,
+                    block_q=None, block_k=None,
                     interpret: Optional[bool] = None):
     """Blockwise flash attention, layout [batch, seq, heads, head_dim].
 
@@ -495,7 +496,11 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
             just contiguous prefixes. Rows whose keys are ALL masked
             output zeros (the reference would return a uniform average).
         block_q / block_k: Kernel tile sizes along the sequence. S is
-            padded up to a multiple internally.
+            padded up to a multiple internally. Default (None) is 128,
+            overridable process-wide via CLOUD_TPU_FLASH_BLOCK_Q /
+            CLOUD_TPU_FLASH_BLOCK_K — the deployment hook for a
+            `benchmarks/flash_autotune.py` pin, so a measured best
+            config applies without touching call sites.
         interpret: Force Pallas interpret mode. Default: interpret
             everywhere except on real TPU backends.
 
@@ -515,6 +520,10 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
         sm_scale = 1.0 / math.sqrt(head_dim)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None:
+        block_q = int(os.environ.get("CLOUD_TPU_FLASH_BLOCK_Q", 128))
+    if block_k is None:
+        block_k = int(os.environ.get("CLOUD_TPU_FLASH_BLOCK_K", 128))
 
     block = max(block_q, block_k)
     if block_q % min(block_q, block_k) or block_k % min(block_q, block_k):

@@ -275,3 +275,27 @@ def test_gqa_shape_validation():
     # mismatched v used to die later as an opaque einsum shape error).
     with pytest.raises(ValueError, match="identical"):
         mha_reference(q, k, v[:, :, :1])
+
+
+def test_block_size_env_override(monkeypatch):
+    """CLOUD_TPU_FLASH_BLOCK_Q/K set the default tile sizes (the
+    deployment hook for a flash_autotune pin) without changing
+    numerics; explicit args still win."""
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 512, 2, 64)),
+                           jnp.float32) for _ in range(3))
+    ref = mha_reference(q, k, v, causal=True)
+    monkeypatch.setenv("CLOUD_TPU_FLASH_BLOCK_Q", "256")
+    monkeypatch.setenv("CLOUD_TPU_FLASH_BLOCK_K", "128")
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # Explicit argument beats the env default.
+    out2 = flash_attention(q, k, v, causal=True, interpret=True,
+                           block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # A bad env pin fails loudly, not silently.
+    monkeypatch.setenv("CLOUD_TPU_FLASH_BLOCK_Q", "192")
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, k, v, causal=True, interpret=True)
