@@ -9,9 +9,20 @@ over an explicit JSONL channel (SURVEY §7.4 item 6), which
 `DistributingCloudTuner` reads back without event-file parsing.
 """
 
+import functools
 import json
 
 import jax
+import jax.numpy as jnp
+
+# Sharding-preserving device copy of a pytree. Runs under jit so it
+# stays a device-side buffer copy — host-side jnp.array(copy=True)
+# would try to materialize the value locally, which fails for
+# multi-host arrays with non-addressable shards (FSDP/ZeRO-sharded
+# params on pods). ONE module-level jit wrapper so the compiled copy is
+# cached per tree structure/shape, not recompiled per snapshot.
+_device_copy = jax.jit(
+    functools.partial(jax.tree_util.tree_map, jnp.copy))
 
 
 class Callback:
@@ -110,16 +121,12 @@ class EarlyStopping(Callback):
         self._best_state = None
 
     def _snapshot_state(self):
-        import jax.numpy as jnp
-
         # A REAL copy: the live buffers are donated to the next step.
         # Params AND extra_vars (BatchNorm statistics etc.) — restoring
         # best weights against last-epoch BN stats would pair tensors
         # from different models.
-        copy = lambda tree: jax.tree_util.tree_map(
-            lambda p: jnp.array(p, copy=True), tree)
-        self._best_state = (copy(self.trainer.state.params),
-                            copy(self.trainer.state.extra_vars))
+        self._best_state = (_device_copy(self.trainer.state.params),
+                            _device_copy(self.trainer.state.extra_vars))
 
     def on_epoch_end(self, epoch, logs):
         value = logs.get(self.monitor)

@@ -78,6 +78,25 @@ def main():
     weighted_eval = wv_trainer.evaluate(x, y, batch_size=32,
                                         sample_weight=sw, verbose=False)
 
+    # EarlyStopping restore_best_weights on the pod with FSDP-sharded
+    # params: each process holds only its own shards, so the best-epoch
+    # snapshot MUST be a sharding-preserving device copy — a host-side
+    # materializing copy fails on the non-addressable shards this
+    # config creates (the exact regression the jitted _device_copy in
+    # callbacks.py guards against). Frozen optimizer (lr=0.0) makes
+    # every epoch identical, so restore is a no-op on VALUES while
+    # still exercising the snapshot/restore machinery.
+    from cloud_tpu.training import EarlyStopping
+    es_trainer = Trainer(MLP(hidden=16, num_classes=4,
+                             compute_dtype=jnp.float32),
+                         optimizer=optax.sgd(0.0), fsdp=True)
+    es = EarlyStopping(monitor="loss", patience=0,
+                       restore_best_weights=True)
+    es_history = es_trainer.fit(x, y, epochs=3, batch_size=32,
+                                shuffle=False, verbose=False,
+                                callbacks=(es,))
+    es_eval = es_trainer.evaluate(x, y, batch_size=32, verbose=False)
+
     print(json.dumps({
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
@@ -89,6 +108,8 @@ def main():
         "wv_val_accuracy": wv_history["val_accuracy"],
         "weighted_eval_loss": weighted_eval["loss"],
         "weighted_eval_accuracy": weighted_eval["accuracy"],
+        "es_epochs": len(es_history["loss"]),
+        "es_eval_loss": es_eval["loss"],
     }))
 
 

@@ -143,6 +143,11 @@ def test_two_process_pod_matches_single_process():
             weighted_eval["loss"], rel=1e-5)
         assert rec["weighted_eval_accuracy"] == pytest.approx(
             weighted_eval["accuracy"], rel=1e-5)
+        # EarlyStopping restore ran multi-host (sharding-preserving
+        # snapshot) and both processes agree on the restored model.
+        assert rec["es_epochs"] >= 1
+    np.testing.assert_allclose(outs[0]["es_eval_loss"],
+                               outs[1]["es_eval_loss"], rtol=1e-6)
 
 
 @pytest.mark.parametrize("bad_id", [0])
