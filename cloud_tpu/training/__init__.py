@@ -1,7 +1,8 @@
 from cloud_tpu.training.callbacks import (Callback, EarlyStopping,
                                           LambdaCallback, MetricsLogger,
-                                          ModelCheckpoint, TensorBoard,
-                                          read_metrics_log)
+                                          ModelCheckpoint,
+                                          PreemptionCheckpoint,
+                                          TensorBoard, read_metrics_log)
 from cloud_tpu.training.data import (ArrayDataset, GeneratorDataset,
                                      NpzShardDataset, ThreadedDataset,
                                      prefetch_to_device)

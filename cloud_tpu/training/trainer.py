@@ -397,6 +397,10 @@ class Trainer:
         self._scalar_unmasked_metrics = set()
         self._jit_predict_step = None
         self.stop_training = False  # set by callbacks (EarlyStopping)
+        # Step-granular abort (preemption): checked between steps in the
+        # fit loop — a plain host bool, so the check costs nothing and
+        # never syncs the device. request_stop() sets it.
+        self._abort_epoch = False
 
     # -- state construction --------------------------------------------
 
@@ -999,6 +1003,7 @@ class Trainer:
 
         history = {}
         self.stop_training = False
+        self._abort_epoch = False
         # Visible to callbacks at on_train_begin (e.g. ProfilerCallback
         # checks its target epochs will actually run).
         self.planned_epochs = epochs
@@ -1032,6 +1037,19 @@ class Trainer:
                 raise teardown_error
         return history
 
+    def request_stop(self):
+        """Stops training at the next step boundary (signal-safe).
+
+        The preemption hook: sets two plain host flags — the step loop
+        breaks out of the current epoch at its next iteration (no
+        device sync, no interrupted collective), the partial epoch
+        still runs its epoch-end callbacks (so ModelCheckpoint /
+        PreemptionCheckpoint save a resumable state), and fit()
+        returns. Safe to call from a signal handler or another thread.
+        """
+        self._abort_epoch = True
+        self.stop_training = True
+
     def _fit_epochs(self, dataset, epochs, steps_per_epoch,
                     validation_data, batch_size, callbacks, history,
                     verbose, prefetch=2):
@@ -1054,6 +1072,8 @@ class Trainer:
                                        self._feed_grouped(item)))
                 first = True
                 for kind, batch_examples, fed in feeder:
+                    if self._abort_epoch:
+                        break
                     examples += batch_examples
                     if kind == "multi":
                         self.state, logs = multi_step(self.state, fed)
@@ -1093,10 +1113,14 @@ class Trainer:
                             "per-example values.".format(
                                 sorted(self._train_scalar_unmasked)))
                     first = False
-                self._post_epoch_logs(step_logs, count, examples, t0,
-                                      epoch, validation_data,
-                                      batch_size, callbacks, history,
-                                      verbose, prefetch)
+                if not (self._abort_epoch and count == 0):
+                    # A zero-step aborted epoch has no metrics; an
+                    # epoch-end with only steps_per_sec would desync
+                    # history keys and hand callbacks a loss-less epoch.
+                    self._post_epoch_logs(step_logs, count, examples,
+                                          t0, epoch, validation_data,
+                                          batch_size, callbacks,
+                                          history, verbose, prefetch)
                 if self.stop_training:
                     break
                 continue
@@ -1104,6 +1128,8 @@ class Trainer:
                 self._epoch_batches(dataset), limit=steps_per_epoch,
                 size=prefetch)
             for batch_examples, batch in feeder:
+                if self._abort_epoch:
+                    break
                 examples += batch_examples
                 self.state, logs = self._jit_train_step(self.state, batch)
                 if (count == 0 and epoch == 0
@@ -1123,9 +1149,12 @@ class Trainer:
                 # device step); convert once per epoch below.
                 step_logs.append(logs)
                 count += 1
-            self._post_epoch_logs(step_logs, count, examples, t0, epoch,
-                                  validation_data, batch_size, callbacks,
-                                  history, verbose, prefetch)
+            if not (self._abort_epoch and count == 0):
+                # Same zero-step-abort guard as the multi-step path.
+                self._post_epoch_logs(step_logs, count, examples, t0,
+                                      epoch, validation_data,
+                                      batch_size, callbacks, history,
+                                      verbose, prefetch)
             if self.stop_training:
                 break
 
@@ -1166,6 +1195,11 @@ class Trainer:
         logs["steps_per_sec"] = count / elapsed
         _emit_runtime_metrics(count, examples, elapsed)
 
+        if validation_data is not None and self._abort_epoch:
+            # Preemption abort: the eviction grace window is for the
+            # checkpoint (PreemptionCheckpoint saves in on_epoch_end,
+            # below) — a full validation pass here could eat it.
+            validation_data = None
         if validation_data is not None:
             # Keras-style (x, y) or (x, y, sample_weight).
             if len(validation_data) == 3:

@@ -1555,3 +1555,44 @@ class TestSummary:
         trainer.build(x)
         text = trainer.summary(print_fn=lambda t: None)
         assert "Extra vars" in text
+
+
+class TestRequestStop:
+    def test_stops_at_step_boundary_mid_epoch(self):
+        """request_stop() from another thread (the signal-handler
+        calling convention) breaks the epoch at the next step, the
+        partial epoch still reaches on_epoch_end, and fit returns."""
+        import threading
+        import time as time_lib
+
+        from cloud_tpu.training import LambdaCallback
+
+        x, y = _toy_classification(n=4096)
+        trainer = Trainer(MLP(hidden=16, num_classes=4),
+                          optimizer=optax.sgd(0.1))
+        epoch_ends = []
+
+        # Fire from a LambdaCallback at first epoch begin via a timer
+        # thread, so the stop lands while the step loop is running.
+        def arm(epoch):
+            if epoch == 0:
+                threading.Timer(0.3, trainer.request_stop).start()
+
+        history = trainer.fit(
+            x, y, epochs=50, batch_size=32, verbose=False,
+            callbacks=(LambdaCallback(
+                on_epoch_begin=arm,
+                on_epoch_end=lambda e, logs: epoch_ends.append(e)),))
+        total_steps = int(trainer.state.step)
+        # Stopped long before the 50-epoch budget (128 steps/epoch).
+        assert total_steps < 50 * 128
+        assert len(history["loss"]) == len(epoch_ends)
+        assert epoch_ends, "epoch-end callbacks must still fire"
+
+    def test_request_stop_before_fit_is_reset(self):
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=8, num_classes=4))
+        trainer.request_stop()  # stale flag from a previous life
+        history = trainer.fit(x, y, epochs=2, batch_size=32,
+                              verbose=False)
+        assert len(history["loss"]) == 2  # fit() resets the flags
