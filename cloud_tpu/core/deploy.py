@@ -26,7 +26,6 @@ except ImportError:
     googleapiclient_errors = None
 
 from cloud_tpu.core import gcp
-from cloud_tpu.core import machine_config
 from cloud_tpu.utils import google_api_client
 
 logger = logging.getLogger("cloud_tpu")

@@ -37,7 +37,6 @@ this for you and converts HF param layouts to this module's.
 from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P

@@ -9,7 +9,6 @@ TPU with causal=False), bfloat16 compute / float32 params, static
 shapes throughout.
 """
 
-from typing import Optional
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -24,7 +24,6 @@ import time
 from cloud_tpu.cloud_fit import client as cloud_fit_client
 from cloud_tpu.cloud_fit import remote as cloud_fit_remote
 from cloud_tpu.core import gcp
-from cloud_tpu.tuner import hyperparameters as hp_module
 from cloud_tpu.tuner import optimizer_client
 from cloud_tpu.tuner import utils as tuner_utils
 from cloud_tpu.utils import google_api_client

@@ -10,7 +10,6 @@ Run: python examples/mnist_example_using_ctl.py
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

@@ -8,7 +8,6 @@ sizes are shrunk via env knobs where needed to keep CI fast.
 
 import importlib.util
 import os
-import sys
 
 import numpy as np
 import pytest

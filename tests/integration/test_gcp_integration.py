@@ -64,8 +64,7 @@ class TestCloudFitIntegration:
     cloud_fit/tests/integration/integration_test.py:97-139)."""
 
     def test_fit_and_reload(self):
-        import optax
-
+    
         from cloud_tpu.cloud_fit import client as cloud_fit_client
         from cloud_tpu.models import MLP
         from cloud_tpu.training import Trainer

@@ -9,7 +9,6 @@ crashed worker must never green-cache a "passing" kernel smoke.
 import importlib.util
 import json
 import os
-import sys
 import types
 
 import pytest

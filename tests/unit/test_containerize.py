@@ -7,7 +7,6 @@ client (298-362), and Cloud Build request pinning with mocked
 discovery/storage (364-476).
 """
 
-import os
 import sys
 import tarfile
 from unittest import mock

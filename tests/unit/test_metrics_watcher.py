@@ -7,7 +7,6 @@ channel instead of TensorBoard event protos.
 """
 
 import json
-import os
 
 from cloud_tpu.training.callbacks import MetricsLogger
 from cloud_tpu.utils.metrics_watcher import (MetricsWatcher,

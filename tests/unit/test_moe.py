@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from cloud_tpu.models.moe import MoEMLP, expert_parallel_rules
 from cloud_tpu.parallel import sharding as sharding_lib

@@ -94,7 +94,6 @@ class TestReduceOnPlateau:
         """optax.contrib.reduce_on_plateau chained after the base
         optimizer gets the step loss through the extra-args protocol
         and shrinks its scale once the (frozen) loss plateaus."""
-        import jax
         import numpy as np
         import optax
         import optax.contrib

@@ -1563,7 +1563,6 @@ class TestRequestStop:
         calling convention) breaks the epoch at the next step, the
         partial epoch still reaches on_epoch_end, and fit returns."""
         import threading
-        import time as time_lib
 
         from cloud_tpu.training import LambdaCallback
 

@@ -12,7 +12,6 @@ from unittest import mock
 import numpy as np
 import pytest
 
-from cloud_tpu.tuner import hyperparameters as hp_module
 from cloud_tpu.tuner import optimizer_client
 from cloud_tpu.tuner import utils as tuner_utils
 from cloud_tpu.tuner.hyperparameters import HyperParameters, Objective

@@ -5,7 +5,6 @@ Mirrors the reference's rejection-branch coverage
 for the TPU-native path.
 """
 
-import os
 
 import pytest
 
