@@ -37,6 +37,29 @@ import time
 
 import numpy as np
 
+# Sweep-derived operating point: benchmarks/best_pin.json (written by
+# `sweep.py --write-pin` from the best measured config) supplies
+# defaults for the FAIR-GAME knobs — batch size, steps_per_execution,
+# bf16 input feeding — that don't change the model being measured
+# (space-to-depth does, so it is never pinned). Explicit env always
+# wins; applied before the constants below so main(), the worker
+# subprocess, and the green-cache metric naming all agree.
+_PIN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "benchmarks", "best_pin.json")
+_PINNABLE = ("BENCH_BATCH", "BENCH_SPE", "BENCH_BF16_INPUT")
+try:
+    if os.environ.get("BENCH_IGNORE_PIN", "0") != "1":
+        with open(_PIN_PATH) as _f:
+            _pin = json.load(_f)
+        if isinstance(_pin, dict):
+            for _k in _PINNABLE:
+                if _k in _pin and _k not in os.environ:
+                    os.environ[_k] = str(int(_pin[_k]))
+except (OSError, ValueError, TypeError):
+    # A malformed pin must degrade to defaults, never kill the
+    # harness (its contract: the JSON line is never empty).
+    pass
+
 BATCH = int(os.environ.get("BENCH_BATCH", 256))
 IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", 3))

@@ -19,7 +19,12 @@ _BENCH_PATH = os.path.join(os.path.dirname(__file__), "..", "..",
 
 @pytest.fixture()
 def bench(tmp_path, monkeypatch):
-    """Fresh bench module per test (module state: _EMITTED, paths)."""
+    """Fresh bench module per test (module state: _EMITTED, paths).
+
+    BENCH_IGNORE_PIN: the import-time best-pin application mutates
+    os.environ; a real benchmarks/best_pin.json on the dev box must
+    not leak BENCH_* values into the pytest process."""
+    monkeypatch.setenv("BENCH_IGNORE_PIN", "1")
     spec = importlib.util.spec_from_file_location(
         "bench_under_test", os.path.abspath(_BENCH_PATH))
     mod = importlib.util.module_from_spec(spec)
@@ -128,3 +133,53 @@ class TestCrashedWorker:
         assert err is None
         assert record["kernel_parity"] == "ok"
         assert "worker_rc" not in record
+
+
+class TestBestPin:
+    def test_pin_file_supplies_defaults_env_wins(self, tmp_path,
+                                                 monkeypatch):
+        """benchmarks/best_pin.json supplies fair-game defaults
+        (batch/spe/bf16-input) at import; explicit env still wins and
+        BENCH_S2D is never pinned (it changes the model)."""
+        import importlib.util
+        import json as json_lib
+
+        pin_path = tmp_path / "best_pin.json"
+        pin_path.write_text(json_lib.dumps(
+            {"BENCH_BATCH": 512, "BENCH_SPE": 5,
+             "BENCH_BF16_INPUT": 1, "BENCH_S2D": 1,
+             "source": "test"}))
+        monkeypatch.setenv("BENCH_SPE", "2")  # explicit env wins
+        monkeypatch.delenv("BENCH_BATCH", raising=False)
+        monkeypatch.delenv("BENCH_BF16_INPUT", raising=False)
+        monkeypatch.delenv("BENCH_S2D", raising=False)
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_pin_test", os.path.abspath(_BENCH_PATH))
+        mod = importlib.util.module_from_spec(spec)
+        monkeypatch.setattr("os.path.join",
+                            _join_redirect(str(pin_path)))
+        try:
+            spec.loader.exec_module(mod)
+            assert mod.BATCH == 512                      # pinned default
+            assert os.environ["BENCH_SPE"] == "2"        # env won
+            assert os.environ["BENCH_BF16_INPUT"] == "1"  # pinned
+            # S2D is not a pinnable key even when present in the file.
+            assert "BENCH_S2D" not in os.environ
+        finally:
+            # The import-time pin application mutates os.environ
+            # outside monkeypatch's bookkeeping — scrub what it set so
+            # nothing leaks into later tests.
+            for key in ("BENCH_BATCH", "BENCH_BF16_INPUT"):
+                os.environ.pop(key, None)
+
+
+def _join_redirect(pin_path):
+    """os.path.join that redirects only the best_pin.json lookup."""
+    real_join = os.path.join
+
+    def join(*parts):
+        if parts and parts[-1] == "best_pin.json":
+            return pin_path
+        return real_join(*parts)
+    return join
