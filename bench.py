@@ -101,6 +101,7 @@ METRIC = "resnet50_train_images_per_sec_per_chip"
 # exactly one JSON line may ever be printed.
 _INFLIGHT = None
 _EMITTED = False
+_CHIP_LOCK = None  # held for the process lifetime once acquired
 
 
 def _bounded_run(args, timeout):
@@ -325,6 +326,21 @@ def main():
     try:
         signal.signal(signal.SIGTERM, _terminated)
     except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
+    # One measurement driver on the chip at a time: a concurrent
+    # capture (e.g. the auto-capture watcher mid-sweep) would contend
+    # through the tunnel and corrupt both timings. Advisory — a
+    # timeout proceeds anyway (never deadlock the harness); the wait
+    # spends this run's own deadline budget. Acquired for the process
+    # lifetime: the kernel releases the flock when this process (or a
+    # crash) closes the fd, so no explicit release path is needed.
+    try:
+        sys.path.insert(0, os.path.join(_HERE, "benchmarks"))
+        from _subproc import hold_chip_lock
+        global _CHIP_LOCK  # keep the fd referenced for process lifetime
+        _CHIP_LOCK = hold_chip_lock(
+            timeout=min(900.0, max(remaining() - 120.0, 0.0)))
+    except ImportError:  # partial checkout: measure unlocked
         pass
     while True:
         if measurements >= MAX_MEASUREMENTS:
@@ -588,6 +604,10 @@ def worker():
         record["stem"] = "space_to_depth"
     if bf16_input:
         record["input_dtype"] = "bfloat16"
+    if os.environ.get("BENCH_LOCK_CONTENDED") == "1":
+        # Another measurement driver may have shared the chip during
+        # this run (the chip-lock wait timed out upstream).
+        record["lock_contended"] = True
     if os.environ.get("BENCH_SKIP_KERNEL_PARITY", "0") != "1":
         # Emit the throughput record FIRST: if the kernel smoke hangs
         # the tunnel, the parent salvages this line from the killed

@@ -41,3 +41,88 @@ def run_json_point(cmd, timeout, cwd, env=None, error_extra=None):
                 continue  # cut mid-write; keep scanning
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()
     return err(tail[-1][:160] if tail else "rc={}".format(proc.returncode))
+
+
+class chip_lock:
+    """Advisory inter-process lock on the (single) TPU chip.
+
+    Two benchmark drivers sharing the chip (e.g. an auto-capture
+    watcher mid-sweep and the round-end harness running bench.py)
+    would contend through the tunnel and corrupt each other's timings.
+    Every entry point that measures takes this flock first:
+
+        with chip_lock(timeout=900) as acquired:
+            ...  # acquired is False after `timeout`s — proceed anyway
+                 # (an advisory lock must never deadlock the harness;
+                 # a contended measurement beats no measurement).
+
+    Lock file: benchmarks/.chip.lock (flock, so a crashed holder
+    releases automatically).
+    """
+
+    def __init__(self, timeout=900.0, path=None):
+        import os as os_lib
+        self.timeout = timeout
+        self.path = path or os_lib.path.join(
+            os_lib.path.dirname(os_lib.path.abspath(__file__)),
+            ".chip.lock")
+        self._fd = None
+
+    def __enter__(self):
+        import errno
+        import fcntl
+        import os as os_lib
+        import time as time_lib
+
+        try:
+            self._fd = os_lib.open(self.path,
+                                   os_lib.O_CREAT | os_lib.O_RDWR, 0o644)
+        except OSError:
+            return False  # unwritable location: proceed unlocked
+        deadline = time_lib.monotonic() + self.timeout
+        while True:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return True
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    return False
+                if time_lib.monotonic() >= deadline:
+                    return False
+                time_lib.sleep(2.0)
+
+    def __exit__(self, *exc):
+        import os as os_lib
+
+        if self._fd is not None:
+            try:
+                os_lib.close(self._fd)  # closing releases the flock
+            except OSError:
+                pass
+        return False
+
+
+def hold_chip_lock(timeout=600.0, cpu=False):
+    """Acquires the chip lock for the process lifetime; returns the
+    lock object (KEEP the reference — dropping it closes the fd and
+    releases the flock).
+
+    Forced-CPU runs (cpu=True or BENCH_FORCE_CPU=1) return None
+    without touching the lock: they never use the chip and must not
+    stall — or block — a real TPU measurement. On timeout the run
+    proceeds (advisory lock, never deadlock the harness) with a
+    stderr warning, and BENCH_LOCK_CONTENDED=1 is exported so worker
+    subprocesses can mark their records as possibly contended.
+    """
+    import os
+    import sys
+
+    if cpu or os.environ.get("BENCH_FORCE_CPU") == "1":
+        return None
+    lock = chip_lock(timeout=timeout)
+    if not lock.__enter__():
+        print("# chip lock not acquired in {:.0f}s; proceeding "
+              "(concurrent measurement possible)".format(timeout),
+              file=sys.stderr)
+        os.environ["BENCH_LOCK_CONTENDED"] = "1"
+    return lock

@@ -30,6 +30,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from _subproc import run_json_point
 
+_CHIP_LOCK = None  # held for the process lifetime once acquired
+
 
 def _point_worker(args):
     import jax
@@ -122,6 +124,13 @@ def main(argv=None):
     if args.point is not None:
         args.point = tuple(int(v) for v in args.point.split(","))
         return _point_worker(args)
+
+
+    # Serialize chip access with other measurement drivers (advisory;
+    # skips forced-CPU runs — see _subproc.hold_chip_lock).
+    from _subproc import hold_chip_lock
+    global _CHIP_LOCK
+    _CHIP_LOCK = hold_chip_lock(cpu=args.cpu)
 
     blocks = [int(v) for v in args.blocks.split(",")]
     grid = [(0, 0)] + [  # (0,0) = the jnp reference oracle point

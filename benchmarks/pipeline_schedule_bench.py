@@ -48,6 +48,8 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
+_CHIP_LOCK = None  # held for the process lifetime once acquired
+
 
 def measure(pp_stages, num_micro, run_steps, batch, seq, d_model,
             vocab):
@@ -131,6 +133,14 @@ def main():
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
+
+    # Serialize chip access with other measurement drivers (advisory;
+    # skips forced-CPU runs — see _subproc.hold_chip_lock). After
+    # argparse so --help never waits on the lock.
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _subproc import hold_chip_lock
+    global _CHIP_LOCK
+    _CHIP_LOCK = hold_chip_lock(cpu=args.cpu)
 
     from cloud_tpu.parallel import runtime
 

@@ -28,6 +28,8 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
+_CHIP_LOCK = None  # held for the process lifetime once acquired
+
 
 def _sync(out):
     """True barrier: fetch one output leaf's VALUE to host.
@@ -416,6 +418,13 @@ CONFIGS = {1: config1_mnist, 2: config2_resnet50, 3: config3_dp_pod_shape,
 
 
 def main(argv):
+    # Serialize chip access with other measurement drivers (advisory;
+    # skips forced-CPU runs — see _subproc.hold_chip_lock).
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _subproc import hold_chip_lock
+    global _CHIP_LOCK
+    _CHIP_LOCK = hold_chip_lock()
+
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # Same escape hatch as bench.py: a site hook pins JAX_PLATFORMS
         # to the TPU tunnel, so only an explicit config update sticks

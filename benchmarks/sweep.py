@@ -22,6 +22,8 @@ BENCH = os.path.join(_REPO_ROOT, "bench.py")
 
 from _subproc import run_json_point
 
+_CHIP_LOCK = None  # held for the process lifetime once acquired
+
 
 def run_point(batch, s2d, spe, timeout, bf16_input=0):
     env = dict(
@@ -65,6 +67,13 @@ def main(argv=None):
                              "bf16-input; NOT s2d, which changes the "
                              "model) for bench.py to adopt as defaults")
     args = parser.parse_args(argv)
+
+
+    # Serialize chip access with other measurement drivers (advisory;
+    # skips forced-CPU runs — see _subproc.hold_chip_lock).
+    from _subproc import hold_chip_lock
+    global _CHIP_LOCK
+    _CHIP_LOCK = hold_chip_lock()
 
     best = None
     records = []
