@@ -77,16 +77,19 @@ class ProfilerCallback(Callback):
         # callback instance re-evaluates the fallback for each fit().
         self._run_epochs = self.epochs
         planned = getattr(self.trainer, "planned_epochs", None)
-        if planned is not None and not any(e < planned
+        start = getattr(self.trainer, "initial_epoch", 0)
+        if planned is not None and not any(start <= e < planned
                                            for e in self.epochs):
-            # E.g. the default epochs=(1,) with fit(epochs=1): only
-            # epoch 0 runs. Trace it rather than silently producing
+            # E.g. the default epochs=(1,) with fit(epochs=1) (only
+            # epoch 0 runs) or a resumed fit(initial_epoch=4) that
+            # starts past every requested epoch. Trace the first epoch
+            # THIS fit will actually run rather than silently producing
             # nothing.
             logging.getLogger("cloud_tpu").warning(
                 "ProfilerCallback: none of the requested epochs %s will "
-                "run (fit epochs=%d); profiling epoch 0 instead.",
-                sorted(self.epochs), planned)
-            self._run_epochs = {0}
+                "run (fit runs epochs [%d, %d)); profiling epoch %d "
+                "instead.", sorted(self.epochs), start, planned, start)
+            self._run_epochs = {start}
 
     def on_epoch_begin(self, epoch):
         if epoch in self._run_epochs and jax.process_index() == 0:

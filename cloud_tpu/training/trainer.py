@@ -893,6 +893,8 @@ class Trainer:
             batch_size=32,
             shuffle=True,
             validation_data=None,
+            validation_split=0.0,
+            initial_epoch=0,
             callbacks=(),
             steps_per_epoch=None,
             verbose=True,
@@ -921,12 +923,61 @@ class Trainer:
         mean(per_example * w) and per-example metrics weighted means.
         Array inputs only; `validation_data` may be (x, y, w) too.
 
+        validation_split: Keras parity — float in (0, 1): hold out the
+        LAST fraction of the (un-shuffled) input arrays as validation
+        data, weights included; mutually exclusive with
+        validation_data, array inputs only. Training shuffle (if on)
+        applies only to the retained training fraction, like Keras.
+
+        initial_epoch: Keras parity — epoch index to start from
+        (epochs still names the FINAL epoch bound, so `epochs=10,
+        initial_epoch=4` runs 6 epochs numbered 4..9); pairs with
+        `resume_from=` so callback epoch numbering and schedules
+        driven by epoch continue where the interrupted run stopped.
+
         class_weight: Optional {label: weight} dict (Keras
         `fit(class_weight=)`) for imbalanced classification — sugar
         for a per-example sample_weight derived from integer labels
         `y` (multiplies into any explicit sample_weight). Labels
         absent from the dict weigh 1.0.
         """
+        if validation_split:
+            if not 0.0 < validation_split < 1.0:
+                raise ValueError(
+                    "validation_split must be in (0, 1); got {}."
+                    .format(validation_split))
+            if validation_data is not None:
+                raise ValueError(
+                    "Pass validation_split OR validation_data, not "
+                    "both.")
+            if y is None or not (
+                    hasattr(x, "shape") or isinstance(x, (dict, list,
+                                                          tuple))):
+                raise ValueError(
+                    "validation_split needs raw array inputs (x, y); "
+                    "datasets should pre-split and pass "
+                    "validation_data.")
+            n = jax.tree_util.tree_leaves(x)[0].shape[0]
+            split = int(n * (1.0 - validation_split))
+            if split == 0 or split == n:
+                raise ValueError(
+                    "validation_split={} leaves an empty {} set for {}"
+                    " examples.".format(
+                        validation_split,
+                        "training" if split == 0 else "validation", n))
+            # Keras semantics: the LAST fraction, taken before any
+            # shuffling, is validation.
+            take = lambda lo, hi: jax.tree_util.tree_map(
+                lambda a: a[lo:hi], x)
+            y_arr = np.asarray(y)
+            if sample_weight is not None:
+                sw = np.asarray(sample_weight, np.float32)
+                validation_data = (take(split, n), y_arr[split:],
+                                   sw[split:])
+                sample_weight = sw[:split]
+            else:
+                validation_data = (take(split, n), y_arr[split:])
+            x, y = take(0, split), y_arr[:split]
         if class_weight is not None:
             labels = None if y is None else np.asarray(y)
             if labels is None or labels.ndim != 1:
@@ -1005,8 +1056,10 @@ class Trainer:
         self.stop_training = False
         self._abort_epoch = False
         # Visible to callbacks at on_train_begin (e.g. ProfilerCallback
-        # checks its target epochs will actually run).
+        # checks its target epochs will actually run). The epoch range
+        # of THIS fit is [initial_epoch, planned_epochs).
         self.planned_epochs = epochs
+        self.initial_epoch = initial_epoch
         for cb in callbacks:
             cb.set_trainer(self)
             cb.on_train_begin()
@@ -1014,7 +1067,8 @@ class Trainer:
         try:
             self._fit_epochs(dataset, epochs, steps_per_epoch,
                              validation_data, batch_size, callbacks,
-                             history, verbose, prefetch)
+                             history, verbose, prefetch,
+                             initial_epoch=initial_epoch)
         finally:
             # Guaranteed even when a train step raises (OOM, interrupt):
             # callbacks holding external resources (profiler traces,
@@ -1052,8 +1106,8 @@ class Trainer:
 
     def _fit_epochs(self, dataset, epochs, steps_per_epoch,
                     validation_data, batch_size, callbacks, history,
-                    verbose, prefetch=2):
-        for epoch in range(epochs):
+                    verbose, prefetch=2, initial_epoch=0):
+        for epoch in range(initial_epoch, epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             step_logs = []
@@ -1100,7 +1154,7 @@ class Trainer:
                             self.state, fed)
                         step_logs.append(logs)
                         count += 1
-                    if (first and epoch == 0
+                    if (first and epoch == initial_epoch
                             and getattr(self, "_train_scalar_unmasked",
                                         None)):
                         # Same loud failure as the single-step path: a
@@ -1132,7 +1186,7 @@ class Trainer:
                     break
                 examples += batch_examples
                 self.state, logs = self._jit_train_step(self.state, batch)
-                if (count == 0 and epoch == 0
+                if (count == 0 and epoch == initial_epoch
                         and getattr(self, "_train_scalar_unmasked", None)):
                     # Populated during the trace that just ran: a
                     # scalar metric can't be sample-weighted — fail

@@ -1595,3 +1595,104 @@ class TestRequestStop:
         history = trainer.fit(x, y, epochs=2, batch_size=32,
                               verbose=False)
         assert len(history["loss"]) == 2  # fit() resets the flags
+
+
+class TestValidationSplit:
+    def test_matches_manual_split(self):
+        """validation_split holds out the LAST fraction (pre-shuffle),
+        matching an explicit validation_data split exactly."""
+        import jax.numpy as jnp
+
+        x, y = _toy_classification(n=128)
+        a = Trainer(MLP(hidden=16, num_classes=4,
+                        compute_dtype=jnp.float32),
+                    optimizer=optax.sgd(0.0), seed=0)  # frozen
+        b = Trainer(MLP(hidden=16, num_classes=4,
+                        compute_dtype=jnp.float32),
+                    optimizer=optax.sgd(0.0), seed=0)
+        ha = a.fit(x, y, epochs=1, batch_size=32, shuffle=False,
+                   validation_split=0.25, verbose=False)
+        hb = b.fit(x[:96], y[:96], epochs=1, batch_size=32,
+                   shuffle=False, validation_data=(x[96:], y[96:]),
+                   verbose=False)
+        assert ha["loss"][0] == pytest.approx(hb["loss"][0], rel=1e-6)
+        assert ha["val_loss"][0] == pytest.approx(hb["val_loss"][0],
+                                                  rel=1e-6)
+
+    def test_split_carries_sample_weights(self):
+        x, y = _toy_classification(n=96)
+        w = np.linspace(0.2, 2.0, 96).astype(np.float32)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          optimizer=optax.sgd(0.1))
+        h = trainer.fit(x, y, epochs=1, batch_size=32, shuffle=False,
+                        sample_weight=w, validation_split=1 / 3,
+                        verbose=False)
+        assert "val_loss" in h
+        assert int(trainer.state.step) == 2  # 64 train rows / 32
+
+    def test_rejections(self):
+        x, y = _toy_classification(n=64)
+        t = Trainer(MLP(hidden=8, num_classes=4))
+        with pytest.raises(ValueError, match="not both"):
+            t.fit(x, y, epochs=1, validation_split=0.5,
+                  validation_data=(x, y), verbose=False)
+        with pytest.raises(ValueError, match="in \\(0, 1\\)"):
+            t.fit(x, y, epochs=1, validation_split=1.5, verbose=False)
+        with pytest.raises(ValueError, match="array inputs"):
+            t.fit([(x[:32], y[:32])], epochs=1, validation_split=0.5,
+                  verbose=False)
+        with pytest.raises(ValueError, match="empty"):
+            t.fit(x[:3], y[:3], epochs=1, validation_split=0.9,
+                  verbose=False)
+
+
+class TestInitialEpoch:
+    def test_resumes_epoch_numbering(self):
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          optimizer=optax.adam(1e-2))
+        seen = []
+        from cloud_tpu.training import LambdaCallback
+        trainer.fit(x, y, epochs=5, initial_epoch=3, batch_size=32,
+                    verbose=False,
+                    callbacks=(LambdaCallback(
+                        on_epoch_begin=seen.append),))
+        assert seen == [3, 4]
+        assert int(trainer.state.step) == 4  # 2 epochs x 2 steps
+
+
+class TestInitialEpochGuards:
+    def test_scalar_weighted_guard_fires_on_resumed_fit(self):
+        """The loud scalar-metric-with-weights failure must fire on the
+        FIRST epoch of a resumed fit (initial_epoch > 0), not only on
+        epoch index 0 (review r4 regression)."""
+        import jax.numpy as jnp
+
+        def scalar_m(outputs, y):
+            return jnp.mean(jnp.argmax(outputs, -1) == y)
+
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          metrics=(scalar_m,))
+        with pytest.raises(ValueError, match="scalar_m"):
+            trainer.fit(x, y, epochs=5, initial_epoch=3, batch_size=32,
+                        verbose=False,
+                        sample_weight=np.ones(64, np.float32))
+
+    def test_profiler_fallback_uses_start_epoch(self, tmp_path):
+        """ProfilerCallback's will-it-run check accounts for
+        initial_epoch: requested epoch 1 never runs in a fit over
+        epochs [3, 5), so the fallback must target epoch 3 (which
+        runs), not epoch 0 (which doesn't)."""
+        from cloud_tpu.monitoring.profiler import ProfilerCallback
+
+        x, y = _toy_classification(n=64)
+        trainer = Trainer(MLP(hidden=8, num_classes=4),
+                          optimizer=optax.adam(1e-2))
+        cb = ProfilerCallback(str(tmp_path), epochs=(1,))
+        trainer.fit(x, y, epochs=5, initial_epoch=3, batch_size=32,
+                    verbose=False, callbacks=(cb,))
+        assert cb._run_epochs == {3}
+        # A trace directory was actually produced for the traced epoch.
+        import os as os_lib
+        assert any(os_lib.scandir(str(tmp_path)))
