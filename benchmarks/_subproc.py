@@ -68,10 +68,19 @@ class chip_lock:
             ".chip.lock")
         self._fd = None
 
+    # Poll/handoff cadence: waiters retry every POLL seconds; a
+    # releasing point-lock pauses HANDOFF_GAP after the release, so a
+    # waiter's next poll reliably lands inside the gap (GAP >> POLL) —
+    # without the gap, a sweep re-acquires within microseconds of
+    # releasing and a polling waiter essentially never gets the lock.
+    POLL_S = 0.05
+    HANDOFF_GAP_S = 0.25
+
     def __enter__(self):
         import errno
         import fcntl
         import os as os_lib
+        import sys as sys_lib
         import time as time_lib
 
         try:
@@ -88,18 +97,47 @@ class chip_lock:
                 if e.errno not in (errno.EAGAIN, errno.EACCES):
                     return False
                 if time_lib.monotonic() >= deadline:
+                    # Contended run: say so once, and export the mark
+                    # so worker subprocesses stamp their records
+                    # (bench.py reads BENCH_LOCK_CONTENDED).
+                    print("# chip lock not acquired in {:.0f}s; "
+                          "proceeding (concurrent measurement "
+                          "possible)".format(self.timeout),
+                          file=sys_lib.stderr)
+                    os_lib.environ["BENCH_LOCK_CONTENDED"] = "1"
                     return False
-                time_lib.sleep(2.0)
+                time_lib.sleep(self.POLL_S)
 
     def __exit__(self, *exc):
         import os as os_lib
+        import time as time_lib
 
         if self._fd is not None:
             try:
                 os_lib.close(self._fd)  # closing releases the flock
             except OSError:
                 pass
+            self._fd = None
+            # Handoff window for any polling waiter (see POLL_S note).
+            time_lib.sleep(self.HANDOFF_GAP_S)
         return False
+
+
+def point_lock(timeout=120.0, cpu=False):
+    """Per-point chip lock for long-running sweeps.
+
+    A sweep that held the lock for its whole multi-hour run would
+    force a concurrent flagship bench.py (which waits at most ~15 min)
+    to proceed contended. Taking the lock per point instead caps any
+    other driver's wait at one point's duration: between points the
+    flock is free for the flagship to grab. Returns a context manager
+    (no-op for forced-CPU runs)."""
+    import contextlib
+    import os
+
+    if cpu or os.environ.get("BENCH_FORCE_CPU") == "1":
+        return contextlib.nullcontext(False)
+    return chip_lock(timeout=timeout)
 
 
 def hold_chip_lock(timeout=600.0, cpu=False):
@@ -110,19 +148,14 @@ def hold_chip_lock(timeout=600.0, cpu=False):
     Forced-CPU runs (cpu=True or BENCH_FORCE_CPU=1) return None
     without touching the lock: they never use the chip and must not
     stall — or block — a real TPU measurement. On timeout the run
-    proceeds (advisory lock, never deadlock the harness) with a
-    stderr warning, and BENCH_LOCK_CONTENDED=1 is exported so worker
+    proceeds (advisory lock, never deadlock the harness); chip_lock
+    itself warns and exports BENCH_LOCK_CONTENDED=1 so worker
     subprocesses can mark their records as possibly contended.
     """
     import os
-    import sys
 
     if cpu or os.environ.get("BENCH_FORCE_CPU") == "1":
         return None
     lock = chip_lock(timeout=timeout)
-    if not lock.__enter__():
-        print("# chip lock not acquired in {:.0f}s; proceeding "
-              "(concurrent measurement possible)".format(timeout),
-              file=sys.stderr)
-        os.environ["BENCH_LOCK_CONTENDED"] = "1"
+    lock.__enter__()
     return lock

@@ -28,9 +28,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _subproc import run_json_point
-
-_CHIP_LOCK = None  # held for the process lifetime once acquired
+from _subproc import point_lock, run_json_point
 
 
 def _point_worker(args):
@@ -126,12 +124,6 @@ def main(argv=None):
         return _point_worker(args)
 
 
-    # Serialize chip access with other measurement drivers (advisory;
-    # skips forced-CPU runs — see _subproc.hold_chip_lock).
-    from _subproc import hold_chip_lock
-    global _CHIP_LOCK
-    _CHIP_LOCK = hold_chip_lock(cpu=args.cpu)
-
     blocks = [int(v) for v in args.blocks.split(",")]
     grid = [(0, 0)] + [  # (0,0) = the jnp reference oracle point
         (bq, bk) for bq, bk in itertools.product(blocks, blocks)
@@ -149,9 +141,12 @@ def main(argv=None):
             cmd.append("--cpu")
         if args.tiny:
             cmd.append("--tiny")
-        record, err = run_json_point(
-            cmd, args.timeout, _REPO_ROOT,
-            error_extra={"block_q": bq, "block_k": bk})
+        # Per-point lock: see sweep.py — a concurrent flagship bench
+        # waits at most one point, not the whole grid.
+        with point_lock(timeout=args.timeout, cpu=args.cpu):
+            record, err = run_json_point(
+                cmd, args.timeout, _REPO_ROOT,
+                error_extra={"block_q": bq, "block_k": bk})
         if record is None:
             print(json.dumps(err), flush=True)
             continue

@@ -28,8 +28,6 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
-_CHIP_LOCK = None  # held for the process lifetime once acquired
-
 
 def _sync(out):
     """True barrier: fetch one output leaf's VALUE to host.
@@ -418,12 +416,11 @@ CONFIGS = {1: config1_mnist, 2: config2_resnet50, 3: config3_dp_pod_shape,
 
 
 def main(argv):
-    # Serialize chip access with other measurement drivers (advisory;
-    # skips forced-CPU runs — see _subproc.hold_chip_lock).
+    # Per-CONFIG chip lock (advisory; no-op for forced-CPU runs): a
+    # concurrent flagship bench.py waits at most one config, not the
+    # whole 9-config run — see _subproc.point_lock.
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from _subproc import hold_chip_lock
-    global _CHIP_LOCK
-    _CHIP_LOCK = hold_chip_lock()
+    from _subproc import point_lock
 
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # Same escape hatch as bench.py: a site hook pins JAX_PLATFORMS
@@ -433,7 +430,8 @@ def main(argv):
         jax.config.update("jax_platforms", "cpu")
     wanted = [int(a) for a in argv] or sorted(CONFIGS)
     for i in wanted:
-        result = CONFIGS[i]()
+        with point_lock(timeout=300.0):
+            result = CONFIGS[i]()
         result["config"] = i
         print(json.dumps(result))
 

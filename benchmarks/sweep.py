@@ -20,9 +20,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(_REPO_ROOT, "bench.py")
 
-from _subproc import run_json_point
-
-_CHIP_LOCK = None  # held for the process lifetime once acquired
+from _subproc import point_lock, run_json_point
 
 
 def run_point(batch, s2d, spe, timeout, bf16_input=0):
@@ -39,9 +37,13 @@ def run_point(batch, s2d, spe, timeout, bf16_input=0):
         BENCH_SKIP_KERNEL_PARITY="1",
     )
     point = {"batch": batch, "s2d": s2d, "spe": spe}
-    record, err = run_json_point(
-        [sys.executable, BENCH, "--worker"], timeout, _REPO_ROOT,
-        env=env, error_extra=point)
+    # Per-POINT chip lock: between points the flock is free, so a
+    # concurrent flagship bench.py grabs the chip within one point's
+    # duration instead of waiting out the whole sweep.
+    with point_lock(timeout=timeout):
+        record, err = run_json_point(
+            [sys.executable, BENCH, "--worker"], timeout, _REPO_ROOT,
+            env=env, error_extra=point)
     if record is None:
         return err
     record.update(point)
@@ -68,12 +70,6 @@ def main(argv=None):
                              "model) for bench.py to adopt as defaults")
     args = parser.parse_args(argv)
 
-
-    # Serialize chip access with other measurement drivers (advisory;
-    # skips forced-CPU runs — see _subproc.hold_chip_lock).
-    from _subproc import hold_chip_lock
-    global _CHIP_LOCK
-    _CHIP_LOCK = hold_chip_lock()
 
     best = None
     records = []
