@@ -420,9 +420,10 @@ def _kernel_parity_smoke(jax):
         kq, kk, kv = jax.random.split(key, 3)
         b, s, h, d = 2, 256, 4, 64
         q = jax.random.normal(kq, (b, s, h, d), dtype=jnp.float32)
-        # Contiguous-prefix key mask (valid lengths 256 and 192) so the
-        # causal config never produces a fully-masked row, where flash
-        # (zeros) and the oracle (uniform average) legitimately differ.
+        # Contiguous-prefix key mask (valid lengths 256 and 192).
+        # (Fully-masked rows would also agree now — both conventions
+        # are zeros since round 4 — but valid rows are what the smoke
+        # is about.)
         mask = (np.arange(s)[None, :] <
                 np.array([[s], [192]])).astype(bool)
         mask = jnp.asarray(mask)

@@ -102,7 +102,18 @@ def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None):
         logits = jnp.where(allowed, logits, _NEG_INF)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
-    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    weights = jax.nn.softmax(logits, axis=-1)
+    if causal or mask is not None:
+        # Fully-masked rows output ZEROS (and zero grads) — the flash
+        # convention, unified here (round 4) so the oracle and kernel
+        # agree on every row and the sp strategies (ring zeros via its
+        # lse sentinel; ulysses delegates to whichever local kernel the
+        # backend picked) behave identically on any backend. Without
+        # this, softmax over all-(-1e30) logits is a uniform average.
+        all_masked = jnp.max(logits, axis=-1,
+                             keepdims=True) <= _NEG_INF / 2
+        weights = jnp.where(all_masked, 0.0, weights)
+    weights = weights.astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
@@ -494,7 +505,8 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
             kernel, so Keras-parity workloads with per-example padding
             never leave the flash path. Any pattern is supported, not
             just contiguous prefixes. Rows whose keys are ALL masked
-            output zeros (the reference would return a uniform average).
+            output zeros — and since round 4 `mha_reference` adopts the
+            same convention, kernel and oracle agree on every row.
         block_q / block_k: Kernel tile sizes along the sequence. S is
             padded up to a multiple internally. Default (None) is 128,
             overridable process-wide via CLOUD_TPU_FLASH_BLOCK_Q /

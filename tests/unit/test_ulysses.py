@@ -313,3 +313,32 @@ class TestModelPaddedSequenceParallel:
             np.testing.assert_allclose(np.asarray(out_sp),
                                        np.asarray(out_ref),
                                        atol=2e-4, rtol=2e-4)
+
+
+class TestUlyssesFullyMaskedRows:
+    def test_fully_masked_rows_output_zeros(self, sp_mesh):
+        """Same contract as ring (pinned there in round 4): rows whose
+        keys are ALL masked output zeros (the flash convention the
+        local kernel applies after the head/sequence exchange), with
+        finite zero grads — keeping the two sp strategies
+        interchangeable on padded batches."""
+        q, k, v = _rand_qkv()
+        mask_np = np.ones((2, 32), bool)
+        mask_np[1, :] = False          # example 1: every key masked
+        mask = jnp.asarray(mask_np)
+
+        out = ulysses_attention(q, k, v, mesh=sp_mesh, causal=False,
+                                mask=mask)
+        np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
+        expected = mha_reference(q, k, v, causal=False, mask=mask)
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   np.asarray(expected[0]),
+                                   atol=2e-5, rtol=2e-5)
+
+        grads = jax.grad(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, mesh=sp_mesh, causal=False, mask=mask).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for g in grads:
+            assert bool(jnp.all(jnp.isfinite(g)))
+        np.testing.assert_array_equal(np.asarray(grads[0][1]), 0.0)
