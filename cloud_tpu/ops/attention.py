@@ -48,7 +48,7 @@ class _Config(NamedTuple):
     block_k: int
     kv_len: int  # true (unpadded) sequence length
     heads: int   # q heads, folded into the grid's leading batch*heads dim
-    has_mask: bool  # per-example key mask streamed as [B, S_pad] blocks
+    has_mask: bool  # per-example key mask streamed as [B, 1, S_pad] blocks
     interpret: bool
     kv_group: int = 1  # q heads per kv head (grouped-query attention)
 
@@ -135,7 +135,7 @@ def _block_mask(config, qi, ki, mask_ref):
             jnp.int32, (block_q, block_k), 0)
         mask = mask & (col <= row)
     if mask_ref is not None:
-        valid = mask_ref[...] != 0  # [1, block_k]
+        valid = mask_ref[...].reshape(1, block_k) != 0
         mask = mask & jnp.broadcast_to(valid, (block_q, block_k))
     return mask
 
@@ -196,15 +196,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 
 
 def _mask_spec(config, transposed=False):
-    """BlockSpec for the [B, S_pad] key-mask: one (1, block_k) strip per
-    k-block, indexed by the example this program serves."""
+    """BlockSpec for the [B, 1, S_pad] key-mask: one (1, 1, block_k)
+    strip per k-block, indexed by the example this program serves.
+
+    The mask rides with a singleton middle axis so the block's
+    second-to-last dim (1) EQUALS the array dim — Mosaic requires the
+    last two block dims be (divisible by 8, divisible by 128) or equal
+    to the array dims, and a rank-2 [B, S_pad] layout with (1, block_k)
+    blocks violates the sublane rule whenever B > 1 (caught by the
+    round-4 on-TPU parity smoke; interpret mode never checks this)."""
     heads = config.heads
     if transposed:  # dk/dv grid: (b over B*H_kv, j, t)
         heads_kv = config.heads // config.kv_group
-        return pl.BlockSpec((1, config.block_k),
-                            lambda b, j, t: (b // heads_kv, j))
-    return pl.BlockSpec((1, config.block_k),
-                        lambda b, i, j: (b // heads, j))
+        return pl.BlockSpec((1, 1, config.block_k),
+                            lambda b, j, t: (b // heads_kv, 0, j))
+    return pl.BlockSpec((1, 1, config.block_k),
+                        lambda b, i, j: (b // heads, 0, j))
 
 
 def _maybe_mask(config, kernel):
@@ -219,7 +226,7 @@ def _maybe_mask(config, kernel):
 
 def _flash_forward(config, q, k, v, kmask):
     """q: [B*H, S_pad, D]; k/v: [B*H_kv, S_pad, D] (H_kv = H/kv_group);
-    kmask: [B, S_pad] int32 or None ->
+    kmask: [B, 1, S_pad] int32 or None ->
     (out [B*H, S_pad, D], lse [B*H, S_pad, 128]).
 
     GQA streams each kv head's blocks to its group of q-head programs
@@ -570,6 +577,9 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
         kmask = mask.astype(jnp.int32)
         if seq_pad != seq:
             kmask = jnp.pad(kmask, ((0, 0), (0, seq_pad - seq)))
+        # [B, 1, S_pad]: the singleton axis makes the (1, 1, block_k)
+        # mask blocks legal under Mosaic's sublane rule (_mask_spec).
+        kmask = kmask[:, None, :]
         out = _flash_attention_masked(config, fold(q), fold(k), fold(v),
                                       kmask)
     out = out[:, :seq].reshape(batch, heads, seq, head_dim)
