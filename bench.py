@@ -212,8 +212,9 @@ def _run_worker(timeout=None):
             record.setdefault("kernel_parity",
                               "timeout past {:.0f}s".format(timeout))
             # The measurement (and possibly the smoke) completed, but
-            # the process had to be killed: report it, never
-            # green-cache it (same invariant as the rc!=0 path).
+            # the process had to be killed: worker_rc demotes the
+            # record to the annotated cache tier (_cache_rank), same
+            # as the rc!=0 path.
             record["worker_rc"] = "killed after {:.0f}s timeout".format(
                 timeout)
             return record, None
@@ -227,8 +228,9 @@ def _run_worker(timeout=None):
             # on TPU that's the Mosaic-compile failure class the
             # kernel smoke exists to surface; don't report it green.
             # OVERWRITE any kernel_parity the worker printed: even a
-            # passing smoke followed by a teardown crash must not
-            # green-cache a record from a crashed process.
+            # passing smoke followed by a teardown crash must not be
+            # REPORTED as parity-ok; the crash annotation also demotes
+            # the record to the annotated cache tier (_cache_rank).
             tail = (proc.stderr or "").strip().splitlines()
             record["kernel_parity"] = "crashed rc={}: {}".format(
                 proc.returncode, tail[-1][:160] if tail else "")
@@ -239,10 +241,76 @@ def _run_worker(timeout=None):
                                                "rc={}".format(proc.returncode))
 
 
-def _save_last_green(record):
+def _cache_rank(record):
+    """Cache precedence for a record, from its own fields:
+
+    2 — harness capture, parity ok, clean worker exit (fully green);
+    1 — harness capture with honest annotations (kernel_parity failure
+        or worker_rc): the throughput number is real and was measured
+        by this code, but something around it went wrong — cacheable,
+        served stale WITH its annotations, so it can never be mistaken
+        for a fully-green run (ADVICE r3's actual concern);
+    0 — self-reported hand number (the round-2 seed).
+
+    A new record replaces the cache iff its rank >= the cached rank, so
+    a real-but-annotated capture outranks the hand seed and a fresh
+    fully-green run outranks everything, while an annotated run can
+    never shadow an existing fully-green one.
+    """
+    if record.get("self_reported"):
+        return 0
+    if (record.get("kernel_parity", "ok") == "ok"
+            and "worker_rc" not in record):
+        return 2
+    return 1
+
+
+def _series_path(metric):
+    """One cache slot PER METRIC SERIES (base, _s2d, _bf16in, ...):
+    a variant run's record must never evict another series' only
+    fallback record. LAST_GREEN_PATH names the base-series slot;
+    variant slots insert the metric suffix before the extension."""
+    base, ext = os.path.splitext(LAST_GREEN_PATH)
+    if metric.startswith(METRIC):
+        suffix = metric[len(METRIC):]
+    else:  # foreign metric name: still give it its own slot
+        suffix = "_" + metric
+    return base + suffix + ext
+
+
+def _read_slot(path):
+    """The slot's record, or None (missing/corrupt/non-object JSON —
+    a truncated write can still parse as a bare list/string)."""
     try:
-        os.makedirs(os.path.dirname(LAST_GREEN_PATH), exist_ok=True)
-        with open(LAST_GREEN_PATH, "w") as f:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return record if isinstance(record, dict) else None
+
+
+def _maybe_cache(record):
+    """Cache a real-TPU harness capture if it outranks its series' slot.
+
+    Only a real-TPU number is worth serving stale later; a forced-CPU
+    CI run must not shadow the last green TPU run. Rank (above) keeps
+    the slot honest: annotated captures carry their annotations into
+    any later stale emission."""
+    if record.get("platform") != "tpu" or not record.get("value"):
+        return False
+    path = _series_path(record.get("metric", METRIC))
+    cached = _read_slot(path)
+    if cached is not None and _cache_rank(record) < _cache_rank(cached):
+        return False
+    _save_last_green(record, path)
+    return True
+
+
+def _save_last_green(record, path=None):
+    path = path or _series_path(record.get("metric", METRIC))
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
             json.dump(record, f, indent=2)
             f.write("\n")
     except OSError as e:
@@ -251,13 +319,9 @@ def _save_last_green(record):
 
 
 def _load_last_green():
-    """Most recent green record for this metric series, or None."""
-    try:
-        with open(LAST_GREEN_PATH) as f:
-            record = json.load(f)
-    except (OSError, ValueError):
-        return None
-    if record.get("metric") != _metric_name() or not record.get("value"):
+    """Most recent cached record for this run's metric series, or None."""
+    record = _read_slot(_series_path(_metric_name()))
+    if record is None or not record.get("value"):
         return None
     return record
 
@@ -371,21 +435,13 @@ def main():
         measurements += 1
         record, err = _run_worker(timeout=min(WORKER_TIMEOUT_S, remaining()))
         if record is not None:
-            # The parity smoke GATES the green cache: a throughput
-            # number measured alongside a failing/crashing kernel must
-            # not be replayed as green on later tunnel-down days. It is
-            # still printed (annotated) — the measurement is real, the
-            # kernel claim is not.
-            parity = record.get("kernel_parity", "ok")
-            parity_ok = parity == "ok" or os.environ.get(
-                "BENCH_SKIP_KERNEL_PARITY", "0") == "1"
-            # Only a real-TPU number is worth serving stale later; a
-            # forced-CPU CI run must not shadow the last green TPU run,
-            # and a record salvaged from a crashed/killed worker
-            # (worker_rc present) must not be replayed as green.
-            if (record.get("platform") == "tpu" and parity_ok
-                    and "worker_rc" not in record):
-                _save_last_green(record)
+            # Tiered green cache (_cache_rank): a fully-green record
+            # (parity ok, clean exit) replaces anything; a capture with
+            # honest annotations (parity failure, worker_rc) replaces
+            # the hand seed or an older annotated capture but never a
+            # fully-green one, and its annotations travel into any
+            # later stale emission.
+            _maybe_cache(record)
             _print_record(record)
             return
         last_err = err
