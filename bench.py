@@ -319,9 +319,17 @@ def _save_last_green(record, path=None):
 
 
 def _load_last_green():
-    """Most recent cached record for this run's metric series, or None."""
+    """Most recent cached record for this run's metric series, or None.
+
+    The metric guard stays even with per-series slots: a legacy
+    single-slot cache file (pre-round-4 code wrote every series to
+    LAST_GREEN_PATH) may hold a variant record at the base path, and a
+    cross-series number must never be replayed as this series' stale
+    fallback."""
     record = _read_slot(_series_path(_metric_name()))
     if record is None or not record.get("value"):
+        return None
+    if record.get("metric") != _metric_name():
         return None
     return record
 

@@ -209,10 +209,15 @@ class OptimizerClient:
                                                  self.region)
 
 
-#: Bundled subset of the Vizier REST surface (the reference ships a full
-#: pinned discovery document, tuner/constants.py:20-22 +
-#: optimizer_client.py:404-411; ours is hand-authored and covers exactly
-#: the methods OptimizerClient calls).
+#: Bundled pinned Vizier REST surface. The reference ships the full
+#: discovery document (tuner/constants.py:20-22 +
+#: optimizer_client.py:404-411); ours is hand-authored but covers every
+#: method the reference's document exposes (projects.operations.* and
+#: projects.locations.{operations,studies,studies.trials}.*, plus
+#: locations-level operations.list which the reference's doc lacks), so
+#: no client call can fall off the offline path. The pinned-surface
+#: test (tests/unit/test_tuner.py::TestPinnedDiscoverySurface) holds a
+#: reflection guard over OptimizerClient to keep it that way.
 PINNED_DISCOVERY_PATH = os.path.join(
     os.path.dirname(__file__), "api", "vizier_v1_discovery.json")
 
