@@ -3,10 +3,14 @@
 The bridge from the open-checkpoint ecosystem to this framework's
 TPU-native Llama implementation (no reference equivalent — the
 reference loads Keras SavedModels only, SURVEY §2.1 #18-19). Converts a
-`transformers` `LlamaForCausalLM` (or its raw state_dict + config) into
-the flax param pytree `cloud_tpu.models.LlamaLM` expects, building the
-model with `rope_style="rotate_half"` — the pairing the checkpoint's
-q/k projections were trained against (llama.py:apply_rope).
+`transformers` `LlamaForCausalLM`/`MistralForCausalLM` (or its raw
+state_dict + config) into the flax param pytree
+`cloud_tpu.models.LlamaLM` expects, building the model with
+`rope_style="rotate_half"` — the pairing the checkpoint's q/k
+projections were trained against (llama.py:apply_rope). Config
+features carried through: GQA, rms_norm_eps, rope_theta, Llama-3.1 /
+linear `rope_scaling`, Mistral `sliding_window` (banded flash kernel +
+decode band mask), and Mistral-Nemo decoupled `head_dim`.
 
 Layout mapping (HF torch [out, in] row-major vs flax [in, out(+split)]):
 
@@ -34,7 +38,39 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from cloud_tpu.models.llama import LlamaLM
+from cloud_tpu.models.llama import LlamaLM, RopeScaling
+
+
+def _translate_rope_scaling(hf_scaling):
+    """HF `rope_scaling` config dict -> RopeScaling (or None).
+
+    Supports the "llama3" banded scheme (Llama-3.1 family) and plain
+    "linear" position compression; "default" means no transform. Other
+    schemes (yarn, dynamic, longrope) change the rotation math in ways
+    apply_rope does not implement — rejected loudly rather than
+    silently mis-rotating.
+    """
+    if not hf_scaling:
+        return None
+    if not isinstance(hf_scaling, dict):
+        hf_scaling = dict(hf_scaling)
+    kind = hf_scaling.get("rope_type", hf_scaling.get("type", ""))
+    if kind == "default":
+        return None
+    if kind == "linear":
+        return RopeScaling(kind="linear",
+                           factor=float(hf_scaling["factor"]))
+    if kind == "llama3":
+        return RopeScaling(
+            kind="llama3",
+            factor=float(hf_scaling["factor"]),
+            low_freq_factor=float(hf_scaling["low_freq_factor"]),
+            high_freq_factor=float(hf_scaling["high_freq_factor"]),
+            original_max_len=int(
+                hf_scaling["original_max_position_embeddings"]))
+    raise NotImplementedError(
+        "This checkpoint uses rope_scaling={!r}; only 'llama3', "
+        "'linear', and 'default' import.".format(hf_scaling))
 
 
 def _to_numpy(tensor):
@@ -96,39 +132,20 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     layers = cfg("num_hidden_layers")
     head_dim = d_model // heads
     explicit_head_dim = cfg("head_dim", False)
-    if explicit_head_dim and explicit_head_dim != head_dim:
-        # Mistral-Nemo-style decoupled head_dim: GQAttention derives
-        # head_dim from d_model // num_heads, so these checkpoints
-        # cannot map — reject clearly instead of dying in a reshape.
-        raise NotImplementedError(
-            "This checkpoint uses an explicit head_dim={} != "
-            "hidden_size//num_attention_heads={}, which LlamaLM's "
-            "attention does not support.".format(
-                explicit_head_dim, head_dim))
+    if explicit_head_dim:
+        # Mistral-Nemo-style decoupled head_dim: the attention width is
+        # independent of hidden_size//num_heads; GQAttention takes it
+        # as an explicit field and the out projection maps back.
+        head_dim = int(explicit_head_dim)
 
+    # Mistral-style sliding-window attention: mapped onto the flash
+    # kernel's banded causal path (ops.attention window=; the decode
+    # cache masks the same band), so the imported model attends exactly
+    # the keys the checkpoint was trained on at any sequence length.
     window = cfg("sliding_window", False)
     horizon = max_seq_len or cfg("max_position_embeddings", 2048)
-    if window and window < horizon:
-        # Mistral-style checkpoints were trained with sliding-window
-        # attention; LlamaLM's full causal attention would attend to
-        # tokens the checkpoint never saw for sequences past the
-        # window — silently wrong logits. Importing is fine when usage
-        # stays within the window.
-        raise NotImplementedError(
-            "This checkpoint uses sliding-window attention "
-            "(window={}), which LlamaLM does not implement; pass "
-            "max_seq_len <= {} to import for within-window use."
-            .format(window, window))
 
-    rope_scaling = cfg("rope_scaling", False)
-    if rope_scaling:
-        # Llama-3.1-style frequency scaling changes the rotation math,
-        # not just the layout; importing would silently mis-rotate the
-        # low-frequency components.
-        raise NotImplementedError(
-            "This checkpoint uses rope_scaling={!r}, which "
-            "import_hf_llama does not implement; only plain "
-            "theta-parameterized RoPE imports.".format(rope_scaling))
+    rope_scaling = _translate_rope_scaling(cfg("rope_scaling", False))
 
     consumed = set()
 
@@ -204,6 +221,9 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         norm_eps=float(cfg("rms_norm_eps", 1e-6)),
         compute_dtype=compute_dtype,
         attention_impl=attention_impl,
+        head_dim=(head_dim if head_dim != d_model // heads else None),
+        rope_scaling=rope_scaling,
+        sliding_window=(int(window) if window else None),
     )
     return lm, {"params": params}
 

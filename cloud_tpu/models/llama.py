@@ -18,6 +18,15 @@ framework needs the architecture family that today's open checkpoints
   memory bound) by H/H_kv while the q heads keep full MXU tiles. K/V
   are broadcast to the q-head grouping only at the attention op, never
   stored expanded.
+- **Sliding-window attention** (`sliding_window=`): Mistral-style
+  banded causal masking, mapped onto the flash kernel's tile-skip grid
+  (ops.attention window=) in training and the cache band mask in
+  decode.
+- **RoPE frequency scaling** (`rope_scaling=RopeScaling(...)`):
+  Llama-3.1 "llama3" banded scheme and plain linear compression for
+  long-context checkpoints.
+- **Decoupled head_dim** (`head_dim=`): attention width independent of
+  d_model/num_heads (Mistral-Nemo-style checkpoints).
 
 `LlamaLM` keeps `TransformerLM`'s module contract (same attribute
 names, same "cache" collection shape conventions), so `generate()` —
@@ -34,7 +43,7 @@ learned q/k projections. To run imported weights, build the model with
 this for you and converts HF param layouts to this module's.
 """
 
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -44,8 +53,52 @@ from jax.sharding import PartitionSpec as P
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
 
 
+class RopeScaling(NamedTuple):
+    """Long-context RoPE frequency-scaling recipe (HF `rope_scaling`).
+
+    kind selects the transform applied to the base inv-frequencies:
+      - "linear": every frequency divided by `factor` (positions
+        effectively compressed by `factor`).
+      - "llama3": Llama-3.1's banded scheme — high frequencies (short
+        wavelengths, local syntax) untouched, low frequencies (long
+        wavelengths, past `original_max_len`) divided by `factor`, a
+        smooth interpolation between the `high_freq_factor` and
+        `low_freq_factor` wavelength cutoffs.
+
+    A NamedTuple (not a dict) so flax module fields carrying it stay
+    hashable/comparable; `models.hf_import` translates the HF config
+    dict form.
+    """
+    kind: str
+    factor: float
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_len: int = 8192
+
+
+def _scale_rope_freqs(freqs, scaling: RopeScaling):
+    """Applies a RopeScaling recipe to base inv-frequencies [D/2]."""
+    if scaling.kind == "linear":
+        return freqs / scaling.factor
+    if scaling.kind == "llama3":
+        wavelen = 2.0 * np.pi / freqs
+        low_wl = scaling.original_max_len / scaling.low_freq_factor
+        high_wl = scaling.original_max_len / scaling.high_freq_factor
+        smooth = ((scaling.original_max_len / wavelen
+                   - scaling.low_freq_factor)
+                  / (scaling.high_freq_factor - scaling.low_freq_factor))
+        blended = (1.0 - smooth) * freqs / scaling.factor + smooth * freqs
+        return jnp.where(
+            wavelen < high_wl, freqs,
+            jnp.where(wavelen > low_wl, freqs / scaling.factor, blended))
+    raise ValueError(
+        "Unknown RopeScaling kind {!r}; expected 'linear' or "
+        "'llama3'.".format(scaling.kind))
+
+
 def apply_rope(x, positions, theta: float = 10000.0,
-               style: str = "interleaved"):
+               style: str = "interleaved",
+               scaling: Optional[RopeScaling] = None):
     """Rotary position embedding over the last (head_dim) axis.
 
     x: [B, S, H, D] (D even); positions: [S] or [B, S] int32.
@@ -67,6 +120,8 @@ def apply_rope(x, positions, theta: float = 10000.0,
         raise ValueError("RoPE needs an even head_dim; got %d." % head_dim)
     freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32)
                       / head_dim)
+    if scaling is not None:
+        freqs = _scale_rope_freqs(freqs, scaling)
     if positions.ndim == 1:
         positions = positions[None, :]
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
@@ -106,13 +161,23 @@ class GQAttention(nn.Module):
     rope_style: str = "interleaved"  # 'rotate_half' for HF-layout weights
     decode: bool = False
     cache_len: int = 0
+    head_dim: Optional[int] = None  # None -> d_model // num_heads
+    rope_scaling: Optional[RopeScaling] = None
+    sliding_window: Optional[int] = None  # Mistral-style band width
+
+    def _rope(self, x, positions):
+        return apply_rope(x, positions, self.rope_theta, self.rope_style,
+                          self.rope_scaling)
 
     @nn.compact
     def __call__(self, x, mask=None):
         from cloud_tpu import ops
 
         d_model = x.shape[-1]
-        head_dim = d_model // self.num_heads
+        # Decoupled head_dim (Mistral-Nemo-style checkpoints): the
+        # attention width need not be d_model/H; the out projection
+        # maps H*head_dim back to d_model either way.
+        head_dim = self.head_dim or d_model // self.num_heads
         dense = lambda feats, name: nn.DenseGeneral(
             feats, axis=-1, use_bias=False, dtype=self.compute_dtype,
             name=name)
@@ -128,9 +193,14 @@ class GQAttention(nn.Module):
             out = self._decode_attention(q, k, v)
         else:
             positions = jnp.arange(x.shape[1])
-            q = apply_rope(q, positions, self.rope_theta, self.rope_style)
-            k = apply_rope(k, positions, self.rope_theta, self.rope_style)
+            q = self._rope(q, positions)
+            k = self._rope(k, positions)
             if self.attention_impl in SEQUENCE_PARALLEL_IMPLS:
+                if self.sliding_window:
+                    raise NotImplementedError(
+                        "sliding_window is not supported by the "
+                        "sequence-parallel impls ({}); use flash/"
+                        "reference/auto.".format(self.attention_impl))
                 # RoPE composes with sequence parallelism for free: the
                 # rotation above ran on the *global* [B, S, H, D] arrays
                 # (traced shapes under jit are global), so every shard
@@ -143,6 +213,7 @@ class GQAttention(nn.Module):
             else:
                 # flash/reference take the grouped H_kv layout natively.
                 out = ops.attention(q, k, v, causal=True, mask=mask,
+                                    window=self.sliding_window,
                                     impl=self.attention_impl)
         out = out.astype(self.compute_dtype)
         return nn.DenseGeneral(d_model, axis=(-2, -1), use_bias=False,
@@ -175,8 +246,8 @@ class GQAttention(nn.Module):
 
         idx = index.value
         positions = idx + jnp.arange(seq)
-        q = apply_rope(q, positions, self.rope_theta, self.rope_style)
-        k = apply_rope(k, positions, self.rope_theta, self.rope_style)
+        q = self._rope(q, positions)
+        k = self._rope(k, positions)
 
         cached_k.value = lax.dynamic_update_slice(
             cached_k.value, k.astype(self.compute_dtype), (0, idx, 0, 0))
@@ -186,6 +257,14 @@ class GQAttention(nn.Module):
 
         key_positions = jnp.arange(self.cache_len)
         allowed = key_positions[None, :] <= positions[:, None]  # [S, L]
+        if self.sliding_window:
+            # Same band as the training-time kernel: keys in
+            # (pos - window, pos]. Cached entries older than the window
+            # are masked (not evicted — the cache stays positionally
+            # addressed; rolling eviction is a memory optimization this
+            # path doesn't need at cache_len scale).
+            allowed = allowed & (key_positions[None, :]
+                                 > positions[:, None] - self.sliding_window)
         scale = 1.0 / np.sqrt(head_dim)
         group = self.num_heads // self.num_kv_heads
         # Grouped einsum: q reshaped [B,S,H_kv,G,D] attends its own kv
@@ -228,6 +307,9 @@ class LlamaBlock(nn.Module):
     dropout_rate: float = 0.0
     decode: bool = False
     cache_len: int = 0
+    head_dim: Optional[int] = None
+    rope_scaling: Optional[RopeScaling] = None
+    sliding_window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
@@ -237,7 +319,11 @@ class LlamaBlock(nn.Module):
                         self.compute_dtype, self.attention_impl,
                         self.rope_theta, rope_style=self.rope_style,
                         decode=self.decode,
-                        cache_len=self.cache_len, name="attention")(y, mask)
+                        cache_len=self.cache_len,
+                        head_dim=self.head_dim,
+                        rope_scaling=self.rope_scaling,
+                        sliding_window=self.sliding_window,
+                        name="attention")(y, mask)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         x = x + y
@@ -271,6 +357,9 @@ class LlamaLM(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
     attention_impl: str = "auto"
     decode: bool = False
+    head_dim: Optional[int] = None  # None -> d_model // num_heads
+    rope_scaling: Optional[RopeScaling] = None  # long-context extension
+    sliding_window: Optional[int] = None  # Mistral-style band width
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -289,6 +378,9 @@ class LlamaLM(nn.Module):
                            self.norm_eps, self.dropout_rate,
                            decode=self.decode,
                            cache_len=self.max_seq_len,
+                           head_dim=self.head_dim,
+                           rope_scaling=self.rope_scaling,
+                           sliding_window=self.sliding_window,
                            name="block_%d" % i)(x, mask, deterministic)
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
                        name="norm_final")(x)

@@ -51,6 +51,7 @@ class _Config(NamedTuple):
     has_mask: bool  # per-example key mask streamed as [B, 1, S_pad] blocks
     interpret: bool
     kv_group: int = 1  # q heads per kv head (grouped-query attention)
+    window: int = 0  # sliding-window width; 0 = full causal
 
 
 def repeat_kv(k, num_heads):
@@ -72,13 +73,16 @@ def repeat_kv(k, num_heads):
     return jnp.repeat(k, num_heads // h_kv, axis=2)
 
 
-def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None):
+def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None,
+                  window=None):
     """Pure-jnp multi-head attention, layout [B, S, H, D].
 
     The correctness oracle for the kernel and the fallback path for
     shapes/backends the kernel does not cover. Grouped-query attention:
     k/v may carry H_kv < H heads (H divisible by H_kv); they are
-    broadcast to the q-head grouping here.
+    broadcast to the q-head grouping here. window: sliding-window
+    (Mistral-style) attention — row i attends keys (i-window, i];
+    requires causal=True.
     """
     head_dim = q.shape[-1]
     if sm_scale is None:
@@ -86,6 +90,9 @@ def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None):
     if v.shape != k.shape:
         raise ValueError("k and v must have identical shapes; got "
                          "{} vs {}.".format(k.shape, v.shape))
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True.")
     if k.shape[2] != q.shape[2]:
         heads, h_kv = q.shape[2], k.shape[2]
         if heads % h_kv:
@@ -99,6 +106,12 @@ def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None):
     seq_q, seq_k = q.shape[1], k.shape[1]
     if causal:
         allowed = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool))
+        if window is not None:
+            # Band: col in (row - window, row]. HF Mistral's convention
+            # (sliding_window keys INCLUDING self are visible).
+            row = jnp.arange(seq_q)[:, None]
+            col = jnp.arange(seq_k)[None, :]
+            allowed = allowed & (col > row - int(window))
         logits = jnp.where(allowed, logits, _NEG_INF)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :], logits, _NEG_INF)
@@ -134,10 +147,27 @@ def _block_mask(config, qi, ki, mask_ref):
         row = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         mask = mask & (col <= row)
+        if config.window:
+            # Sliding-window band: col in (row - window, row] — the HF
+            # Mistral convention (window keys visible including self).
+            mask = mask & (col > row - config.window)
     if mask_ref is not None:
         valid = mask_ref[...].reshape(1, block_k) != 0
         mask = mask & jnp.broadcast_to(valid, (block_q, block_k))
     return mask
+
+
+def _tile_live(config, qi, ki):
+    """Causal tile-skip condition: a (qi, ki) tile runs only if it
+    intersects the visible region — at or below the diagonal, and
+    (with a sliding window) not entirely below the band."""
+    cond = (ki * config.block_k <= qi * config.block_q
+            + config.block_q - 1)
+    if config.window:
+        cond = jnp.logical_and(
+            cond, (ki + 1) * config.block_k - 1
+            > qi * config.block_q - config.window)
+    return cond
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
@@ -178,9 +208,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
 
     if config.causal:
-        # Blocks strictly above the diagonal contribute nothing: skip.
-        @pl.when(ki * config.block_k <= qi * config.block_q
-                 + config.block_q - 1)
+        @pl.when(_tile_live(config, qi, ki))
         def _masked_step():
             _step()
     else:
@@ -316,8 +344,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if config.causal:
-        @pl.when(ki * config.block_k <= qi * config.block_q
-                 + config.block_q - 1)
+        @pl.when(_tile_live(config, qi, ki))
         def _masked_step():
             _step()
     else:
@@ -363,8 +390,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if config.causal:
-        @pl.when(ki * config.block_k <= qi * config.block_q
-                 + config.block_q - 1)
+        @pl.when(_tile_live(config, qi, ki))
         def _masked_step():
             _step()
     else:
@@ -494,7 +520,7 @@ _flash_attention_masked.defvjp(_flash_attention_masked_fwd,
 
 
 def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
-                    block_q=None, block_k=None,
+                    window=None, block_q=None, block_k=None,
                     interpret: Optional[bool] = None):
     """Blockwise flash attention, layout [batch, seq, heads, head_dim].
 
@@ -506,6 +532,11 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
             the kernel streams kv blocks per group instead of
             materializing the H-wide expansion in HBM.
         causal: Apply a causal (autoregressive) mask.
+        window: Sliding-window (Mistral-style) attention — row i
+            attends keys in (i-window, i]; requires causal=True. Tiles
+            entirely below the band are skipped in the grid
+            (_tile_live), so long-sequence cost scales with S*window,
+            not S^2.
         sm_scale: Softmax temperature; default 1/sqrt(D).
         mask: Optional [B, S] boolean key mask (True = attend). The
             padded-batch fast path: masked keys are excluded inside the
@@ -537,6 +568,9 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
                 heads, h_kv))
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True.")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_q is None:
@@ -557,7 +591,8 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
                      block_q=block_q, block_k=block_k, kv_len=seq,
                      heads=heads, has_mask=mask is not None,
                      interpret=bool(interpret),
-                     kv_group=heads // h_kv)
+                     kv_group=heads // h_kv,
+                     window=int(window or 0))
 
     def fold(x):
         n_heads = x.shape[2]
@@ -586,23 +621,25 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
-def attention(q, k, v, causal=True, sm_scale=None, mask=None, impl="auto"):
+def attention(q, k, v, causal=True, sm_scale=None, mask=None,
+              window=None, impl="auto"):
     """Dispatching attention: pallas flash kernel or jnp reference.
 
     impl: "auto" picks the flash kernel on TPU (with or without a key
     mask — padded batches stay on the fast path), the jnp reference
-    elsewhere; "flash"/"reference" force a path.
+    elsewhere; "flash"/"reference" force a path. window: sliding-window
+    width (both paths honor it; requires causal=True).
     """
     if impl == "flash":
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               mask=mask)
+                               mask=mask, window=window)
     if impl == "reference":
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
-                             mask=mask)
+                             mask=mask, window=window)
     if impl != "auto":
         raise ValueError("Unknown attention impl: {!r}".format(impl))
     if jax.default_backend() == "tpu":
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               mask=mask)
+                               mask=mask, window=window)
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
-                         mask=mask)
+                         mask=mask, window=window)

@@ -130,9 +130,48 @@ class TestHFImport:
             lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
         np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
 
-    def test_rope_scaling_rejected(self, transformers, torch):
+    def test_rope_scaling_llama3_matches_torch(self, transformers,
+                                               torch):
+        """Llama-3.1-style banded frequency scaling: logits parity
+        against transformers' own llama3 rope implementation."""
+        hf = _tiny_hf_llama(
+            transformers, torch,
+            max_position_embeddings=32,
+            rope_scaling={"rope_type": "llama3", "factor": 2.0,
+                          "low_freq_factor": 1.0,
+                          "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 16},
+        ).eval()
+        tokens = np.random.default_rng(3).integers(0, 64, size=(2, 24))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.rope_scaling is not None
+        assert lm.rope_scaling.kind == "llama3"
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_rope_scaling_linear_matches_torch(self, transformers,
+                                               torch):
+        hf = _tiny_hf_llama(
+            transformers, torch,
+            rope_scaling={"rope_type": "linear", "factor": 2.0},
+        ).eval()
+        tokens = np.random.default_rng(4).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.rope_scaling.kind == "linear"
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_rope_scaling_yarn_rejected(self, transformers, torch):
+        """Unimplemented schemes must still fail loudly, not silently
+        mis-rotate."""
         hf = _tiny_hf_llama(transformers, torch)
-        hf.config.rope_scaling = {"rope_type": "llama3", "factor": 8.0}
+        hf.config.rope_scaling = {"rope_type": "yarn", "factor": 8.0}
         with pytest.raises(NotImplementedError, match="rope_scaling"):
             import_hf_llama(hf)
 
@@ -145,11 +184,40 @@ class TestHFImport:
         with pytest.raises(ValueError, match="bias"):
             import_hf_llama(state_dict=sd, config=hf.config)
 
-    def test_sliding_window_rejected(self, transformers, torch):
-        hf = _tiny_hf_llama(transformers, torch)
-        hf.config.sliding_window = 8  # < max_position_embeddings=32
-        with pytest.raises(NotImplementedError, match="sliding"):
-            import_hf_llama(hf)
-        # Within-window use imports fine.
-        lm, _ = import_hf_llama(hf, max_seq_len=8)
-        assert lm.max_seq_len == 8
+    def test_sliding_window_matches_torch(self, transformers, torch):
+        """Mistral-style sliding-window checkpoint: logits parity at a
+        sequence length PAST the window, where the band actually
+        binds."""
+        config = transformers.MistralConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            rope_theta=10000.0, rms_norm_eps=1e-6,
+            sliding_window=4, tie_word_embeddings=False,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.MistralForCausalLM(config).eval()
+        tokens = np.random.default_rng(5).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.sliding_window == 4
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_decoupled_head_dim_matches_torch(self, transformers,
+                                              torch):
+        """Mistral-Nemo-style explicit head_dim != hidden/heads."""
+        hf = _tiny_hf_llama(transformers, torch, head_dim=16).eval()
+        # 4 heads x head_dim 16 = 64 != hidden_size 32: truly decoupled.
+        assert (hf.config.head_dim * hf.config.num_attention_heads
+                != hf.config.hidden_size)
+        tokens = np.random.default_rng(6).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.head_dim == 16
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)

@@ -191,6 +191,44 @@ class TestLlamaDecode:
             cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(toks), np.asarray(cur))
 
+    def test_greedy_matches_oracle_with_sliding_window(self):
+        """The decode cache's band mask must agree with the forward
+        pass's banded kernel — decode generates past the window so the
+        band binds."""
+        model = _model(sliding_window=4)
+        prompt = _prompt()
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        toks = generate(model, params, prompt, max_new_tokens=8,
+                        temperature=0)
+        cur = prompt
+        for _ in range(8):
+            logits = model.apply({"params": params}, cur)
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(cur))
+
+    def test_greedy_matches_oracle_with_rope_scaling(self):
+        """Scaled-RoPE decode must continue the same rotation as the
+        forward pass (absolute cache positions through the scaled
+        frequency table)."""
+        from cloud_tpu.models.llama import RopeScaling
+        scaling = RopeScaling(kind="llama3", factor=2.0,
+                              low_freq_factor=1.0, high_freq_factor=4.0,
+                              original_max_len=16)
+        model = _model(rope_scaling=scaling)
+        prompt = _prompt()
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        toks = generate(model, params, prompt, max_new_tokens=6,
+                        temperature=0)
+        cur = prompt
+        for _ in range(6):
+            logits = model.apply({"params": params}, cur)
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(cur))
+
     def test_greedy_parity_bf16(self):
         model = _model(compute_dtype=jnp.bfloat16)
         prompt = _prompt()

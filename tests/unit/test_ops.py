@@ -297,3 +297,85 @@ def test_block_size_env_override(monkeypatch):
     monkeypatch.setenv("CLOUD_TPU_FLASH_BLOCK_Q", "192")
     with pytest.raises(ValueError, match="divide"):
         flash_attention(q, k, v, causal=True, interpret=True)
+
+
+class TestSlidingWindow:
+    """window=: banded causal attention (Mistral convention — row i
+    attends keys in (i-window, i]). The reference is checked against a
+    dense explicit-band oracle; the kernel against the reference,
+    including the tile-skip guard (_tile_live) at window widths that
+    kill whole tiles."""
+
+    def _dense_band(self, q, k, v, window):
+        seq = q.shape[1]
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        row = jnp.arange(seq)[:, None]
+        col = jnp.arange(seq)[None, :]
+        allowed = (col <= row) & (col > row - window)
+        logits = jnp.where(allowed, logits, -1e30)
+        weights = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+    @pytest.mark.parametrize("window", [1, 17, 128, 300])
+    def test_reference_matches_dense_band(self, window):
+        q, k, v = _qkv()
+        ref = mha_reference(q, k, v, causal=True, window=window)
+        oracle = self._dense_band(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(oracle),
+                                   atol=TOL, rtol=TOL)
+
+    @pytest.mark.parametrize("window", [1, 17, 128, 300])
+    def test_flash_matches_reference(self, window):
+        q, k, v = _qkv()
+        ref = mha_reference(q, k, v, causal=True, window=window)
+        out = flash_attention(q, k, v, causal=True, window=window,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=TOL, rtol=TOL)
+
+    def test_flash_gradients_match_reference(self):
+        q, k, v = _qkv(seed=3)
+        window = 48
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, causal=True, window=window,
+                                   interpret=True).sum()
+
+        def loss_ref(q, k, v):
+            return mha_reference(q, k, v, causal=True,
+                                 window=window).sum()
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_window_with_gqa_and_key_mask(self):
+        q, _, _ = _qkv(batch=2, heads=4, seed=4)
+        rng = np.random.default_rng(5)
+        k, v = (jnp.asarray(rng.normal(size=(2, 256, 2, 64)),
+                            jnp.float32) for _ in range(2))
+        mask = jnp.asarray(
+            np.arange(256)[None, :] < np.array([[256], [200]]))
+        ref = mha_reference(q, k, v, causal=True, window=32, mask=mask)
+        out = flash_attention(q, k, v, causal=True, window=32,
+                              mask=mask, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=TOL, rtol=TOL)
+
+    def test_window_requires_causal(self):
+        q, k, v = _qkv(seq=128)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=8,
+                            interpret=True)
+        with pytest.raises(ValueError, match="causal"):
+            mha_reference(q, k, v, causal=False, window=8)
+
+    def test_dispatcher_forwards_window(self):
+        q, k, v = _qkv(seq=128)
+        ref = mha_reference(q, k, v, causal=True, window=16)
+        out = attention(q, k, v, causal=True, window=16, impl="flash")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=TOL, rtol=TOL)
