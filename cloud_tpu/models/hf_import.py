@@ -10,7 +10,8 @@ state_dict + config) into the flax param pytree
 projections were trained against (llama.py:apply_rope). Config
 features carried through: GQA, rms_norm_eps, rope_theta, Llama-3.1 /
 linear `rope_scaling`, Mistral `sliding_window` (banded flash kernel +
-decode band mask), and Mistral-Nemo decoupled `head_dim`.
+decode band mask), Mistral-Nemo decoupled `head_dim`, and Qwen2-style
+q/k/v biases (detected from the state_dict).
 
 Layout mapping (HF torch [out, in] row-major vs flax [in, out(+split)]):
 
@@ -147,6 +148,12 @@ def import_hf_llama(model=None, state_dict=None, config=None,
 
     rope_scaling = _translate_rope_scaling(cfg("rope_scaling", False))
 
+    # Qwen2-style biased q/k/v projections (o_proj and the MLP stay
+    # bias-free in that family). Detected from the weights themselves —
+    # config attribute names differ across families (attention_bias vs
+    # implicit) but the state_dict does not lie.
+    qkv_bias = "model.layers.0.self_attn.q_proj.bias" in state_dict
+
     consumed = set()
 
     def take(name):
@@ -174,7 +181,14 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         def proj(name, n_heads):
             # [n*hd, d] row-major -> [d, n, hd] flax DenseGeneral.
             w = take(hf + "self_attn.{}_proj.weight".format(name))
-            return w.reshape(n_heads, head_dim, d_model).transpose(2, 0, 1)
+            entry = {"kernel": w.reshape(
+                n_heads, head_dim, d_model).transpose(2, 0, 1)}
+            if qkv_bias:
+                # [n*hd] -> [n, hd] (DenseGeneral bias matches features)
+                entry["bias"] = take(
+                    hf + "self_attn.{}_proj.bias".format(name)
+                ).reshape(n_heads, head_dim)
+            return entry
 
         o = take(hf + "self_attn.o_proj.weight")  # [d, H*hd]
         params["block_%d" % i] = {
@@ -182,9 +196,9 @@ def import_hf_llama(model=None, state_dict=None, config=None,
             "norm_mlp": {
                 "scale": take(hf + "post_attention_layernorm.weight")},
             "attention": {
-                "query": {"kernel": proj("q", heads)},
-                "key": {"kernel": proj("k", kv_heads)},
-                "value": {"kernel": proj("v", kv_heads)},
+                "query": proj("q", heads),
+                "key": proj("k", kv_heads),
+                "value": proj("v", kv_heads),
                 "out": {"kernel": o.T.reshape(heads, head_dim, d_model)},
             },
             "mlp": {
@@ -195,10 +209,10 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         }
 
     # Every parameter in the checkpoint must have landed somewhere:
-    # silently dropping e.g. Qwen-style q/k/v biases would produce a
-    # model whose logits are wrong with nothing flagging it. (Non-
-    # parameter buffers like rotary inv_freq tables are derivable and
-    # skipped.)
+    # silently dropping an unmapped tensor (an o_proj/MLP bias, a
+    # novel adapter) would produce a model whose logits are wrong with
+    # nothing flagging it. (Non-parameter buffers like rotary inv_freq
+    # tables are derivable and skipped.)
     leftover = sorted(
         name for name in state_dict
         if name not in consumed and "rotary_emb" not in name)
@@ -224,6 +238,7 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         head_dim=(head_dim if head_dim != d_model // heads else None),
         rope_scaling=rope_scaling,
         sliding_window=(int(window) if window else None),
+        qkv_bias=qkv_bias,
     )
     return lm, {"params": params}
 

@@ -164,6 +164,7 @@ class GQAttention(nn.Module):
     head_dim: Optional[int] = None  # None -> d_model // num_heads
     rope_scaling: Optional[RopeScaling] = None
     sliding_window: Optional[int] = None  # Mistral-style band width
+    qkv_bias: bool = False  # Qwen2-style biased q/k/v (out stays bias-free)
 
     def _rope(self, x, positions):
         return apply_rope(x, positions, self.rope_theta, self.rope_style,
@@ -179,8 +180,8 @@ class GQAttention(nn.Module):
         # maps H*head_dim back to d_model either way.
         head_dim = self.head_dim or d_model // self.num_heads
         dense = lambda feats, name: nn.DenseGeneral(
-            feats, axis=-1, use_bias=False, dtype=self.compute_dtype,
-            name=name)
+            feats, axis=-1, use_bias=self.qkv_bias,
+            dtype=self.compute_dtype, name=name)
         q = dense((self.num_heads, head_dim), "query")(x)
         k = dense((self.num_kv_heads, head_dim), "key")(x)
         v = dense((self.num_kv_heads, head_dim), "value")(x)
@@ -310,6 +311,7 @@ class LlamaBlock(nn.Module):
     head_dim: Optional[int] = None
     rope_scaling: Optional[RopeScaling] = None
     sliding_window: Optional[int] = None
+    qkv_bias: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
@@ -323,6 +325,7 @@ class LlamaBlock(nn.Module):
                         head_dim=self.head_dim,
                         rope_scaling=self.rope_scaling,
                         sliding_window=self.sliding_window,
+                        qkv_bias=self.qkv_bias,
                         name="attention")(y, mask)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
@@ -360,6 +363,7 @@ class LlamaLM(nn.Module):
     head_dim: Optional[int] = None  # None -> d_model // num_heads
     rope_scaling: Optional[RopeScaling] = None  # long-context extension
     sliding_window: Optional[int] = None  # Mistral-style band width
+    qkv_bias: bool = False  # Qwen2-style biased q/k/v projections
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -381,6 +385,7 @@ class LlamaLM(nn.Module):
                            head_dim=self.head_dim,
                            rope_scaling=self.rope_scaling,
                            sliding_window=self.sliding_window,
+                           qkv_bias=self.qkv_bias,
                            name="block_%d" % i)(x, mask, deterministic)
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
                        name="norm_final")(x)
@@ -396,6 +401,7 @@ def llama_tensor_parallel_rules(tp_axis: str = "tp"):
     num_kv_heads % tp == 0)."""
     return [
         (r"attention/(query|key|value)/kernel", P(None, tp_axis, None)),
+        (r"attention/(query|key|value)/bias", P(tp_axis, None)),
         (r"attention/out/kernel", P(tp_axis, None, None)),
         (r"mlp/(gate|up)/kernel", P(None, tp_axis)),
         (r"mlp/down/kernel", P(tp_axis, None)),

@@ -176,13 +176,33 @@ class TestHFImport:
             import_hf_llama(hf)
 
     def test_unmapped_bias_params_rejected(self, transformers, torch):
-        """Checkpoints with q/k/v biases (Qwen-style) must fail loudly,
-        not silently drop the biases."""
+        """Tensors the importer cannot place (an o_proj bias here) must
+        fail loudly, not be silently dropped."""
         hf = _tiny_hf_llama(transformers, torch)
         sd = {k: v for k, v in hf.state_dict().items()}
-        sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(32)
+        sd["model.layers.0.self_attn.o_proj.bias"] = torch.zeros(32)
         with pytest.raises(ValueError, match="bias"):
             import_hf_llama(state_dict=sd, config=hf.config)
+
+    def test_qwen2_qkv_bias_matches_torch(self, transformers, torch):
+        """Qwen2-family checkpoints carry q/k/v biases (o_proj and the
+        MLP stay bias-free): logits parity against the torch model."""
+        config = transformers.Qwen2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            rope_theta=10000.0, rms_norm_eps=1e-6,
+            tie_word_embeddings=False)
+        torch.manual_seed(0)
+        hf = transformers.Qwen2ForCausalLM(config).eval()
+        tokens = np.random.default_rng(7).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.qkv_bias is True
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
 
     def test_sliding_window_matches_torch(self, transformers, torch):
         """Mistral-style sliding-window checkpoint: logits parity at a
