@@ -154,6 +154,36 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     # implicit) but the state_dict does not lie.
     qkv_bias = "model.layers.0.self_attn.q_proj.bias" in state_dict
 
+    # Gemma family: GeGLU gate activation, sqrt(d_model)-scaled
+    # embeddings, and the (1 + weight) RMSNorm convention — the last is
+    # a pure reparameterization, folded into the imported scales below.
+    model_type = cfg("model_type", "llama")
+    if model_type in ("gemma2", "gemma3", "gemma3_text"):
+        # Gemma-2/3 add logit softcapping and per-block pre/post norms
+        # this architecture does not model; their extra norm tensors
+        # would also trip the leftover check — reject up front.
+        raise NotImplementedError(
+            "model_type={!r} (extra per-block norms / logit "
+            "softcapping) is not supported; gemma (v1) imports."
+            .format(model_type))
+    is_gemma = model_type == "gemma"
+    act = cfg("hidden_activation", False) or cfg("hidden_act", False) \
+        or ("gelu_pytorch_tanh" if is_gemma else "silu")
+    try:
+        mlp_activation = {"silu": "silu",
+                          "gelu_pytorch_tanh": "gelu_tanh",
+                          "gelu": "gelu"}[act]
+    except KeyError:
+        raise NotImplementedError(
+            "hidden activation {!r} is not supported (silu / "
+            "gelu_pytorch_tanh / gelu import).".format(act))
+
+    def norm_scale(w):
+        # HF Gemma RMSNorm computes x * (1 + weight); flax RMSNorm
+        # computes x * scale. Folding the +1 into the imported scale is
+        # numerically identical.
+        return w + 1.0 if is_gemma else w
+
     consumed = set()
 
     def take(name):
@@ -166,7 +196,7 @@ def import_hf_llama(model=None, state_dict=None, config=None,
 
     params = {
         "embed": {"embedding": take("model.embed_tokens.weight")},
-        "norm_final": {"scale": take("model.norm.weight")},
+        "norm_final": {"scale": norm_scale(take("model.norm.weight"))},
     }
     if "lm_head.weight" in state_dict:
         head_w = take("lm_head.weight").T  # [V, d] -> [d, V]
@@ -192,9 +222,10 @@ def import_hf_llama(model=None, state_dict=None, config=None,
 
         o = take(hf + "self_attn.o_proj.weight")  # [d, H*hd]
         params["block_%d" % i] = {
-            "norm_attn": {"scale": take(hf + "input_layernorm.weight")},
-            "norm_mlp": {
-                "scale": take(hf + "post_attention_layernorm.weight")},
+            "norm_attn": {"scale": norm_scale(
+                take(hf + "input_layernorm.weight"))},
+            "norm_mlp": {"scale": norm_scale(
+                take(hf + "post_attention_layernorm.weight"))},
             "attention": {
                 "query": proj("q", heads),
                 "key": proj("k", kv_heads),
@@ -239,6 +270,8 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         rope_scaling=rope_scaling,
         sliding_window=(int(window) if window else None),
         qkv_bias=qkv_bias,
+        mlp_activation=mlp_activation,
+        scale_embed=is_gemma,
     )
     return lm, {"params": params}
 

@@ -27,6 +27,10 @@ framework needs the architecture family that today's open checkpoints
   long-context checkpoints.
 - **Decoupled head_dim** (`head_dim=`): attention width independent of
   d_model/num_heads (Mistral-Nemo-style checkpoints).
+- **Family switches**: `qkv_bias=` (Qwen2), `mlp_activation=`
+  ("gelu_tanh" GeGLU) + `scale_embed=` (Gemma) — one architecture
+  serves the Llama/Mistral/Qwen/Gemma checkpoint families via
+  `models.hf_import`.
 
 `LlamaLM` keeps `TransformerLM`'s module contract (same attribute
 names, same "cache" collection shape conventions), so `generate()` —
@@ -279,21 +283,40 @@ class GQAttention(nn.Module):
         return out.reshape(batch, seq, self.num_heads, head_dim)
 
 
+_GATE_ACTIVATIONS = {
+    "silu": nn.silu,  # Llama/Mistral/Qwen
+    "gelu_tanh": lambda x: nn.gelu(x, approximate=True),  # Gemma
+    "gelu": lambda x: nn.gelu(x, approximate=False),
+}
+
+
 class SwiGLU(nn.Module):
-    """Gated MLP: down(silu(gate(x)) * up(x)), all bias-free."""
+    """Gated MLP: down(act(gate(x)) * up(x)), all bias-free.
+
+    activation selects the gate nonlinearity: "silu" (the SwiGLU
+    proper, Llama/Mistral/Qwen) or "gelu_tanh"/"gelu" (GeGLU, the
+    Gemma family).
+    """
 
     d_ff: int
     compute_dtype: jnp.dtype = jnp.bfloat16
+    activation: str = "silu"
 
     @nn.compact
     def __call__(self, x):
+        try:
+            act = _GATE_ACTIVATIONS[self.activation]
+        except KeyError:
+            raise ValueError(
+                "Unknown mlp activation {!r}; expected one of {}."
+                .format(self.activation, sorted(_GATE_ACTIVATIONS)))
         gate = nn.Dense(self.d_ff, use_bias=False,
                         dtype=self.compute_dtype, name="gate")(x)
         up = nn.Dense(self.d_ff, use_bias=False,
                       dtype=self.compute_dtype, name="up")(x)
         return nn.Dense(x.shape[-1], use_bias=False,
                         dtype=self.compute_dtype,
-                        name="down")(nn.silu(gate) * up)
+                        name="down")(act(gate) * up)
 
 
 class LlamaBlock(nn.Module):
@@ -312,6 +335,7 @@ class LlamaBlock(nn.Module):
     rope_scaling: Optional[RopeScaling] = None
     sliding_window: Optional[int] = None
     qkv_bias: bool = False
+    mlp_activation: str = "silu"
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
@@ -332,7 +356,8 @@ class LlamaBlock(nn.Module):
         x = x + y
         y = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
                        name="norm_mlp")(x)
-        y = SwiGLU(self.d_ff, self.compute_dtype, name="mlp")(y)
+        y = SwiGLU(self.d_ff, self.compute_dtype,
+                   activation=self.mlp_activation, name="mlp")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         return x + y
@@ -364,6 +389,8 @@ class LlamaLM(nn.Module):
     rope_scaling: Optional[RopeScaling] = None  # long-context extension
     sliding_window: Optional[int] = None  # Mistral-style band width
     qkv_bias: bool = False  # Qwen2-style biased q/k/v projections
+    mlp_activation: str = "silu"  # "gelu_tanh" for the Gemma family
+    scale_embed: bool = False  # Gemma: hidden = embed * sqrt(d_model)
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -375,6 +402,11 @@ class LlamaLM(nn.Module):
         num_kv = self.num_kv_heads or self.num_heads
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
+        if self.scale_embed:
+            # Gemma convention: the normalizer is cast to the compute
+            # dtype BEFORE multiplying (a bf16-rounded sqrt(d), matching
+            # checkpoints trained that way).
+            x = x * jnp.asarray(self.d_model ** 0.5, self.compute_dtype)
         for i in range(self.num_layers):
             x = LlamaBlock(self.num_heads, num_kv, self.d_ff,
                            self.compute_dtype, self.attention_impl,
@@ -386,6 +418,7 @@ class LlamaLM(nn.Module):
                            rope_scaling=self.rope_scaling,
                            sliding_window=self.sliding_window,
                            qkv_bias=self.qkv_bias,
+                           mlp_activation=self.mlp_activation,
                            name="block_%d" % i)(x, mask, deterministic)
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
                        name="norm_final")(x)

@@ -184,6 +184,34 @@ class TestHFImport:
         with pytest.raises(ValueError, match="bias"):
             import_hf_llama(state_dict=sd, config=hf.config)
 
+    def test_gemma_matches_torch(self, transformers, torch):
+        """Gemma v1: GeGLU gate, sqrt(d_model)-scaled embeddings,
+        (1+weight) RMSNorm folded into the imported scales, explicit
+        head_dim, tied embeddings — logits parity."""
+        config = transformers.GemmaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16,
+            max_position_embeddings=32, rms_norm_eps=1e-6)
+        torch.manual_seed(0)
+        hf = transformers.GemmaForCausalLM(config).eval()
+        tokens = np.random.default_rng(8).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.mlp_activation == "gelu_tanh"
+        assert lm.scale_embed is True
+        assert lm.head_dim == 16
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
+
+    def test_gemma2_rejected(self, transformers, torch):
+        hf = _tiny_hf_llama(transformers, torch)
+        config = dict(hf.config.to_dict(), model_type="gemma2")
+        with pytest.raises(NotImplementedError, match="gemma2"):
+            import_hf_llama(state_dict=hf.state_dict(), config=config)
+
     def test_qwen2_qkv_bias_matches_torch(self, transformers, torch):
         """Qwen2-family checkpoints carry q/k/v biases (o_proj and the
         MLP stay bias-free): logits parity against the torch model."""
