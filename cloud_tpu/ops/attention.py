@@ -52,6 +52,7 @@ class _Config(NamedTuple):
     interpret: bool
     kv_group: int = 1  # q heads per kv head (grouped-query attention)
     window: int = 0  # sliding-window width; 0 = full causal
+    softcap: float = 0.0  # Gemma2-style tanh logit cap; 0 = off
 
 
 def repeat_kv(k, num_heads):
@@ -74,7 +75,7 @@ def repeat_kv(k, num_heads):
 
 
 def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None,
-                  window=None):
+                  window=None, logit_softcap=None):
     """Pure-jnp multi-head attention, layout [B, S, H, D].
 
     The correctness oracle for the kernel and the fallback path for
@@ -82,7 +83,9 @@ def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None,
     k/v may carry H_kv < H heads (H divisible by H_kv); they are
     broadcast to the q-head grouping here. window: sliding-window
     (Mistral-style) attention — row i attends keys (i-window, i];
-    requires causal=True.
+    requires causal=True. logit_softcap: Gemma2-style tanh capping,
+    logits -> cap * tanh(logits / cap), applied after the softmax scale
+    and before any masking (the HF Gemma2 order).
     """
     head_dim = q.shape[-1]
     if sm_scale is None:
@@ -103,6 +106,9 @@ def mha_reference(q, k, v, causal=True, sm_scale=None, mask=None,
         v = jnp.repeat(v, heads // h_kv, axis=2)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
     logits = logits.astype(jnp.float32)
+    if logit_softcap:
+        cap = float(logit_softcap)
+        logits = cap * jnp.tanh(logits / cap)
     seq_q, seq_k = q.shape[1], k.shape[1]
     if causal:
         allowed = jnp.tril(jnp.ones((seq_q, seq_k), dtype=bool))
@@ -188,6 +194,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * config.sm_scale
+        if config.softcap:
+            # Gemma2 logit soft-capping, cap * tanh(s / cap) — before
+            # masking (the HF order; masked entries go to -inf either
+            # way, so the capped value never leaks).
+            s = config.softcap * jnp.tanh(s / config.softcap)
         mask = _block_mask(config, qi, ki, mask_ref)
         s = jnp.where(mask, s, _NEG_INF)
 
@@ -309,15 +320,26 @@ def _flash_forward(config, q, k, v, kmask):
 
 
 def _attn_probs(config, qi, ki, q, k, lse_col, mask_ref):
-    """Recomputes the (block_q, block_k) probability block."""
+    """Recomputes the (block_q, block_k) probability block.
+
+    Returns (p, dcap): dcap is the softcap chain-rule factor
+    d(cap*tanh(s/cap))/ds = 1 - tanh^2(s/cap) to fold into dS, or None
+    when soft-capping is off.
+    """
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * config.sm_scale
+    dcap = None
+    if config.softcap:
+        t = jnp.tanh(s / config.softcap)
+        dcap = 1.0 - t * t
+        s = config.softcap * t
     mask = _block_mask(config, qi, ki, mask_ref)
     # Explicit zero (not just -inf logits): a fully-masked row carries
     # lse == -inf and exp(-inf - -inf) == 1 would fabricate mass.
-    return jnp.where(mask, jnp.exp(jnp.where(mask, s, _NEG_INF) - lse_col),
-                     0.0)
+    p = jnp.where(mask, jnp.exp(jnp.where(mask, s, _NEG_INF) - lse_col),
+                  0.0)
+    return p, dcap
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
@@ -334,11 +356,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        p = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1], mask_ref)
+        p, dcap = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1],
+                              mask_ref)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1]) * config.sm_scale
+        if dcap is not None:
+            ds = ds * dcap
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -375,7 +400,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        p = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1], mask_ref)
+        p, dcap = _attn_probs(config, qi, ki, q, k, lse_ref[0][:, :1],
+                              mask_ref)
         # dV += P^T dO   (contract over the q rows)
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -384,6 +410,8 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1]) * config.sm_scale
+        if dcap is not None:
+            ds = ds * dcap
         # dK += dS^T Q
         dk_acc[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -520,8 +548,8 @@ _flash_attention_masked.defvjp(_flash_attention_masked_fwd,
 
 
 def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
-                    window=None, block_q=None, block_k=None,
-                    interpret: Optional[bool] = None):
+                    window=None, logit_softcap=None, block_q=None,
+                    block_k=None, interpret: Optional[bool] = None):
     """Blockwise flash attention, layout [batch, seq, heads, head_dim].
 
     Args:
@@ -538,6 +566,10 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
             (_tile_live), so long-sequence cost scales with S*window,
             not S^2.
         sm_scale: Softmax temperature; default 1/sqrt(D).
+        logit_softcap: Gemma2-style tanh logit capping — logits become
+            cap * tanh(logits / cap) after the softmax scale and before
+            masking (the HF Gemma2 order); the backward kernels fold
+            the tanh derivative into dS. None/0 = off.
         mask: Optional [B, S] boolean key mask (True = attend). The
             padded-batch fast path: masked keys are excluded inside the
             kernel, so Keras-parity workloads with per-example padding
@@ -592,7 +624,8 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
                      heads=heads, has_mask=mask is not None,
                      interpret=bool(interpret),
                      kv_group=heads // h_kv,
-                     window=int(window or 0))
+                     window=int(window or 0),
+                     softcap=float(logit_softcap or 0.0))
 
     def fold(x):
         n_heads = x.shape[2]
@@ -622,24 +655,22 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, mask=None,
 
 
 def attention(q, k, v, causal=True, sm_scale=None, mask=None,
-              window=None, impl="auto"):
+              window=None, logit_softcap=None, impl="auto"):
     """Dispatching attention: pallas flash kernel or jnp reference.
 
     impl: "auto" picks the flash kernel on TPU (with or without a key
     mask — padded batches stay on the fast path), the jnp reference
     elsewhere; "flash"/"reference" force a path. window: sliding-window
-    width (both paths honor it; requires causal=True).
+    width; logit_softcap: Gemma2 tanh capping (both paths honor both).
     """
+    kwargs = dict(causal=causal, sm_scale=sm_scale, mask=mask,
+                  window=window, logit_softcap=logit_softcap)
     if impl == "flash":
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               mask=mask, window=window)
+        return flash_attention(q, k, v, **kwargs)
     if impl == "reference":
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
-                             mask=mask, window=window)
+        return mha_reference(q, k, v, **kwargs)
     if impl != "auto":
         raise ValueError("Unknown attention impl: {!r}".format(impl))
     if jax.default_backend() == "tpu":
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
-                               mask=mask, window=window)
-    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
-                         mask=mask, window=window)
+        return flash_attention(q, k, v, **kwargs)
+    return mha_reference(q, k, v, **kwargs)
