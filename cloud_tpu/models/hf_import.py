@@ -10,8 +10,11 @@ state_dict + config) into the flax param pytree
 projections were trained against (llama.py:apply_rope). Config
 features carried through: GQA, rms_norm_eps, rope_theta, Llama-3.1 /
 linear `rope_scaling`, Mistral `sliding_window` (banded flash kernel +
-decode band mask), Mistral-Nemo decoupled `head_dim`, and Qwen2-style
-q/k/v biases (detected from the state_dict).
+decode band mask), Mistral-Nemo decoupled `head_dim`, Qwen2-style
+q/k/v biases (detected from the state_dict), Gemma2 sandwich norms /
+tanh soft-capping / query_pre_attn_scalar / alternating local-global
+attention, and Gemma3 q/k RMSNorm + dual-theta 5:1 local-global
+layers.
 
 Layout mapping (HF torch [out, in] row-major vs flax [in, out(+split)]):
 
@@ -157,18 +160,29 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     # Gemma family: GeGLU gate activation, sqrt(d_model)-scaled
     # embeddings, and the (1 + weight) RMSNorm convention — the last is
     # a pure reparameterization, folded into the imported scales below.
+    # Gemma2 adds sandwich norms (post-attn/post-MLP), tanh logit
+    # soft-capping (attention + final), a query_pre_attn_scalar softmax
+    # scale, and alternating local/global attention; Gemma3 swaps the
+    # softcaps for per-head q/k RMSNorm, runs 5:1 local:global with a
+    # separate local RoPE theta, and applies rope_scaling to global
+    # layers only (HF gemma3 modeling builds its local rotary from an
+    # unscaled rope_local_base_freq config copy).
     model_type = cfg("model_type", "llama")
-    if model_type in ("gemma2", "gemma3", "gemma3_text"):
-        # Gemma-2/3 add logit softcapping and per-block pre/post norms
-        # this architecture does not model; their extra norm tensors
-        # would also trip the leftover check — reject up front.
+    if model_type == "gemma3":
         raise NotImplementedError(
-            "model_type={!r} (extra per-block norms / logit "
-            "softcapping) is not supported; gemma (v1) imports."
-            .format(model_type))
+            "model_type='gemma3' is the multimodal wrapper; import the "
+            "text tower (model_type='gemma3_text', e.g. "
+            "model.language_model with config.text_config).")
+    is_gemma2 = model_type == "gemma2"
+    is_gemma3 = model_type == "gemma3_text"
+    if is_gemma3 and cfg("use_bidirectional_attention", False):
+        raise NotImplementedError(
+            "use_bidirectional_attention=True (embedding-Gemma) is not "
+            "supported; causal gemma3_text imports.")
     is_gemma = model_type == "gemma"
+    gemma_family = is_gemma or is_gemma2 or is_gemma3
     act = cfg("hidden_activation", False) or cfg("hidden_act", False) \
-        or ("gelu_pytorch_tanh" if is_gemma else "silu")
+        or ("gelu_pytorch_tanh" if gemma_family else "silu")
     try:
         mlp_activation = {"silu": "silu",
                           "gelu_pytorch_tanh": "gelu_tanh",
@@ -182,7 +196,42 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         # HF Gemma RMSNorm computes x * (1 + weight); flax RMSNorm
         # computes x * scale. Folding the +1 into the imported scale is
         # numerically identical.
-        return w + 1.0 if is_gemma else w
+        return w + 1.0 if gemma_family else w
+
+    # Gemma2/3 per-layer attention pattern: HF layer_types (list of
+    # "sliding_attention"/"full_attention") when present, else each
+    # family's documented default (gemma2: alternating starting local;
+    # gemma3: 5 local then 1 global).
+    attn_kinds = None
+    layer_types = cfg("layer_types", False)
+    if layer_types:
+        kinds = {"sliding_attention": "local", "full_attention": "global"}
+        try:
+            attn_kinds = tuple(kinds[t] for t in layer_types)
+        except KeyError:
+            raise NotImplementedError(
+                "Unknown layer_types entries {!r}.".format(
+                    sorted(set(layer_types) - set(kinds))))
+    elif is_gemma2:
+        attn_kinds = tuple(
+            "local" if (i + 1) % 2 else "global" for i in range(layers))
+    elif is_gemma3:
+        pattern = int(cfg("sliding_window_pattern", 6))
+        attn_kinds = tuple(
+            "local" if (i + 1) % pattern else "global"
+            for i in range(layers))
+
+    attn_scale = None
+    if is_gemma2 or is_gemma3:
+        attn_scale = float(cfg("query_pre_attn_scalar")) ** -0.5
+
+    # Mixtral: top-k routed MoE FFN in every block. Imported drop-free
+    # (capacity_factor=None) so inference matches HF exactly — HF
+    # never drops tokens; set a capacity factor for large-scale
+    # fine-tuning and let the aux loss balance load.
+    is_mixtral = model_type == "mixtral"
+    moe_experts = int(cfg("num_local_experts", 8)) if is_mixtral else 0
+    moe_top_k = int(cfg("num_experts_per_tok", 2)) if is_mixtral else 2
 
     consumed = set()
 
@@ -221,23 +270,61 @@ def import_hf_llama(model=None, state_dict=None, config=None,
             return entry
 
         o = take(hf + "self_attn.o_proj.weight")  # [d, H*hd]
-        params["block_%d" % i] = {
+        attention = {
+            "query": proj("q", heads),
+            "key": proj("k", kv_heads),
+            "value": proj("v", kv_heads),
+            "out": {"kernel": o.T.reshape(heads, head_dim, d_model)},
+        }
+        if is_gemma3:
+            # Per-head q/k RMSNorm, scale shared across heads ([hd]).
+            attention["q_norm"] = {"scale": norm_scale(
+                take(hf + "self_attn.q_norm.weight"))}
+            attention["k_norm"] = {"scale": norm_scale(
+                take(hf + "self_attn.k_norm.weight"))}
+        block = {
             "norm_attn": {"scale": norm_scale(
                 take(hf + "input_layernorm.weight"))},
-            "norm_mlp": {"scale": norm_scale(
-                take(hf + "post_attention_layernorm.weight"))},
-            "attention": {
-                "query": proj("q", heads),
-                "key": proj("k", kv_heads),
-                "value": proj("v", kv_heads),
-                "out": {"kernel": o.T.reshape(heads, head_dim, d_model)},
-            },
-            "mlp": {
+            "attention": attention,
+        }
+        if is_mixtral:
+            # block_sparse_moe: gate.weight [E, d] is the router;
+            # experts.{e}.{w1,w3,w2} are the SwiGLU gate/up/down,
+            # stacked on a leading expert dim for TopKMoEMLP.
+            moe = hf + "block_sparse_moe."
+            block["moe"] = {
+                "router": take(moe + "gate.weight").T,  # [d, E]
+                "expert_gate": np.stack([
+                    take(moe + "experts.{}.w1.weight".format(e)).T
+                    for e in range(moe_experts)]),      # [E, d, f]
+                "expert_up": np.stack([
+                    take(moe + "experts.{}.w3.weight".format(e)).T
+                    for e in range(moe_experts)]),
+                "expert_down": np.stack([
+                    take(moe + "experts.{}.w2.weight".format(e)).T
+                    for e in range(moe_experts)]),      # [E, f, d]
+            }
+        else:
+            block["mlp"] = {
                 "gate": {"kernel": take(hf + "mlp.gate_proj.weight").T},
                 "up": {"kernel": take(hf + "mlp.up_proj.weight").T},
                 "down": {"kernel": take(hf + "mlp.down_proj.weight").T},
-            },
-        }
+            }
+        if is_gemma2 or is_gemma3:
+            # Sandwich norms: HF's post_attention_layernorm normalizes
+            # the ATTENTION OUTPUT here (in llama/gemma1 the same name
+            # is the pre-MLP norm), and the pre/post_feedforward pair
+            # brackets the MLP.
+            block["norm_attn_post"] = {"scale": norm_scale(
+                take(hf + "post_attention_layernorm.weight"))}
+            block["norm_mlp"] = {"scale": norm_scale(
+                take(hf + "pre_feedforward_layernorm.weight"))}
+            block["norm_mlp_post"] = {"scale": norm_scale(
+                take(hf + "post_feedforward_layernorm.weight"))}
+        else:
+            block["norm_mlp"] = {"scale": norm_scale(
+                take(hf + "post_attention_layernorm.weight"))}
+        params["block_%d" % i] = block
 
     # Every parameter in the checkpoint must have landed somewhere:
     # silently dropping an unmapped tensor (an o_proj/MLP bias, a
@@ -271,7 +358,28 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         sliding_window=(int(window) if window else None),
         qkv_bias=qkv_bias,
         mlp_activation=mlp_activation,
-        scale_embed=is_gemma,
+        scale_embed=gemma_family,
+        post_block_norms=is_gemma2 or is_gemma3,
+        attn_scale=attn_scale,
+        attn_logit_softcap=(
+            float(cfg("attn_logit_softcapping", 0) or 0) or None
+            if is_gemma2 else None),
+        final_logit_softcap=(
+            float(cfg("final_logit_softcapping", 0) or 0) or None
+            if is_gemma2 else None),
+        qk_norm=is_gemma3,
+        attn_kinds=attn_kinds,
+        rope_theta_local=(float(cfg("rope_local_base_freq", 10000.0))
+                          if is_gemma3 else None),
+        # Gemma3 is the only family whose local layers run an UNSCALED
+        # separate rotary (HF builds rotary_emb_local from an unscaled
+        # rope_local_base_freq config copy); every other family with
+        # layer_types (e.g. Qwen2 use_sliding_window) applies the same
+        # scaled rotary to sliding and full layers alike.
+        rope_scaling_local=(None if is_gemma3 else rope_scaling),
+        moe_experts=moe_experts,
+        moe_top_k=moe_top_k,
+        moe_capacity_factor=None,  # drop-free: exact HF semantics
     )
     return lm, {"params": params}
 

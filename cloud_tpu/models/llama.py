@@ -28,8 +28,11 @@ framework needs the architecture family that today's open checkpoints
 - **Decoupled head_dim** (`head_dim=`): attention width independent of
   d_model/num_heads (Mistral-Nemo-style checkpoints).
 - **Family switches**: `qkv_bias=` (Qwen2), `mlp_activation=`
-  ("gelu_tanh" GeGLU) + `scale_embed=` (Gemma) — one architecture
-  serves the Llama/Mistral/Qwen/Gemma checkpoint families via
+  ("gelu_tanh" GeGLU) + `scale_embed=` (Gemma), `post_block_norms=` +
+  `attn_logit_softcap=`/`final_logit_softcap=` + `attn_scale=` +
+  `attn_kinds=` local/global patterns (Gemma2), `qk_norm=` +
+  `rope_theta_local=` (Gemma3) — one architecture serves the
+  Llama/Mistral/Qwen/Gemma-1/2/3 checkpoint families via
   `models.hf_import`.
 
 `LlamaLM` keeps `TransformerLM`'s module contract (same attribute
@@ -47,7 +50,7 @@ learned q/k projections. To run imported weights, build the model with
 this for you and converts HF param layouts to this module's.
 """
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -169,6 +172,10 @@ class GQAttention(nn.Module):
     rope_scaling: Optional[RopeScaling] = None
     sliding_window: Optional[int] = None  # Mistral-style band width
     qkv_bias: bool = False  # Qwen2-style biased q/k/v (out stays bias-free)
+    attn_scale: Optional[float] = None  # None -> 1/sqrt(head_dim)
+    logit_softcap: Optional[float] = None  # Gemma2 tanh cap on logits
+    qk_norm: bool = False  # Gemma3 per-head RMSNorm on q/k (pre-RoPE)
+    norm_eps: float = 1e-6  # eps for the qk norms
 
     def _rope(self, x, positions):
         return apply_rope(x, positions, self.rope_theta, self.rope_style,
@@ -190,6 +197,15 @@ class GQAttention(nn.Module):
         k = dense((self.num_kv_heads, head_dim), "key")(x)
         v = dense((self.num_kv_heads, head_dim), "value")(x)
 
+        if self.qk_norm:
+            # Gemma3: RMSNorm over head_dim (scale shared across heads),
+            # applied BEFORE RoPE — replaces Gemma2's attention softcap
+            # as the logit-magnitude control.
+            q = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
+                           name="q_norm")(q)
+            k = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
+                           name="k_norm")(k)
+
         if self.decode:
             if mask is not None:
                 raise NotImplementedError(
@@ -201,11 +217,13 @@ class GQAttention(nn.Module):
             q = self._rope(q, positions)
             k = self._rope(k, positions)
             if self.attention_impl in SEQUENCE_PARALLEL_IMPLS:
-                if self.sliding_window:
+                if self.sliding_window or self.logit_softcap or \
+                        self.attn_scale:
                     raise NotImplementedError(
-                        "sliding_window is not supported by the "
-                        "sequence-parallel impls ({}); use flash/"
-                        "reference/auto.".format(self.attention_impl))
+                        "sliding_window / logit_softcap / attn_scale "
+                        "are not supported by the sequence-parallel "
+                        "impls ({}); use flash/reference/auto."
+                        .format(self.attention_impl))
                 # RoPE composes with sequence parallelism for free: the
                 # rotation above ran on the *global* [B, S, H, D] arrays
                 # (traced shapes under jit are global), so every shard
@@ -218,7 +236,9 @@ class GQAttention(nn.Module):
             else:
                 # flash/reference take the grouped H_kv layout natively.
                 out = ops.attention(q, k, v, causal=True, mask=mask,
+                                    sm_scale=self.attn_scale,
                                     window=self.sliding_window,
+                                    logit_softcap=self.logit_softcap,
                                     impl=self.attention_impl)
         out = out.astype(self.compute_dtype)
         return nn.DenseGeneral(d_model, axis=(-2, -1), use_bias=False,
@@ -270,13 +290,16 @@ class GQAttention(nn.Module):
             # path doesn't need at cache_len scale).
             allowed = allowed & (key_positions[None, :]
                                  > positions[:, None] - self.sliding_window)
-        scale = 1.0 / np.sqrt(head_dim)
+        scale = self.attn_scale or 1.0 / np.sqrt(head_dim)
         group = self.num_heads // self.num_kv_heads
         # Grouped einsum: q reshaped [B,S,H_kv,G,D] attends its own kv
         # head — no materialized repeat of the cache.
         qg = q.reshape(batch, seq, self.num_kv_heads, group, head_dim)
         logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, cached_k.value,
                             preferred_element_type=jnp.float32) * scale
+        if self.logit_softcap:
+            cap = float(self.logit_softcap)
+            logits = cap * jnp.tanh(logits / cap)
         logits = jnp.where(allowed[None, None, None], logits, -1e30)
         weights = nn.softmax(logits, axis=-1).astype(self.compute_dtype)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, cached_v.value)
@@ -336,11 +359,19 @@ class LlamaBlock(nn.Module):
     sliding_window: Optional[int] = None
     qkv_bias: bool = False
     mlp_activation: str = "silu"
+    post_norms: bool = False  # Gemma2/3: extra norm after attn and MLP
+    attn_scale: Optional[float] = None
+    logit_softcap: Optional[float] = None
+    qk_norm: bool = False
+    moe_experts: int = 0  # > 0: Mixtral-style top-k MoE replaces the MLP
+    moe_top_k: int = 2
+    moe_capacity_factor: Optional[float] = 2.0  # None = drop-free
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
-        y = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
-                       name="norm_attn")(x)
+        norm = lambda name: nn.RMSNorm(
+            epsilon=self.norm_eps, dtype=self.compute_dtype, name=name)
+        y = norm("norm_attn")(x)
         y = GQAttention(self.num_heads, self.num_kv_heads,
                         self.compute_dtype, self.attention_impl,
                         self.rope_theta, rope_style=self.rope_style,
@@ -350,14 +381,39 @@ class LlamaBlock(nn.Module):
                         rope_scaling=self.rope_scaling,
                         sliding_window=self.sliding_window,
                         qkv_bias=self.qkv_bias,
+                        attn_scale=self.attn_scale,
+                        logit_softcap=self.logit_softcap,
+                        qk_norm=self.qk_norm,
+                        norm_eps=self.norm_eps,
                         name="attention")(y, mask)
+        if self.post_norms:
+            # Gemma2/3 sandwich norms: each sublayer's OUTPUT is
+            # normalized before the residual add (the residual stream
+            # itself stays un-normalized).
+            y = norm("norm_attn_post")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         x = x + y
-        y = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
-                       name="norm_mlp")(x)
-        y = SwiGLU(self.d_ff, self.compute_dtype,
-                   activation=self.mlp_activation, name="mlp")(y)
+        y = norm("norm_mlp")(x)
+        if self.moe_experts:
+            from cloud_tpu.models.moe import TopKMoEMLP
+            y, aux_loss = TopKMoEMLP(
+                num_experts=self.moe_experts, top_k=self.moe_top_k,
+                d_ff=self.d_ff,
+                capacity_factor=self.moe_capacity_factor,
+                compute_dtype=self.compute_dtype,
+                activation=self.mlp_activation, name="moe")(
+                    y, deterministic)
+            # Surfaced via mutable=["losses"] and summed into the
+            # training loss by Trainer, same as TransformerBlock's
+            # Switch-MoE path.
+            self.sow("losses", "moe_aux_loss", aux_loss,
+                     reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0)
+        else:
+            y = SwiGLU(self.d_ff, self.compute_dtype,
+                       activation=self.mlp_activation, name="mlp")(y)
+        if self.post_norms:
+            y = norm("norm_mlp_post")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         return x + y
@@ -391,6 +447,44 @@ class LlamaLM(nn.Module):
     qkv_bias: bool = False  # Qwen2-style biased q/k/v projections
     mlp_activation: str = "silu"  # "gelu_tanh" for the Gemma family
     scale_embed: bool = False  # Gemma: hidden = embed * sqrt(d_model)
+    # Gemma2/3 family switches (all default off):
+    post_block_norms: bool = False  # extra norm after attn/MLP outputs
+    attn_scale: Optional[float] = None  # query_pre_attn_scalar ** -0.5
+    attn_logit_softcap: Optional[float] = None  # Gemma2: 50.0
+    final_logit_softcap: Optional[float] = None  # Gemma2: 30.0
+    qk_norm: bool = False  # Gemma3: per-head RMSNorm on q/k
+    # Per-layer local/global attention pattern, cycled over layers:
+    # e.g. ("local", "global") = Gemma2's alternating sliding/full;
+    # ("local",)*5 + ("global",) = Gemma3's 5:1. "local" layers use the
+    # sliding_window band and (rope_theta_local, rope_scaling_local);
+    # "global" layers attend fully with (rope_theta, rope_scaling).
+    # None = every layer identical (sliding_window applies to all).
+    attn_kinds: Optional[Tuple[str, ...]] = None
+    rope_theta_local: Optional[float] = None  # Gemma3: 10_000
+    rope_scaling_local: Optional[RopeScaling] = None
+    # Mixtral family: top-k routed MoE FFN in every block.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: Optional[float] = 2.0  # None = drop-free
+
+    def _layer_attn(self, i):
+        """(window, theta, scaling) for layer i under attn_kinds."""
+        if self.attn_kinds is None:
+            return self.sliding_window, self.rope_theta, self.rope_scaling
+        kind = self.attn_kinds[i % len(self.attn_kinds)]
+        if kind == "global":
+            return None, self.rope_theta, self.rope_scaling
+        if kind != "local":
+            raise ValueError(
+                "attn_kinds entries must be 'local' or 'global'; got "
+                "{!r}.".format(kind))
+        if not self.sliding_window:
+            raise ValueError(
+                "attn_kinds includes 'local' layers but sliding_window "
+                "is not set.")
+        return (self.sliding_window,
+                self.rope_theta_local or self.rope_theta,
+                self.rope_scaling_local)
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -408,23 +502,35 @@ class LlamaLM(nn.Module):
             # checkpoints trained that way).
             x = x * jnp.asarray(self.d_model ** 0.5, self.compute_dtype)
         for i in range(self.num_layers):
+            window, theta, scaling = self._layer_attn(i)
             x = LlamaBlock(self.num_heads, num_kv, self.d_ff,
                            self.compute_dtype, self.attention_impl,
-                           self.rope_theta, self.rope_style,
+                           theta, self.rope_style,
                            self.norm_eps, self.dropout_rate,
                            decode=self.decode,
                            cache_len=self.max_seq_len,
                            head_dim=self.head_dim,
-                           rope_scaling=self.rope_scaling,
-                           sliding_window=self.sliding_window,
+                           rope_scaling=scaling,
+                           sliding_window=window,
                            qkv_bias=self.qkv_bias,
                            mlp_activation=self.mlp_activation,
+                           post_norms=self.post_block_norms,
+                           attn_scale=self.attn_scale,
+                           logit_softcap=self.attn_logit_softcap,
+                           qk_norm=self.qk_norm,
+                           moe_experts=self.moe_experts,
+                           moe_top_k=self.moe_top_k,
+                           moe_capacity_factor=self.moe_capacity_factor,
                            name="block_%d" % i)(x, mask, deterministic)
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
                        name="norm_final")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False,
                           dtype=self.compute_dtype, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if self.final_logit_softcap:
+            cap = float(self.final_logit_softcap)
+            logits = cap * jnp.tanh(logits / cap)
+        return logits
 
 
 def llama_tensor_parallel_rules(tp_axis: str = "tp"):

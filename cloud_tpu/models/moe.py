@@ -15,6 +15,8 @@ tokens are dropped (contribute zero, standard Switch behavior) and the
 load-balancing auxiliary loss pushes the router toward uniform load.
 """
 
+from typing import Optional
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -102,12 +104,126 @@ class MoEMLP(nn.Module):
                 aux_loss)
 
 
+class TopKMoEMLP(nn.Module):
+    """Mixtral-style top-k routed MoE with SwiGLU experts.
+
+    The modern-LLM counterpart of `MoEMLP` (Switch top-1, GELU
+    experts): each token is processed by its `top_k` highest-scoring
+    experts, whose outputs are combined with the token's renormalized
+    router probabilities — softmax over the selected logits, exactly
+    HF Mixtral's softmax-then-topk-then-renormalize (the two are
+    algebraically identical). Experts are the same gate/up/down SwiGLU
+    as `models.llama.SwiGLU`, stacked on a leading [num_experts] dim
+    that `expert_parallel_rules` shards over the "ep" mesh axis.
+
+    Routing uses the same dense one-hot dispatch/combine einsums as
+    `MoEMLP` (static shapes, MXU-tiled, XLA inserts the all-to-alls),
+    processed slot-major so a token's top-1 choice wins capacity over
+    any token's top-2 choice. `capacity_factor=None` disables dropping
+    entirely (capacity = tokens): exact HF-Mixtral inference semantics,
+    at O(T^2) dispatch-tensor cost — right for checkpoint-parity and
+    small-batch decode, wrong for large-scale training (set a factor,
+    conventionally 1.25-2.0, and let the aux loss balance load).
+
+    Call returns (output, aux_loss); `LlamaBlock` sows the aux loss
+    into the "losses" collection like `TransformerBlock` does.
+    """
+
+    num_experts: int = 8
+    top_k: int = 2
+    d_ff: int = 2048
+    capacity_factor: Optional[float] = 2.0  # None = drop-free
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    activation: str = "silu"
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        """x: [batch, seq, d_model] -> ([batch, seq, d_model], scalar)."""
+        del deterministic  # no router noise in the Mixtral recipe
+        from cloud_tpu.models.llama import _GATE_ACTIVATIONS
+
+        batch, seq, d_model = x.shape
+        tokens = batch * seq
+        k = self.top_k
+        if not 1 <= k <= self.num_experts:
+            raise ValueError(
+                "top_k={} must be in [1, num_experts={}].".format(
+                    k, self.num_experts))
+        if self.capacity_factor is None:
+            capacity = tokens
+        else:
+            capacity = max(1, int(self.capacity_factor * tokens * k
+                                  / self.num_experts))
+        act = _GATE_ACTIVATIONS[self.activation]
+
+        router_kernel = self.param(
+            "router", nn.initializers.lecun_normal(),
+            (d_model, self.num_experts), jnp.float32)
+        logits = jnp.asarray(x, jnp.float32).reshape(
+            tokens, d_model) @ router_kernel              # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_probs, top_idx = jax.lax.top_k(probs, k)      # [T, k]
+        gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+
+        # Load-balancing aux loss at HF Mixtral's scale
+        # (load_balancing_loss_func): per-expert assignment counts are
+        # SUMMED over the k routes (mean over tokens only), so a
+        # uniform router scores top_k — coefficients calibrated
+        # against HF (router_aux_loss_coef) transfer unchanged.
+        sel = jax.nn.one_hot(top_idx, self.num_experts,
+                             dtype=jnp.float32)           # [T, k, E]
+        aux_loss = self.num_experts * jnp.sum(
+            sel.sum(axis=1).mean(axis=0) * probs.mean(axis=0))
+
+        # Capacity assignment, slot-major: all top-1 assignments claim
+        # queue positions before any top-2 assignment, so dropping
+        # (when capacity binds) sheds the lowest-gate routes first.
+        sel_sm = jnp.transpose(sel, (1, 0, 2)).reshape(
+            k * tokens, self.num_experts)                 # [kT, E]
+        position = (jnp.cumsum(sel_sm, axis=0) - 1.0) * sel_sm
+        keep = (position < capacity).astype(jnp.float32) * sel_sm
+        slot = jnp.sum(position * keep, axis=-1).astype(jnp.int32)
+        slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+
+        # dispatch[t, e, c] = 1 iff token t occupies slot c of expert e
+        # via ANY of its k routes (routes are distinct experts, so the
+        # sum over slots never overlaps); combine carries the gate.
+        disp = (keep[:, :, None] * slot_oh[:, None, :]).reshape(
+            k, tokens, self.num_experts, capacity)
+        dispatch = disp.sum(axis=0)                       # [T, E, C]
+        gates_sm = jnp.transpose(gates, (1, 0)).reshape(k, tokens)
+        combine = (disp * gates_sm[:, :, None, None]).sum(axis=0)
+
+        xf = x.reshape(tokens, d_model).astype(self.compute_dtype)
+        expert_in = jnp.einsum("tec,td->ecd",
+                               dispatch.astype(self.compute_dtype), xf)
+        init = nn.initializers.lecun_normal(batch_axis=(0,))
+        w_gate = self.param("expert_gate", init,
+                            (self.num_experts, d_model, self.d_ff),
+                            jnp.float32)
+        w_up = self.param("expert_up", init,
+                          (self.num_experts, d_model, self.d_ff),
+                          jnp.float32)
+        w_down = self.param("expert_down", init,
+                            (self.num_experts, self.d_ff, d_model),
+                            jnp.float32)
+        g = jnp.einsum("ecd,edf->ecf", expert_in,
+                       w_gate.astype(self.compute_dtype))
+        u = jnp.einsum("ecd,edf->ecf", expert_in,
+                       w_up.astype(self.compute_dtype))
+        expert_out = jnp.einsum("ecf,efd->ecd", act(g) * u,
+                                w_down.astype(self.compute_dtype))
+        out = jnp.einsum("tec,ecd->td",
+                         combine.astype(self.compute_dtype), expert_out)
+        return (out.reshape(batch, seq, d_model).astype(x.dtype),
+                aux_loss)
+
+
 def expert_parallel_rules(ep_axis: str = "ep"):
     """Sharding rules putting the expert dim on the "ep" mesh axis —
     compose with `tensor_parallel_rules` in
     `Trainer(param_sharding_rules=...)`."""
     return [
-        (r"expert_in$", P(ep_axis, None, None)),
-        (r"expert_out$", P(ep_axis, None, None)),
+        (r"expert_(in|out|gate|up|down)$", P(ep_axis, None, None)),
         # Router stays replicated: every token scores every expert.
     ]

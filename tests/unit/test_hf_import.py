@@ -206,10 +206,158 @@ class TestHFImport:
             lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
         np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
 
-    def test_gemma2_rejected(self, transformers, torch):
+    def test_gemma2_matches_torch(self, transformers, torch):
+        """Gemma2: sandwich norms, attention+final tanh soft-capping,
+        query_pre_attn_scalar softmax scale, alternating local/global
+        attention — logits parity at a sequence length past the window
+        (so the band binds on the local layers) with 3 layers (so both
+        kinds appear)."""
+        config = transformers.Gemma2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=3, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16,
+            max_position_embeddings=32, rms_norm_eps=1e-6,
+            query_pre_attn_scalar=8, sliding_window=4,
+            attn_logit_softcapping=5.0, final_logit_softcapping=3.0,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.Gemma2ForCausalLM(config).eval()
+        tokens = np.random.default_rng(9).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.post_block_norms is True
+        assert lm.attn_logit_softcap == pytest.approx(5.0)
+        assert lm.final_logit_softcap == pytest.approx(3.0)
+        assert lm.attn_scale == pytest.approx(8 ** -0.5)
+        assert lm.attn_kinds == ("local", "global", "local")
+        assert lm.sliding_window == 4
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
+
+    def test_gemma3_matches_torch(self, transformers, torch):
+        """Gemma3: per-head q/k RMSNorm, 5:1 local:global pattern with
+        a separate local RoPE theta, rope_scaling on global layers
+        only — 6 layers so the one global layer appears, seq past the
+        window so the local band binds, linear rope_scaling so the
+        global-only application is actually tested."""
+        config = transformers.Gemma3TextConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=6, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16,
+            max_position_embeddings=64, rms_norm_eps=1e-6,
+            query_pre_attn_scalar=8, sliding_window=4,
+            rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+            rope_scaling={"rope_type": "linear", "factor": 2.0},
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.Gemma3ForCausalLM(config).eval()
+        tokens = np.random.default_rng(10).integers(0, 64, size=(2, 24))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.qk_norm is True
+        assert lm.post_block_norms is True
+        assert lm.attn_logit_softcap is None
+        assert lm.attn_kinds == ("local",) * 5 + ("global",)
+        assert lm.rope_theta_local == pytest.approx(10_000.0)
+        assert lm.rope_scaling.kind == "linear"
+        assert lm.rope_scaling_local is None
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
+
+    def test_gemma3_decode_cache_matches_full_forward(self, transformers,
+                                                      torch):
+        """The decode path must honor qk-norm, attn_scale, and the
+        per-layer band masks: greedy generate() continuation equals the
+        full-forward argmax at every step."""
+        from cloud_tpu.models import generate
+
+        config = transformers.Gemma3TextConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=6, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16,
+            max_position_embeddings=32, rms_norm_eps=1e-6,
+            query_pre_attn_scalar=8, sliding_window=4,
+            rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.Gemma3ForCausalLM(config).eval()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32,
+                                        max_seq_len=24)
+        prompt = jnp.asarray(
+            np.random.default_rng(11).integers(0, 64, size=(2, 8)),
+            jnp.int32)
+        out = generate(lm, variables["params"], prompt, 6,
+                       rng=jax.random.PRNGKey(0), temperature=0.0)
+        # Oracle: incremental full forwards (no cache), argmax each step.
+        tokens = np.asarray(prompt)
+        for _ in range(6):
+            logits = lm.apply(variables, jnp.asarray(tokens, jnp.int32))
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            tokens = np.concatenate([tokens, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), tokens)
+
+    def test_qwen2_sliding_layer_types_keep_rope_scaling(
+            self, transformers, torch):
+        """A non-Gemma3 family with HF layer_types (Qwen2
+        use_sliding_window: full layers below max_window_layers,
+        sliding above) must apply rope_scaling to its LOCAL layers too
+        — only Gemma3 runs a separate unscaled local rotary."""
+        config = transformers.Qwen2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rope_theta=10000.0, rms_norm_eps=1e-6,
+            use_sliding_window=True, sliding_window=4,
+            max_window_layers=2,
+            rope_scaling={"rope_type": "linear", "factor": 2.0},
+            tie_word_embeddings=False, attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.Qwen2ForCausalLM(config).eval()
+        tokens = np.random.default_rng(12).integers(0, 64, size=(2, 24))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.attn_kinds == ("global", "global", "local", "local")
+        assert lm.rope_scaling_local is not None
+        assert lm.rope_scaling_local.kind == "linear"
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_mixtral_matches_torch(self, transformers, torch):
+        """Mixtral: top-2 routed MoE FFN with renormalized softmax
+        gates — logits parity against the torch model (the importer
+        builds the model drop-free, matching HF's dense routing)."""
+        config = transformers.MixtralConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            num_local_experts=4, num_experts_per_tok=2,
+            rope_theta=10000.0, rms_norm_eps=1e-6,
+            sliding_window=None, tie_word_embeddings=False,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.MixtralForCausalLM(config).eval()
+        tokens = np.random.default_rng(13).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.moe_experts == 4
+        assert lm.moe_top_k == 2
+        assert lm.moe_capacity_factor is None
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
+
+    def test_gemma3_multimodal_wrapper_rejected(self, transformers,
+                                                torch):
         hf = _tiny_hf_llama(transformers, torch)
-        config = dict(hf.config.to_dict(), model_type="gemma2")
-        with pytest.raises(NotImplementedError, match="gemma2"):
+        config = dict(hf.config.to_dict(), model_type="gemma3")
+        with pytest.raises(NotImplementedError, match="text"):
             import_hf_llama(state_dict=hf.state_dict(), config=config)
 
     def test_qwen2_qkv_bias_matches_torch(self, transformers, torch):
