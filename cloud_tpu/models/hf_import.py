@@ -89,6 +89,64 @@ def _to_numpy(tensor):
     return np.asarray(tensor, dtype=np.float32)
 
 
+def _unpack(model, state_dict, config):
+    """(model | state_dict+config) -> (state_dict, config)."""
+    if model is not None:
+        return {k: v for k, v in model.state_dict().items()}, model.config
+    if state_dict is None or config is None:
+        raise ValueError("Pass either `model` or both `state_dict` "
+                         "and `config`.")
+    return state_dict, config
+
+
+def _cfg_reader(config):
+    """Uniform reader over HF config objects and plain dicts."""
+    def cfg(name, default=None):
+        if isinstance(config, dict):
+            value = config.get(name, default)
+        else:
+            value = getattr(config, name, default)
+        if value is None and default is None:
+            raise ValueError("HF config is missing {!r}.".format(name))
+        return value
+    return cfg
+
+
+def _taker(state_dict, prefix=""):
+    """(take, consumed): take() fetches a tensor loudly and records it
+    so _check_all_consumed can prove nothing was silently dropped."""
+    consumed = set()
+
+    def take(name):
+        name = prefix + name
+        if name not in state_dict:
+            raise KeyError(
+                "HF state_dict is missing {!r} (have e.g. {}).".format(
+                    name, sorted(state_dict)[:5]))
+        consumed.add(name)
+        return _to_numpy(state_dict[name])
+
+    return take, consumed
+
+
+def _check_all_consumed(state_dict, consumed, skip_pattern):
+    """Every parameter in the checkpoint must have landed somewhere:
+    silently dropping an unmapped tensor (an o_proj/MLP bias, a novel
+    adapter) would produce a model whose logits are wrong with nothing
+    flagging it. skip_pattern: regex of derivable non-parameter buffers
+    (rotary tables, causal-mask buffers)."""
+    import re
+
+    leftover = sorted(
+        name for name in state_dict
+        if name not in consumed and not re.search(skip_pattern, name))
+    if leftover:
+        raise ValueError(
+            "HF state_dict has parameters this importer does not map "
+            "(the imported model would silently diverge): {}".format(
+                leftover[:8]))
+
+
 def import_hf_llama(model=None, state_dict=None, config=None,
                     compute_dtype=jnp.bfloat16, attention_impl="auto",
                     max_seq_len=None):
@@ -114,21 +172,8 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         match the checkpoint (rotate-half RoPE, checkpoint theta) and
         the `{"params": ...}` variables dict for `model.apply`.
     """
-    if model is not None:
-        state_dict = {k: v for k, v in model.state_dict().items()}
-        config = model.config
-    if state_dict is None or config is None:
-        raise ValueError("Pass either `model` or both `state_dict` "
-                         "and `config`.")
-
-    def cfg(name, default=None):
-        if isinstance(config, dict):
-            value = config.get(name, default)
-        else:
-            value = getattr(config, name, default)
-        if value is None and default is None:
-            raise ValueError("HF config is missing {!r}.".format(name))
-        return value
+    state_dict, config = _unpack(model, state_dict, config)
+    cfg = _cfg_reader(config)
 
     d_model = cfg("hidden_size")
     heads = cfg("num_attention_heads")
@@ -156,6 +201,18 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     # config attribute names differ across families (attention_bias vs
     # implicit) but the state_dict does not lie.
     qkv_bias = "model.layers.0.self_attn.q_proj.bias" in state_dict
+
+    # Phi-3 fuses the projections: qkv_proj = cat(q, k, v) rows and
+    # gate_up_proj = cat(gate, up) rows. Detected from the state_dict
+    # (same reason as qkv_bias); split during mapping below.
+    fused_qkv = "model.layers.0.self_attn.qkv_proj.weight" in state_dict
+    fused_gate_up = ("model.layers.0.mlp.gate_up_proj.weight"
+                     in state_dict)
+    partial_rotary = cfg("partial_rotary_factor", 1.0) or 1.0
+    if float(partial_rotary) != 1.0:
+        raise NotImplementedError(
+            "partial_rotary_factor={} is not supported; apply_rope "
+            "rotates the full head_dim.".format(partial_rotary))
 
     # Gemma family: GeGLU gate activation, sqrt(d_model)-scaled
     # embeddings, and the (1 + weight) RMSNorm convention — the last is
@@ -233,15 +290,7 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     moe_experts = int(cfg("num_local_experts", 8)) if is_mixtral else 0
     moe_top_k = int(cfg("num_experts_per_tok", 2)) if is_mixtral else 2
 
-    consumed = set()
-
-    def take(name):
-        if name not in state_dict:
-            raise KeyError(
-                "HF state_dict is missing {!r} (have e.g. {}).".format(
-                    name, sorted(state_dict)[:5]))
-        consumed.add(name)
-        return _to_numpy(state_dict[name])
+    take, consumed = _taker(state_dict)
 
     params = {
         "embed": {"embedding": take("model.embed_tokens.weight")},
@@ -257,11 +306,14 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     for i in range(layers):
         hf = "model.layers.{}.".format(i)
 
-        def proj(name, n_heads):
+        def hfmt(w, n_heads):
             # [n*hd, d] row-major -> [d, n, hd] flax DenseGeneral.
+            return w.reshape(n_heads, head_dim, d_model).transpose(
+                2, 0, 1)
+
+        def proj(name, n_heads):
             w = take(hf + "self_attn.{}_proj.weight".format(name))
-            entry = {"kernel": w.reshape(
-                n_heads, head_dim, d_model).transpose(2, 0, 1)}
+            entry = {"kernel": hfmt(w, n_heads)}
             if qkv_bias:
                 # [n*hd] -> [n, hd] (DenseGeneral bias matches features)
                 entry["bias"] = take(
@@ -269,13 +321,28 @@ def import_hf_llama(model=None, state_dict=None, config=None,
                 ).reshape(n_heads, head_dim)
             return entry
 
+        if fused_qkv:
+            # Phi-3: qkv_proj rows are cat(q [H*hd], k [Hkv*hd],
+            # v [Hkv*hd]); split, then reshape like the unfused path.
+            w = take(hf + "self_attn.qkv_proj.weight")
+            q_rows = heads * head_dim
+            kv_rows = kv_heads * head_dim
+            qkv = {
+                "query": {"kernel": hfmt(w[:q_rows], heads)},
+                "key": {"kernel": hfmt(
+                    w[q_rows:q_rows + kv_rows], kv_heads)},
+                "value": {"kernel": hfmt(
+                    w[q_rows + kv_rows:], kv_heads)},
+            }
+        else:
+            qkv = {
+                "query": proj("q", heads),
+                "key": proj("k", kv_heads),
+                "value": proj("v", kv_heads),
+            }
         o = take(hf + "self_attn.o_proj.weight")  # [d, H*hd]
-        attention = {
-            "query": proj("q", heads),
-            "key": proj("k", kv_heads),
-            "value": proj("v", kv_heads),
-            "out": {"kernel": o.T.reshape(heads, head_dim, d_model)},
-        }
+        attention = dict(
+            qkv, out={"kernel": o.T.reshape(heads, head_dim, d_model)})
         if is_gemma3:
             # Per-head q/k RMSNorm, scale shared across heads ([hd]).
             attention["q_norm"] = {"scale": norm_scale(
@@ -304,6 +371,15 @@ def import_hf_llama(model=None, state_dict=None, config=None,
                     take(moe + "experts.{}.w2.weight".format(e)).T
                     for e in range(moe_experts)]),      # [E, f, d]
             }
+        elif fused_gate_up:
+            # Phi-3: gate_up_proj rows are cat(gate [f], up [f]).
+            gu = take(hf + "mlp.gate_up_proj.weight")  # [2f, d]
+            d_ff = gu.shape[0] // 2
+            block["mlp"] = {
+                "gate": {"kernel": gu[:d_ff].T},
+                "up": {"kernel": gu[d_ff:].T},
+                "down": {"kernel": take(hf + "mlp.down_proj.weight").T},
+            }
         else:
             block["mlp"] = {
                 "gate": {"kernel": take(hf + "mlp.gate_proj.weight").T},
@@ -326,19 +402,8 @@ def import_hf_llama(model=None, state_dict=None, config=None,
                 take(hf + "post_attention_layernorm.weight"))}
         params["block_%d" % i] = block
 
-    # Every parameter in the checkpoint must have landed somewhere:
-    # silently dropping an unmapped tensor (an o_proj/MLP bias, a
-    # novel adapter) would produce a model whose logits are wrong with
-    # nothing flagging it. (Non-parameter buffers like rotary inv_freq
-    # tables are derivable and skipped.)
-    leftover = sorted(
-        name for name in state_dict
-        if name not in consumed and "rotary_emb" not in name)
-    if leftover:
-        raise ValueError(
-            "HF state_dict has parameters this importer does not map "
-            "(the imported model would silently diverge): {}".format(
-                leftover[:8]))
+    # Rotary inv_freq tables are derivable non-parameter buffers.
+    _check_all_consumed(state_dict, consumed, r"rotary_emb")
 
     lm = LlamaLM(
         vocab_size=cfg("vocab_size"),
@@ -384,4 +449,146 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     return lm, {"params": params}
 
 
-__all__ = ["import_hf_llama"]
+def import_hf_gpt2(model=None, state_dict=None, config=None,
+                   compute_dtype=jnp.bfloat16, attention_impl="auto",
+                   max_seq_len=None):
+    """Converts an HF GPT-2 model to (TransformerLM, variables).
+
+    `TransformerLM` is already GPT-2-shaped (pre-LN blocks, learned
+    positions, tanh-approximate GELU — flax's `nn.gelu` default matches
+    HF's "gelu_new"), so the conversion is pure layout: GPT-2's Conv1D
+    weights are stored [in, out] (no transpose, unlike Linear), the
+    fused c_attn [d, 3d] splits into per-head q/k/v, and the LM head is
+    tied to wte. Layer-norm epsilon (1e-5 in GPT-2 checkpoints) is
+    carried onto the module's norm_eps.
+
+        wte [V, d]            -> embed/embedding      (+ tied lm_head)
+        wpe [P, d]            -> pos_embed/embedding
+        h.i.ln_1.{weight,bias}   -> block_i/ln_attn/{scale,bias}
+        h.i.attn.c_attn [d, 3d]  -> query/key/value kernels [d, H, hd]
+        h.i.attn.c_proj [d, d]   -> out kernel [H, hd, d]
+        h.i.ln_2                 -> block_i/ln_mlp
+        h.i.mlp.c_fc [d, f]      -> mlp_in kernel
+        h.i.mlp.c_proj [f, d]    -> mlp_out kernel
+        ln_f                     -> ln_final
+
+    Args/returns mirror `import_hf_llama`. Non-parameter attention
+    buffers (h.i.attn.bias causal masks in older checkpoints) are
+    skipped; any other unmapped tensor fails loudly.
+    """
+    from cloud_tpu.models.transformer import TransformerLM
+
+    state_dict, config = _unpack(model, state_dict, config)
+    cfg = _cfg_reader(config)
+
+    act = cfg("activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise NotImplementedError(
+            "GPT-2 activation_function={!r} is not supported; "
+            "TransformerLM uses tanh-approximate GELU "
+            "(gelu_new).".format(act))
+    # Attention variants with NO extra parameters would pass the
+    # leftover check and import with silently wrong logits — reject.
+    for flag in ("scale_attn_by_inverse_layer_idx",
+                 "reorder_and_upcast_attn"):
+        if cfg(flag, False):
+            raise NotImplementedError(
+                "GPT-2 {}=True is not supported; TransformerLM always "
+                "scales attention by 1/sqrt(head_dim).".format(flag))
+    if not cfg("scale_attn_weights", True):
+        raise NotImplementedError(
+            "GPT-2 scale_attn_weights=False is not supported; "
+            "TransformerLM always scales attention by "
+            "1/sqrt(head_dim).")
+
+    d_model = cfg("n_embd")
+    heads = cfg("n_head")
+    layers = cfg("n_layer")
+    head_dim = d_model // heads
+    d_ff = cfg("n_inner", False) or 4 * d_model
+    n_positions = cfg("n_positions", 1024)
+    horizon = max_seq_len or n_positions
+    if horizon > n_positions:
+        # Learned positions cannot be extended (unlike RoPE in
+        # import_hf_llama, where any horizon is valid): a larger
+        # horizon would declare an Embed the checkpoint cannot fill
+        # and fail with an opaque shape error at apply time.
+        raise ValueError(
+            "max_seq_len={} exceeds the checkpoint's n_positions={}; "
+            "GPT-2's learned position table cannot be extended.".format(
+                horizon, n_positions))
+
+    prefix = ("transformer."
+              if any(k.startswith("transformer.") for k in state_dict)
+              else "")
+    take, consumed = _taker(state_dict, prefix=prefix)
+
+    def ln(name):
+        return {"scale": take(name + ".weight"),
+                "bias": take(name + ".bias")}
+
+    wte = take("wte.weight")
+    # GPT-2 proper ties the head to wte, but tie_word_embeddings=False
+    # re-trainings carry an independent lm_head.weight — use the
+    # checkpoint's head tensor whenever it is present (identical to
+    # wte in the tied case) instead of assuming the tie.
+    if "lm_head.weight" in state_dict:
+        consumed.add("lm_head.weight")
+        head_w = _to_numpy(state_dict["lm_head.weight"]).T
+    else:
+        head_w = wte.T.copy()
+    params = {
+        "embed": {"embedding": wte},
+        "pos_embed": {"embedding": take("wpe.weight")[:horizon]},
+        "ln_final": ln("ln_f"),
+        "lm_head": {"kernel": head_w},
+    }
+
+    for i in range(layers):
+        hf = "h.{}.".format(i)
+        # Conv1D stores [in, out]: split the fused [d, 3d] c_attn into
+        # q/k/v [d, d] then reshape to [d, H, hd]; biases [3d] -> [H, hd].
+        ca = take(hf + "attn.c_attn.weight")
+        cb = take(hf + "attn.c_attn.bias")
+        qkv_w = [w.reshape(d_model, heads, head_dim)
+                 for w in np.split(ca, 3, axis=1)]
+        qkv_b = [b.reshape(heads, head_dim) for b in np.split(cb, 3)]
+        params["block_%d" % i] = {
+            "ln_attn": ln(hf + "ln_1"),
+            "ln_mlp": ln(hf + "ln_2"),
+            "attention": {
+                "query": {"kernel": qkv_w[0], "bias": qkv_b[0]},
+                "key": {"kernel": qkv_w[1], "bias": qkv_b[1]},
+                "value": {"kernel": qkv_w[2], "bias": qkv_b[2]},
+                "out": {
+                    # [d(in = H*hd), d] -> [H, hd, d] DenseGeneral.
+                    "kernel": take(hf + "attn.c_proj.weight").reshape(
+                        heads, head_dim, d_model),
+                    "bias": take(hf + "attn.c_proj.bias"),
+                },
+            },
+            "mlp_in": {"kernel": take(hf + "mlp.c_fc.weight"),
+                       "bias": take(hf + "mlp.c_fc.bias")},
+            "mlp_out": {"kernel": take(hf + "mlp.c_proj.weight"),
+                        "bias": take(hf + "mlp.c_proj.bias")},
+        }
+
+    # Older checkpoints carry non-parameter causal-mask buffers.
+    _check_all_consumed(state_dict, consumed,
+                        r"\.attn\.(bias|masked_bias)$")
+
+    lm = TransformerLM(
+        vocab_size=cfg("vocab_size"),
+        num_layers=layers,
+        num_heads=heads,
+        d_model=d_model,
+        d_ff=d_ff,
+        max_seq_len=horizon,
+        norm_eps=float(cfg("layer_norm_epsilon", 1e-5)),
+        compute_dtype=compute_dtype,
+        attention_impl=attention_impl,
+    )
+    return lm, {"params": params}
+
+
+__all__ = ["import_hf_llama", "import_hf_gpt2"]

@@ -128,10 +128,12 @@ class TransformerBlock(nn.Module):
     decode: bool = False
     cache_len: int = 0
     causal: bool = True
+    norm_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
-        y = nn.LayerNorm(dtype=self.compute_dtype, name="ln_attn")(x)
+        y = nn.LayerNorm(epsilon=self.norm_eps,
+                         dtype=self.compute_dtype, name="ln_attn")(x)
         y = CausalSelfAttention(self.num_heads, self.compute_dtype,
                                 self.attention_impl,
                                 decode=self.decode,
@@ -142,7 +144,8 @@ class TransformerBlock(nn.Module):
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         x = x + y
 
-        y = nn.LayerNorm(dtype=self.compute_dtype, name="ln_mlp")(x)
+        y = nn.LayerNorm(epsilon=self.norm_eps,
+                         dtype=self.compute_dtype, name="ln_mlp")(x)
         if self.moe_experts:
             from cloud_tpu.models.moe import MoEMLP
             y, aux_loss = MoEMLP(num_experts=self.moe_experts,
@@ -178,6 +181,7 @@ class TransformerLM(nn.Module):
     attention_impl: str = "auto"
     moe_experts: int = 0
     decode: bool = False  # autoregressive KV-cache mode (see generate())
+    norm_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -206,9 +210,11 @@ class TransformerLM(nn.Module):
                                  self.attention_impl, self.moe_experts,
                                  decode=self.decode,
                                  cache_len=self.max_seq_len,
+                                 norm_eps=self.norm_eps,
                                  name="block_%d" % i)(
                                      x, mask, deterministic)
-        x = nn.LayerNorm(dtype=self.compute_dtype, name="ln_final")(x)
+        x = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
+                         name="ln_final")(x)
         # Tied-free output head; vocab dim sharded on tp by the rules.
         logits = nn.Dense(self.vocab_size, use_bias=False,
                           dtype=self.compute_dtype, name="lm_head")(x)
@@ -292,6 +298,7 @@ def generate(model,
              rng=None,
              temperature=1.0,
              top_k=None,
+             top_p=None,
              eos_token=None):
     """Autoregressive sampling with a KV cache.
 
@@ -311,6 +318,10 @@ def generate(model,
         rng: PRNGKey for sampling; required unless temperature == 0.
         temperature: 0 = greedy argmax; otherwise softmax temperature.
         top_k: Optional truncation to the k highest-probability tokens.
+        top_p: Optional nucleus sampling: keep the smallest
+            highest-probability set whose cumulative probability
+            reaches top_p (computed after temperature and any top_k
+            truncation, the HF warper order). (0, 1]; 1.0 = no-op.
         eos_token: Optional stop token: positions after a sampled eos
             are filled with eos_token.
 
@@ -340,6 +351,9 @@ def generate(model,
         raise ValueError(
             "top_k must be in [1, vocab_size={}]; got {}.".format(
                 model.vocab_size, top_k))
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            "top_p must be in (0, 1]; got {}.".format(top_p))
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
@@ -355,6 +369,7 @@ def generate(model,
     prefill, decode_steps = _decode_fns(
         decoder, float(temperature),
         None if top_k is None else int(top_k),
+        None if top_p is None else float(top_p),
         None if eos_token is None else int(eos_token))
 
     rng, prefill_rng = jax.random.split(rng)
@@ -368,7 +383,7 @@ def generate(model,
 
 
 @functools.lru_cache(maxsize=64)
-def _decode_fns(decoder, temperature, top_k, eos_token):
+def _decode_fns(decoder, temperature, top_k, top_p, eos_token):
     """Jitted (prefill, decode_steps) for one decoder/sampling config.
 
     Cached so repeated generate() calls reuse the compiled executables
@@ -388,8 +403,23 @@ def _decode_fns(decoder, temperature, top_k, eos_token):
             logits = jnp.where(logits < kth, -1e30, logits)
         if not temperature:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            rng, logits / temperature, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_p is not None and top_p < 1.0:
+            # Nucleus: keep the smallest top-probability set whose
+            # cumulative mass reaches top_p. `cum - probs < top_p`
+            # keeps every token whose EXCLUSIVE prefix mass is below
+            # the threshold — i.e. the set up to and including the
+            # first token that crosses it, so at least one survives.
+            sorted_scaled = jnp.flip(jnp.sort(scaled, axis=-1), -1)
+            probs = jax.nn.softmax(sorted_scaled, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = (cum - probs) < top_p
+            cutoff = jnp.min(
+                jnp.where(keep, sorted_scaled, jnp.inf),
+                axis=-1, keepdims=True)
+            scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+        return jax.random.categorical(rng, scaled,
+                                      axis=-1).astype(jnp.int32)
 
     @jax.jit
     def prefill(params, cache, prompt, rng):

@@ -353,6 +353,70 @@ class TestHFImport:
             lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
         np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
 
+    def test_phi3_fused_projections_match_torch(self, transformers,
+                                                torch):
+        """Phi-3 fuses qkv_proj (cat q/k/v rows) and gate_up_proj
+        (cat gate/up rows); the importer splits them — logits parity."""
+        config = transformers.Phi3Config(
+            vocab_size=64, hidden_size=32, intermediate_size=48,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            rope_theta=10000.0, rms_norm_eps=1e-6,
+            pad_token_id=0, bos_token_id=1, eos_token_id=2,
+            tie_word_embeddings=False, attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.Phi3ForCausalLM(config).eval()
+        assert any("qkv_proj" in k for k in hf.state_dict())
+        tokens = np.random.default_rng(14).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_gpt2_matches_torch(self, transformers, torch):
+        """GPT-2 -> TransformerLM: Conv1D [in, out] layout, fused
+        c_attn split, tied head, 1e-5 layer-norm eps — logits parity
+        plus a greedy generate() drive."""
+        from cloud_tpu.models import generate
+        from cloud_tpu.models.hf_import import import_hf_gpt2
+
+        config = transformers.GPT2Config(
+            vocab_size=64, n_embd=32, n_layer=2, n_head=4,
+            n_positions=32, attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(config).eval()
+        tokens = np.random.default_rng(15).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_gpt2(hf, compute_dtype=jnp.float32)
+        assert lm.norm_eps == pytest.approx(1e-5)
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+        prompt = jnp.asarray(tokens[:, :8], jnp.int32)
+        out = generate(lm, variables["params"], prompt, 4,
+                       rng=jax.random.PRNGKey(0), temperature=0.0)
+        with torch.no_grad():
+            hf_out = hf.generate(
+                torch.tensor(np.asarray(prompt)), max_new_tokens=4,
+                do_sample=False, use_cache=True,
+                pad_token_id=0).numpy()
+        np.testing.assert_array_equal(np.asarray(out), hf_out)
+
+    def test_gpt2_unknown_activation_rejected(self, transformers,
+                                              torch):
+        from cloud_tpu.models.hf_import import import_hf_gpt2
+        config = transformers.GPT2Config(
+            vocab_size=64, n_embd=32, n_layer=1, n_head=4,
+            n_positions=32, activation_function="relu")
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(config)
+        with pytest.raises(NotImplementedError, match="activation"):
+            import_hf_gpt2(hf)
+
     def test_gemma3_multimodal_wrapper_rejected(self, transformers,
                                                 torch):
         hf = _tiny_hf_llama(transformers, torch)
@@ -417,3 +481,71 @@ class TestHFImport:
         got = np.asarray(
             lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
         np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_gpt2_parameterless_attention_variants_rejected(
+            self, transformers, torch):
+        """scale_attn_by_inverse_layer_idx / reorder_and_upcast_attn
+        change the math without adding parameters — they must fail
+        loudly, not import with silently wrong logits."""
+        from cloud_tpu.models.hf_import import import_hf_gpt2
+        config = transformers.GPT2Config(
+            vocab_size=64, n_embd=32, n_layer=1, n_head=4,
+            n_positions=32, scale_attn_by_inverse_layer_idx=True)
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(config)
+        with pytest.raises(NotImplementedError,
+                           match="scale_attn_by_inverse_layer_idx"):
+            import_hf_gpt2(hf)
+
+    def test_gpt2_max_seq_len_beyond_positions_rejected(
+            self, transformers, torch):
+        """Learned positions cannot be extended: a horizon past
+        n_positions must fail at import, not at apply."""
+        from cloud_tpu.models.hf_import import import_hf_gpt2
+        config = transformers.GPT2Config(
+            vocab_size=64, n_embd=32, n_layer=1, n_head=4,
+            n_positions=32)
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(config)
+        with pytest.raises(ValueError, match="n_positions"):
+            import_hf_gpt2(hf, max_seq_len=64)
+
+    def test_gpt2_untied_head_uses_checkpoint_head(self, transformers,
+                                                   torch):
+        """tie_word_embeddings=False GPT-2 re-trainings carry an
+        independent lm_head — logits parity proves the importer uses
+        the checkpoint's head tensor, not wte."""
+        from cloud_tpu.models.hf_import import import_hf_gpt2
+        config = transformers.GPT2Config(
+            vocab_size=64, n_embd=32, n_layer=1, n_head=4,
+            n_positions=32, tie_word_embeddings=False,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(config).eval()
+        with torch.no_grad():
+            # Force the head away from wte so the tie assumption would
+            # be caught (fresh GPT2LMHeadModel still initializes the
+            # head from wte unless perturbed).
+            hf.lm_head.weight.add_(
+                0.5 * torch.randn_like(hf.lm_head.weight))
+        assert not torch.equal(hf.lm_head.weight,
+                               hf.transformer.wte.weight)
+        tokens = np.random.default_rng(16).integers(0, 64, size=(2, 12))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_gpt2(hf, compute_dtype=jnp.float32)
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_gpt2_unscaled_attention_rejected(self, transformers,
+                                              torch):
+        from cloud_tpu.models.hf_import import import_hf_gpt2
+        config = transformers.GPT2Config(
+            vocab_size=64, n_embd=32, n_layer=1, n_head=4,
+            n_positions=32, scale_attn_weights=False)
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(config)
+        with pytest.raises(NotImplementedError,
+                           match="scale_attn_weights"):
+            import_hf_gpt2(hf)
