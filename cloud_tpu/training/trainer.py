@@ -192,6 +192,41 @@ class ParamEmaState(typing.NamedTuple):
     ema: typing.Any
 
 
+def _trainable_labels(params, trainable):
+    """"train"/"freeze" label per param leaf.
+
+    trainable: regex (re.search over the same path strings
+    param_sharding_rules match, e.g. "block_0/attention/query/kernel")
+    or callable path_string -> bool.
+    """
+    import re
+
+    if callable(trainable):
+        matches = trainable
+    else:
+        pattern = re.compile(trainable)
+        matches = lambda path: pattern.search(path) is not None
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: ("train"
+                         if matches(sharding_lib.path_string(path))
+                         else "freeze"),
+        params)
+
+
+def _freeze_untrainable(optimizer, trainable):
+    """Wraps an optimizer so only `trainable`-matched params update.
+
+    Frozen leaves get `optax.set_to_zero`, and `optax.multi_transform`'s
+    masking means the wrapped optimizer allocates state (Adam moments
+    etc.) ONLY for the trainable subset — frozen positions hold
+    `optax.MaskedNode` placeholders (see build()'s masked-moment
+    sharding).
+    """
+    return optax.multi_transform(
+        {"train": optimizer, "freeze": optax.set_to_zero()},
+        lambda params: _trainable_labels(params, trainable))
+
+
 def _param_ema(decay):
     """optax transform tracking an EMA of the PARAMETERS.
 
@@ -264,7 +299,8 @@ class Trainer:
                  zero1=False,
                  fsdp=False,
                  ema_decay=None,
-                 steps_per_execution=1):
+                 steps_per_execution=1,
+                 trainable=None):
         """Constructor.
 
         Args:
@@ -317,6 +353,17 @@ class Trainer:
                 pods (local groups assemble into global stacked
                 arrays); leftover/ragged batches run through the
                 single-step path.
+            trainable: Optional param-path regex (or callable
+                path_string -> bool): only matching parameters receive
+                optimizer updates; the rest are frozen — the
+                fine-tuning lever for imported checkpoints (e.g.
+                `trainable=r"lm_head|block_11"` trains the head and
+                last block of an `import_hf_llama` model). Matching
+                uses `re.search` on the same "block_0/attention/query/
+                kernel" path strings as `param_sharding_rules`. Frozen
+                parameters allocate NO optimizer state (`optax.
+                multi_transform` masking), so Adam moments shrink to
+                the trainable subset.
             ema_decay: Track an exponential moving average of the
                 parameters (e.g. 0.999): `ema_params` exposes the
                 shadow, and evaluate/predict take `use_ema=True` to
@@ -341,6 +388,9 @@ class Trainer:
 
         if isinstance(optimizer, str):
             optimizer = OPTIMIZERS[optimizer]()
+        self.trainable = trainable
+        if trainable is not None:
+            optimizer = _freeze_untrainable(optimizer, trainable)
         self.ema_decay = ema_decay
         if ema_decay is not None:
             if not 0.0 < ema_decay < 1.0:
@@ -460,10 +510,30 @@ class Trainer:
                 moment_sharding = sharding_lib.zero1_opt_sharding(
                     params, param_sharding, self._mesh)
 
+            # Trainable-subset masking (optax.multi_transform) swaps
+            # frozen leaves for MaskedNode, so masked moments are NOT
+            # params-shaped: recognize that structure too, or every
+            # moment falls into the replicated fallback and the
+            # zero1/fsdp/tp layouts silently vanish exactly for the
+            # fine-tuning runs the feature targets.
+            masked_struct = None
+            if self.trainable is not None:
+                labels = _trainable_labels(params, self.trainable)
+                _mask_like = lambda tree: jax.tree_util.tree_map(
+                    lambda lbl, leaf: (leaf if lbl == "train"
+                                       else optax.MaskedNode()),
+                    labels, tree)
+                masked_struct = jax.tree_util.tree_structure(
+                    _mask_like(params))
+                masked_moment_sharding = _mask_like(moment_sharding)
+
             def _is_params_shaped(node):
-                return (isinstance(node, ParamEmaState)
-                        or jax.tree_util.tree_structure(node)
-                        == param_struct)
+                if isinstance(node, ParamEmaState):
+                    return True
+                struct = jax.tree_util.tree_structure(node)
+                return (struct == param_struct
+                        or (masked_struct is not None
+                            and struct == masked_struct))
 
             def _subtree_sharding(node):
                 if isinstance(node, ParamEmaState):
@@ -471,6 +541,10 @@ class Trainer:
                     # eval time, so it keeps the PARAM layout even under
                     # zero1 moment sharding.
                     return ParamEmaState(ema=param_sharding)
+                if (masked_struct is not None
+                        and jax.tree_util.tree_structure(node)
+                        == masked_struct):
+                    return masked_moment_sharding
                 if _is_params_shaped(node):
                     return moment_sharding
                 return jax.tree_util.tree_map(

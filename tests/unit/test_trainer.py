@@ -6,6 +6,7 @@ cloud_fit/tests/unit/remote_test.py:80-127): real training steps, real
 sharding, no hardware.
 """
 
+import jax
 import numpy as np
 import optax
 import pytest
@@ -15,6 +16,7 @@ pytestmark = pytest.mark.slow  # numeric-heavy: excluded from the fast tier
 from cloud_tpu.models import MLP, ConvNet, TransformerLM, ResNet18
 from cloud_tpu.models import tensor_parallel_rules
 from cloud_tpu.parallel import runtime
+from cloud_tpu.parallel import sharding as sharding_lib
 from cloud_tpu.training import (ArrayDataset, EarlyStopping, MetricsLogger,
                                 ModelCheckpoint, Trainer, read_metrics_log)
 from cloud_tpu.training import checkpoint as checkpoint_lib
@@ -1696,3 +1698,87 @@ class TestInitialEpochGuards:
         # A trace directory was actually produced for the traced epoch.
         import os as os_lib
         assert any(os_lib.scandir(str(tmp_path)))
+
+
+class TestTrainableFreeze:
+    """Trainer(trainable=...): regex-selected params update, the rest
+    stay frozen, and frozen params allocate no optimizer moments."""
+
+    def test_frozen_params_unchanged_trainable_learn(self):
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=32, num_classes=4),
+                          optimizer=optax.adam(1e-2),
+                          trainable=r"Dense_1")
+        trainer.build(x[:4])
+        before = jax.tree_util.tree_map(np.asarray,
+                                        trainer.state.params)
+        history = trainer.fit(x, y, epochs=3, batch_size=64,
+                              verbose=False)
+        after = trainer.state.params
+        np.testing.assert_array_equal(
+            before["Dense_0"]["kernel"],
+            np.asarray(after["Dense_0"]["kernel"]))
+        np.testing.assert_array_equal(
+            before["Dense_0"]["bias"],
+            np.asarray(after["Dense_0"]["bias"]))
+        assert not np.allclose(before["Dense_1"]["kernel"],
+                               np.asarray(after["Dense_1"]["kernel"]))
+        # The head alone can still fit the linear toy problem.
+        assert history["loss"][-1] < history["loss"][0]
+
+    def test_frozen_params_allocate_no_moments(self):
+        """optax.multi_transform masking: Adam moments exist only for
+        the trainable subset."""
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=32, num_classes=4),
+                          optimizer=optax.adam(1e-2),
+                          trainable=r"Dense_1")
+        trainer.build(x[:4])
+        moment_paths = {
+            sharding_lib.path_string(path)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                trainer.state.opt_state)[0]}
+        assert any("Dense_1" in p for p in moment_paths)
+        assert not any("Dense_0" in p for p in moment_paths)
+
+    def test_callable_predicate(self):
+        x, y = _toy_classification()
+        trainer = Trainer(
+            MLP(hidden=32, num_classes=4), optimizer=optax.adam(1e-2),
+            trainable=lambda path: path.endswith("bias"))
+        trainer.build(x[:4])
+        before = jax.tree_util.tree_map(np.asarray,
+                                        trainer.state.params)
+        trainer.fit(x, y, epochs=1, batch_size=64, verbose=False)
+        after = trainer.state.params
+        np.testing.assert_array_equal(
+            before["Dense_0"]["kernel"],
+            np.asarray(after["Dense_0"]["kernel"]))
+        assert not np.allclose(before["Dense_1"]["bias"],
+                               np.asarray(after["Dense_1"]["bias"]))
+
+    def test_composes_with_zero1_moment_sharding(self):
+        """Masked moments (MaskedNode at frozen leaves) must still get
+        the ZeRO-1 dp layout — not fall into the replicated fallback."""
+        runtime.initialize(strategy="tpu_slice")  # 8-device dp mesh
+        try:
+            x, y = _toy_classification()
+            trainer = Trainer(MLP(hidden=32, num_classes=4),
+                              optimizer=optax.adam(1e-2), seed=0,
+                              zero1=True, trainable=r"Dense_0")
+            history = trainer.fit(x, y, epochs=1, batch_size=64,
+                                  verbose=False)
+            assert np.isfinite(history["loss"][-1])
+            moments = {
+                sharding_lib.path_string(path): leaf
+                for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    trainer.state.opt_state)[0]
+                if hasattr(leaf, "sharding")}
+            mu = [v for k, v in moments.items()
+                  if "Dense_0" in k and "kernel" in k and "/mu/" in k]
+            assert mu, sorted(moments)
+            # [8, 32] kernel moment: dim 0 divides the 8-wide dp axis.
+            assert "dp" in tuple(mu[0].sharding.spec), mu[0].sharding
+            assert not any("Dense_1" in k for k in moments)
+        finally:
+            runtime.reset()
