@@ -155,3 +155,70 @@ class TestGenerate:
                 generate(model, params, prompt, max_new_tokens=2,
                          rng=jax.random.PRNGKey(0), temperature=1.0,
                          top_k=bad)
+
+
+class TestTopP:
+    """Nucleus sampling: every sampled token must come from the
+    smallest top-probability set whose cumulative mass reaches top_p
+    (computed on temperature-scaled logits, HF warper order)."""
+
+    def test_samples_stay_inside_nucleus(self):
+        model = _model()
+        prompt = _prompt(b=4)
+        params = _params(model, prompt)
+        temperature, top_p = 1.3, 0.6
+
+        toks = generate(model, params, prompt, max_new_tokens=8,
+                        rng=jax.random.PRNGKey(3),
+                        temperature=temperature, top_p=top_p)
+        gen = np.asarray(toks)
+
+        # Oracle: recompute each step's full-context logits and its
+        # nucleus; the sampled token must be a member.
+        for step in range(prompt.shape[1], gen.shape[1]):
+            logits = model.apply({"params": params},
+                                 jnp.asarray(gen[:, :step]))[:, -1]
+            scaled = np.asarray(logits, np.float64) / temperature
+            for b in range(gen.shape[0]):
+                order = np.argsort(-scaled[b])
+                probs = np.exp(scaled[b][order])
+                probs /= probs.sum()
+                exclusive = np.cumsum(probs) - probs
+                nucleus = set(order[exclusive < top_p].tolist())
+                assert int(gen[b, step]) in nucleus, (
+                    "step {} batch {}: token outside the "
+                    "nucleus".format(step, b))
+
+    def test_top_p_one_matches_plain_sampling(self):
+        model = _model()
+        prompt = _prompt()
+        params = _params(model, prompt)
+        kwargs = dict(max_new_tokens=6, rng=jax.random.PRNGKey(5),
+                      temperature=1.0)
+        plain = generate(model, params, prompt, **kwargs)
+        nucleus = generate(model, params, prompt, top_p=1.0, **kwargs)
+        np.testing.assert_array_equal(np.asarray(plain),
+                                      np.asarray(nucleus))
+
+    def test_tiny_top_p_is_greedy(self):
+        model = _model()
+        prompt = _prompt()
+        params = _params(model, prompt)
+        greedy = generate(model, params, prompt, max_new_tokens=6,
+                          temperature=0.0)
+        tiny = generate(model, params, prompt, max_new_tokens=6,
+                        rng=jax.random.PRNGKey(6), temperature=1.0,
+                        top_p=1e-6)
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(tiny))
+
+    def test_top_p_validated(self):
+        model = _model()
+        prompt = _prompt()
+        params = _params(model, prompt)
+        with pytest.raises(ValueError, match="top_p"):
+            generate(model, params, prompt, 4, top_p=0.0,
+                     rng=jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="top_p"):
+            generate(model, params, prompt, 4, top_p=1.5,
+                     rng=jax.random.PRNGKey(0))
