@@ -71,6 +71,13 @@ class RopeScaling(NamedTuple):
         wavelengths, past `original_max_len`) divided by `factor`, a
         smooth interpolation between the `high_freq_factor` and
         `low_freq_factor` wavelength cutoffs.
+      - "yarn": NTK-by-parts (YaRN, arXiv 2309.00071): dimensions
+        rotating faster than `beta_fast` turns over `original_max_len`
+        keep their frequency (extrapolation), slower than `beta_slow`
+        are divided by `factor` (interpolation), with a linear ramp
+        between; the rotated vectors are additionally scaled by an
+        attention factor (`attention_factor`, or derived from factor
+        and the DeepSeek `mscale`/`mscale_all_dim` pair).
 
     A NamedTuple (not a dict) so flax module fields carrying it stay
     hashable/comparable; `models.hf_import` translates the HF config
@@ -81,9 +88,33 @@ class RopeScaling(NamedTuple):
     low_freq_factor: float = 1.0
     high_freq_factor: float = 4.0
     original_max_len: int = 8192
+    # yarn-only fields:
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    attention_factor: Optional[float] = None
+    mscale: Optional[float] = None
+    mscale_all_dim: Optional[float] = None
+    truncate: bool = True
 
 
-def _scale_rope_freqs(freqs, scaling: RopeScaling):
+def _yarn_mscale(scale, mscale=1.0):
+    if scale <= 1.0:
+        return 1.0
+    return 0.1 * mscale * float(np.log(scale)) + 1.0
+
+
+def yarn_attention_factor(scaling: RopeScaling):
+    """The cos/sin magnitude factor a yarn recipe applies to the
+    rotated q/k (HF _compute_yarn_parameters attention_factor)."""
+    if scaling.attention_factor is not None:
+        return float(scaling.attention_factor)
+    if scaling.mscale and scaling.mscale_all_dim:
+        return (_yarn_mscale(scaling.factor, scaling.mscale)
+                / _yarn_mscale(scaling.factor, scaling.mscale_all_dim))
+    return _yarn_mscale(scaling.factor)
+
+
+def _scale_rope_freqs(freqs, scaling: RopeScaling, theta, head_dim):
     """Applies a RopeScaling recipe to base inv-frequencies [D/2]."""
     if scaling.kind == "linear":
         return freqs / scaling.factor
@@ -98,9 +129,31 @@ def _scale_rope_freqs(freqs, scaling: RopeScaling):
         return jnp.where(
             wavelen < high_wl, freqs,
             jnp.where(wavelen > low_wl, freqs / scaling.factor, blended))
+    if scaling.kind == "yarn":
+        # Dimension index below which a frequency completes `rot` turns
+        # over the original context (HF find_correction_dim).
+        def correction_dim(rot):
+            return (head_dim * np.log(
+                scaling.original_max_len / (rot * 2.0 * np.pi))
+                / (2.0 * np.log(theta)))
+
+        low = correction_dim(scaling.beta_fast)
+        high = correction_dim(scaling.beta_slow)
+        if scaling.truncate:
+            low, high = np.floor(low), np.ceil(high)
+        low = max(low, 0.0)
+        high = min(high, head_dim - 1.0)
+        if high == low:
+            high += 0.001  # HF's singularity guard
+        ramp = jnp.clip(
+            (jnp.arange(head_dim // 2, dtype=jnp.float32) - low)
+            / (high - low), 0.0, 1.0)
+        extrapolation_factor = 1.0 - ramp
+        return (freqs / scaling.factor * (1.0 - extrapolation_factor)
+                + freqs * extrapolation_factor)
     raise ValueError(
-        "Unknown RopeScaling kind {!r}; expected 'linear' or "
-        "'llama3'.".format(scaling.kind))
+        "Unknown RopeScaling kind {!r}; expected 'linear', 'llama3', "
+        "or 'yarn'.".format(scaling.kind))
 
 
 def apply_rope(x, positions, theta: float = 10000.0,
@@ -128,7 +181,7 @@ def apply_rope(x, positions, theta: float = 10000.0,
     freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32)
                       / head_dim)
     if scaling is not None:
-        freqs = _scale_rope_freqs(freqs, scaling)
+        freqs = _scale_rope_freqs(freqs, scaling, theta, head_dim)
     if positions.ndim == 1:
         positions = positions[None, :]
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,S,D/2]
@@ -149,6 +202,10 @@ def apply_rope(x, positions, theta: float = 10000.0,
         raise ValueError(
             "Unknown RoPE style {!r}; expected 'interleaved' or "
             "'rotate_half'.".format(style))
+    if scaling is not None and scaling.kind == "yarn":
+        # YaRN scales the rotary cos/sin magnitudes (both q and k, so
+        # attention logits scale by the factor squared).
+        rotated = rotated * yarn_attention_factor(scaling)
     return rotated.astype(x.dtype)
 
 
