@@ -45,14 +45,15 @@ import jax.numpy as jnp
 from cloud_tpu.models.llama import LlamaLM, RopeScaling
 
 
-def _translate_rope_scaling(hf_scaling):
+def _translate_rope_scaling(hf_scaling, default_original_max=None):
     """HF `rope_scaling` config dict -> RopeScaling (or None).
 
-    Supports the "llama3" banded scheme (Llama-3.1 family) and plain
-    "linear" position compression; "default" means no transform. Other
-    schemes (yarn, dynamic, longrope) change the rotation math in ways
-    apply_rope does not implement — rejected loudly rather than
-    silently mis-rotating.
+    Supports the "llama3" banded scheme (Llama-3.1 family), "yarn"
+    NTK-by-parts (DeepSeek/Qwen long-context, incl. the DeepSeek
+    mscale pair), and plain "linear" position compression; "default"
+    means no transform. Other schemes (dynamic, longrope) change the
+    rotation math in ways apply_rope does not implement — rejected
+    loudly rather than silently mis-rotating.
     """
     if not hf_scaling:
         return None
@@ -72,9 +73,30 @@ def _translate_rope_scaling(hf_scaling):
             high_freq_factor=float(hf_scaling["high_freq_factor"]),
             original_max_len=int(
                 hf_scaling["original_max_position_embeddings"]))
+    if kind == "yarn":
+        original = (hf_scaling.get("original_max_position_embeddings")
+                    or default_original_max)
+        if not original:
+            raise ValueError(
+                "yarn rope_scaling needs original_max_position_"
+                "embeddings (or the config's max_position_embeddings).")
+        af = hf_scaling.get("attention_factor")
+        mscale = hf_scaling.get("mscale")
+        mscale_all = hf_scaling.get("mscale_all_dim")
+        return RopeScaling(
+            kind="yarn",
+            factor=float(hf_scaling["factor"]),
+            original_max_len=int(original),
+            beta_fast=float(hf_scaling.get("beta_fast") or 32.0),
+            beta_slow=float(hf_scaling.get("beta_slow") or 1.0),
+            attention_factor=(None if af is None else float(af)),
+            mscale=(None if mscale is None else float(mscale)),
+            mscale_all_dim=(None if mscale_all is None
+                            else float(mscale_all)),
+            truncate=bool(hf_scaling.get("truncate", True)))
     raise NotImplementedError(
         "This checkpoint uses rope_scaling={!r}; only 'llama3', "
-        "'linear', and 'default' import.".format(hf_scaling))
+        "'yarn', 'linear', and 'default' import.".format(hf_scaling))
 
 
 def _to_numpy(tensor):
@@ -194,7 +216,9 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     window = cfg("sliding_window", False)
     horizon = max_seq_len or cfg("max_position_embeddings", 2048)
 
-    rope_scaling = _translate_rope_scaling(cfg("rope_scaling", False))
+    rope_scaling = _translate_rope_scaling(
+        cfg("rope_scaling", False),
+        default_original_max=cfg("max_position_embeddings", 2048))
 
     # Qwen2-style biased q/k/v projections (o_proj and the MLP stay
     # bias-free in that family). Detected from the weights themselves —
@@ -591,4 +615,225 @@ def import_hf_gpt2(model=None, state_dict=None, config=None,
     return lm, {"params": params}
 
 
-__all__ = ["import_hf_llama", "import_hf_gpt2"]
+def import_hf_deepseek(model=None, state_dict=None, config=None,
+                       compute_dtype=jnp.bfloat16, attention_impl="auto",
+                       max_seq_len=None, moe_capacity_factor=None):
+    """Converts an HF DeepSeek-V2/V3 model to (DeepseekLM, variables).
+
+    Maps multi-head latent attention (q_a/q_b low-rank query path when
+    `q_lora_rank` is set, kv_a_proj_with_mqa -> kv_a + the shared rope
+    key, kv_b expansion) and the dense-then-MoE stack. Both router
+    generations import: V3's sigmoid scores + top-2-sum group limit +
+    e_score_correction_bias (a NON-LEARNED balancing buffer — exclude
+    it from weight-decay fine-tuning, e.g. Trainer(trainable=lambda p:
+    "router_bias" not in p)), and V2's softmax scores + group-MAX
+    limit (topk_method "greedy"/"group_limited_greedy") without bias
+    or top-k normalization. `rope_interleave` selects the
+    "interleaved" rope style (V2's complex-pair rotation is the same
+    convention). Imported drop-free by default
+    (moe_capacity_factor=None) for exact HF routing semantics.
+
+    Layout highlights (HF torch [out, in] -> flax [in, out(+split)]):
+
+        self_attn.q_a_proj [r_q, d]       -> attention/q_a [d, r_q]
+        self_attn.q_b_proj [H*qk, r_q]    -> attention/q_b [r_q, H, qk]
+        self_attn.kv_a_proj_with_mqa      -> attention/kv_a
+            [rank+rope, d]                   [d, rank+rope]
+        self_attn.kv_b_proj               -> attention/kv_b
+            [H*(nope+v), rank]               [rank, H, nope+v]
+        mlp.gate (router) [E, d]          -> moe/router [d, E]
+        mlp.e_score_correction_bias [E]   -> moe/router_bias
+        mlp.experts.{e}.{gate,up,down}    -> moe/expert_{gate,up,down}
+            _proj                            stacked [E, ...]
+        mlp.shared_experts.*_proj         -> moe/shared/{gate,up,down}
+
+    Yarn rope_scaling (DeepSeek's 128k long-context recipe) carries
+    through: the NTK-by-parts frequency blend and cos/sin attention
+    factor ride on RopeScaling(kind="yarn"), and the
+    mscale(factor, mscale_all_dim)^2 softmax adjustment lands in
+    `attn_scale` (HF DeepseekV3Attention.scaling).
+    """
+    from cloud_tpu.models.deepseek import DeepseekLM
+
+    state_dict, config = _unpack(model, state_dict, config)
+    cfg = _cfg_reader(config)
+
+    rope_scaling = _translate_rope_scaling(
+        cfg("rope_scaling", False),
+        default_original_max=cfg("max_position_embeddings", 2048))
+
+    d_model = cfg("hidden_size")
+    heads = cfg("num_attention_heads")
+    layers = cfg("num_hidden_layers")
+    q_rank = cfg("q_lora_rank", False) or None
+    kv_rank = cfg("kv_lora_rank")
+    nope = cfg("qk_nope_head_dim")
+    rope = cfg("qk_rope_head_dim")
+    v_dim = cfg("v_head_dim")
+    qk_dim = nope + rope
+    n_routed = int(cfg("n_routed_experts", 0) or 0)
+    first_dense = int(cfg("first_k_dense_replace", 0))
+    if not n_routed:
+        first_dense = layers  # all-dense variant
+    horizon = max_seq_len or cfg("max_position_embeddings", 2048)
+
+    # V2 vs V3 routing recipes (HF DeepseekV2MoEGate vs
+    # DeepseekV3TopkRouter): V2 scores with softmax, selects groups by
+    # their MAX score (topk_method="group_limited_greedy"; "greedy" =
+    # no group limit), has no correction bias, and never normalizes
+    # the top-k weights (its modeling ignores norm_topk_prob); V3
+    # scores with sigmoid, selects groups by top-2 sums over
+    # bias-corrected scores, and normalizes.
+    is_v2 = cfg("model_type", "deepseek_v3") == "deepseek_v2"
+    n_group = int(cfg("n_group", 1) or 1)
+    topk_group = int(cfg("topk_group", 1) or 1)
+    if is_v2:
+        moe_scoring, moe_route_bias = "softmax", False
+        moe_group_select = "max"
+        norm_topk = False
+        topk_method = cfg("topk_method", "greedy")
+        if topk_method == "greedy":
+            n_group = topk_group = 1  # no group limiting
+        elif topk_method != "group_limited_greedy":
+            raise NotImplementedError(
+                "DeepSeek-V2 topk_method={!r} is not supported."
+                .format(topk_method))
+    else:
+        moe_scoring, moe_route_bias = "sigmoid", True
+        moe_group_select = "top2sum"
+        norm_topk = bool(cfg("norm_topk_prob", True))
+
+    act = cfg("hidden_act", "silu")
+    try:
+        mlp_activation = {"silu": "silu",
+                          "gelu_pytorch_tanh": "gelu_tanh",
+                          "gelu": "gelu"}[act]
+    except KeyError:
+        raise NotImplementedError(
+            "hidden activation {!r} is not supported.".format(act))
+
+    take, consumed = _taker(state_dict)
+
+    params = {
+        "embed": {"embedding": take("model.embed_tokens.weight")},
+        "norm_final": {"scale": take("model.norm.weight")},
+    }
+    if "lm_head.weight" in state_dict:
+        params["lm_head"] = {"kernel": take("lm_head.weight").T}
+    else:
+        params["lm_head"] = {
+            "kernel": params["embed"]["embedding"].T.copy()}
+
+    for i in range(layers):
+        hf = "model.layers.{}.".format(i)
+        sa = hf + "self_attn."
+        attention = {
+            "kv_a": {"kernel": take(sa + "kv_a_proj_with_mqa.weight").T},
+            "kv_a_norm": {"scale": take(sa + "kv_a_layernorm.weight")},
+            "kv_b": {"kernel": take(sa + "kv_b_proj.weight").reshape(
+                heads, nope + v_dim, kv_rank).transpose(2, 0, 1)},
+            "out": {"kernel": take(sa + "o_proj.weight").T.reshape(
+                heads, v_dim, d_model)},
+        }
+        if q_rank:
+            attention["q_a"] = {"kernel": take(sa + "q_a_proj.weight").T}
+            attention["q_a_norm"] = {
+                "scale": take(sa + "q_a_layernorm.weight")}
+            attention["q_b"] = {"kernel": take(
+                sa + "q_b_proj.weight").reshape(
+                    heads, qk_dim, q_rank).transpose(2, 0, 1)}
+        else:
+            attention["query"] = {"kernel": take(
+                sa + "q_proj.weight").reshape(
+                    heads, qk_dim, d_model).transpose(2, 0, 1)}
+        block = {
+            "norm_attn": {"scale": take(hf + "input_layernorm.weight")},
+            "norm_mlp": {"scale": take(
+                hf + "post_attention_layernorm.weight")},
+            "attention": attention,
+        }
+        if i >= first_dense:
+            moe = hf + "mlp."
+            block["moe"] = {
+                "router": take(moe + "gate.weight").T,
+                "expert_gate": np.stack([
+                    take(moe + "experts.{}.gate_proj.weight".format(e)).T
+                    for e in range(n_routed)]),
+                "expert_up": np.stack([
+                    take(moe + "experts.{}.up_proj.weight".format(e)).T
+                    for e in range(n_routed)]),
+                "expert_down": np.stack([
+                    take(moe + "experts.{}.down_proj.weight".format(e)).T
+                    for e in range(n_routed)]),
+                "shared": {
+                    "gate": {"kernel": take(
+                        moe + "shared_experts.gate_proj.weight").T},
+                    "up": {"kernel": take(
+                        moe + "shared_experts.up_proj.weight").T},
+                    "down": {"kernel": take(
+                        moe + "shared_experts.down_proj.weight").T},
+                },
+            }
+            if moe_route_bias:
+                block["moe"]["router_bias"] = take(
+                    moe + "gate.e_score_correction_bias")
+        else:
+            block["mlp"] = {
+                "gate": {"kernel": take(hf + "mlp.gate_proj.weight").T},
+                "up": {"kernel": take(hf + "mlp.up_proj.weight").T},
+                "down": {"kernel": take(hf + "mlp.down_proj.weight").T},
+            }
+        params["block_%d" % i] = block
+
+    _check_all_consumed(state_dict, consumed, r"rotary_emb")
+
+    # DeepSeek yarn checkpoints additionally scale the softmax by
+    # mscale(factor, mscale_all_dim)^2 (HF DeepseekV3Attention.scaling).
+    attn_scale = None
+    if rope_scaling is not None and rope_scaling.kind == "yarn" \
+            and rope_scaling.mscale_all_dim:
+        from cloud_tpu.models.llama import _yarn_mscale
+        mscale = _yarn_mscale(rope_scaling.factor,
+                              rope_scaling.mscale_all_dim)
+        attn_scale = qk_dim ** -0.5 * mscale * mscale
+
+    lm = DeepseekLM(
+        vocab_size=cfg("vocab_size"),
+        num_layers=layers,
+        num_heads=heads,
+        d_model=d_model,
+        d_ff=cfg("intermediate_size"),
+        max_seq_len=horizon,
+        kv_lora_rank=kv_rank,
+        qk_nope_head_dim=nope,
+        qk_rope_head_dim=rope,
+        v_head_dim=v_dim,
+        q_lora_rank=q_rank,
+        rope_theta=float(cfg("rope_theta", 10000.0)),
+        rope_style=("interleaved" if cfg("rope_interleave", True)
+                    else "rotate_half"),
+        rope_scaling=rope_scaling,
+        attn_scale=attn_scale,
+        norm_eps=float(cfg("rms_norm_eps", 1e-6)),
+        compute_dtype=compute_dtype,
+        attention_impl=attention_impl,
+        mlp_activation=mlp_activation,
+        moe_experts=n_routed,
+        moe_top_k=int(cfg("num_experts_per_tok", 2) or 2),
+        moe_d_ff=int(cfg("moe_intermediate_size", 0)
+                     or cfg("intermediate_size")),
+        first_k_dense=first_dense,
+        n_group=n_group,
+        topk_group=topk_group,
+        norm_topk_prob=norm_topk,
+        routed_scaling_factor=float(cfg("routed_scaling_factor", 1.0)),
+        n_shared_experts=int(cfg("n_shared_experts", 1) or 1),
+        moe_capacity_factor=moe_capacity_factor,
+        moe_scoring=moe_scoring,
+        moe_group_select=moe_group_select,
+        moe_route_bias=moe_route_bias,
+    )
+    return lm, {"params": params}
+
+
+__all__ = ["import_hf_llama", "import_hf_gpt2", "import_hf_deepseek"]

@@ -175,48 +175,66 @@ class TopKMoEMLP(nn.Module):
         aux_loss = self.num_experts * jnp.sum(
             sel.sum(axis=1).mean(axis=0) * probs.mean(axis=0))
 
-        # Capacity assignment, slot-major: all top-1 assignments claim
-        # queue positions before any top-2 assignment, so dropping
-        # (when capacity binds) sheds the lowest-gate routes first.
-        sel_sm = jnp.transpose(sel, (1, 0, 2)).reshape(
-            k * tokens, self.num_experts)                 # [kT, E]
-        position = (jnp.cumsum(sel_sm, axis=0) - 1.0) * sel_sm
-        keep = (position < capacity).astype(jnp.float32) * sel_sm
-        slot = jnp.sum(position * keep, axis=-1).astype(jnp.int32)
-        slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        out = routed_expert_ffn(self, x.reshape(tokens, d_model),
+                                top_idx, gates, self.num_experts,
+                                self.d_ff, capacity, act,
+                                self.compute_dtype)
+        return out.reshape(batch, seq, d_model).astype(x.dtype), aux_loss
 
-        # dispatch[t, e, c] = 1 iff token t occupies slot c of expert e
-        # via ANY of its k routes (routes are distinct experts, so the
-        # sum over slots never overlaps); combine carries the gate.
-        disp = (keep[:, :, None] * slot_oh[:, None, :]).reshape(
-            k, tokens, self.num_experts, capacity)
-        dispatch = disp.sum(axis=0)                       # [T, E, C]
-        gates_sm = jnp.transpose(gates, (1, 0)).reshape(k, tokens)
-        combine = (disp * gates_sm[:, :, None, None]).sum(axis=0)
 
-        xf = x.reshape(tokens, d_model).astype(self.compute_dtype)
-        expert_in = jnp.einsum("tec,td->ecd",
-                               dispatch.astype(self.compute_dtype), xf)
-        init = nn.initializers.lecun_normal(batch_axis=(0,))
-        w_gate = self.param("expert_gate", init,
-                            (self.num_experts, d_model, self.d_ff),
-                            jnp.float32)
-        w_up = self.param("expert_up", init,
-                          (self.num_experts, d_model, self.d_ff),
-                          jnp.float32)
-        w_down = self.param("expert_down", init,
-                            (self.num_experts, self.d_ff, d_model),
-                            jnp.float32)
-        g = jnp.einsum("ecd,edf->ecf", expert_in,
-                       w_gate.astype(self.compute_dtype))
-        u = jnp.einsum("ecd,edf->ecf", expert_in,
-                       w_up.astype(self.compute_dtype))
-        expert_out = jnp.einsum("ecf,efd->ecd", act(g) * u,
-                                w_down.astype(self.compute_dtype))
-        out = jnp.einsum("tec,ecd->td",
-                         combine.astype(self.compute_dtype), expert_out)
-        return (out.reshape(batch, seq, d_model).astype(x.dtype),
-                aux_loss)
+def routed_expert_ffn(module, x2d, top_idx, gates, num_experts, d_ff,
+                      capacity, act, compute_dtype):
+    """Dense-dispatch top-k SwiGLU expert computation, shared by
+    `TopKMoEMLP` (Mixtral) and `models.deepseek.DeepseekMoE`.
+
+    x2d: [T, d] tokens; top_idx/gates: [T, k] selected experts and
+    combine weights (any routing recipe). Creates the stacked
+    expert_gate/up/down params on `module` (the caller's @nn.compact
+    scope) so `expert_parallel_rules` shards them over "ep".
+
+    Capacity assignment is slot-major: all slot-0 (highest-gate)
+    assignments claim expert queue positions before any slot-1
+    assignment, so when capacity binds the lowest-priority routes are
+    shed first. dispatch[t, e, c] = 1 iff token t occupies slot c of
+    expert e via ANY of its k routes (routes are distinct experts, so
+    the sum over slots never overlaps); combine carries the gate.
+    Returns [T, d] in compute_dtype.
+    """
+    tokens, d_model = x2d.shape
+    k = top_idx.shape[1]
+    sel = jax.nn.one_hot(top_idx, num_experts, dtype=jnp.float32)
+    sel_sm = jnp.transpose(sel, (1, 0, 2)).reshape(
+        k * tokens, num_experts)                      # [kT, E]
+    position = (jnp.cumsum(sel_sm, axis=0) - 1.0) * sel_sm
+    keep = (position < capacity).astype(jnp.float32) * sel_sm
+    slot = jnp.sum(position * keep, axis=-1).astype(jnp.int32)
+    slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+
+    disp = (keep[:, :, None] * slot_oh[:, None, :]).reshape(
+        k, tokens, num_experts, capacity)
+    dispatch = disp.sum(axis=0)                       # [T, E, C]
+    gates_sm = jnp.transpose(gates, (1, 0)).reshape(k, tokens)
+    combine = (disp * gates_sm[:, :, None, None].astype(
+        jnp.float32)).sum(axis=0)
+
+    xf = x2d.astype(compute_dtype)
+    expert_in = jnp.einsum("tec,td->ecd",
+                           dispatch.astype(compute_dtype), xf)
+    init = nn.initializers.lecun_normal(batch_axis=(0,))
+    w_gate = module.param("expert_gate", init,
+                          (num_experts, d_model, d_ff), jnp.float32)
+    w_up = module.param("expert_up", init,
+                        (num_experts, d_model, d_ff), jnp.float32)
+    w_down = module.param("expert_down", init,
+                          (num_experts, d_ff, d_model), jnp.float32)
+    g = jnp.einsum("ecd,edf->ecf", expert_in,
+                   w_gate.astype(compute_dtype))
+    u = jnp.einsum("ecd,edf->ecf", expert_in,
+                   w_up.astype(compute_dtype))
+    expert_out = jnp.einsum("ecf,efd->ecd", act(g) * u,
+                            w_down.astype(compute_dtype))
+    return jnp.einsum("tec,ecd->td",
+                      combine.astype(compute_dtype), expert_out)
 
 
 def expert_parallel_rules(ep_axis: str = "ep"):

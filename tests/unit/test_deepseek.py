@@ -1,0 +1,264 @@
+"""DeepSeek family: MLA attention + sigmoid group-limited MoE.
+
+Logits parity against `transformers.DeepseekV3ForCausalLM` is the
+oracle for the import path (layout + rope-interleave + routing
+semantics); the compressed-latent decode cache is pinned against
+incremental full-context forwards; the MLA flash path (v zero-padded
+to the key width) is pinned against the jnp reference.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from cloud_tpu.models.hf_import import import_hf_deepseek  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def torch():
+    return pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def transformers():
+    return pytest.importorskip("transformers")
+
+
+def _tiny_hf_deepseek(transformers, torch, **overrides):
+    kwargs = dict(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=24, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=4,
+        q_lora_rank=24, kv_lora_rank=16,
+        qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+        n_routed_experts=8, num_experts_per_tok=2,
+        n_group=2, topk_group=1, n_shared_experts=1,
+        routed_scaling_factor=1.5, norm_topk_prob=True,
+        first_k_dense_replace=1, max_position_embeddings=32,
+        rope_theta=10000.0, rms_norm_eps=1e-6,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        tie_word_embeddings=False, attn_implementation="eager")
+    kwargs.update(overrides)
+    config = transformers.DeepseekV3Config(**kwargs)
+    torch.manual_seed(0)
+    return transformers.DeepseekV3ForCausalLM(config)
+
+
+class TestDeepseekImport:
+
+    def test_logits_match_torch(self, transformers, torch):
+        """Full recipe: q LoRA, 2-group routing limited to 1 group,
+        perturbed score-correction bias (so selection-vs-gate scores
+        actually differ), shared expert, dense first layer."""
+        hf = _tiny_hf_deepseek(transformers, torch).eval()
+        with torch.no_grad():
+            for layer in hf.model.layers[1:]:
+                layer.mlp.gate.e_score_correction_bias.add_(
+                    0.1 * torch.randn(8))
+        tokens = np.random.default_rng(0).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_deepseek(hf, compute_dtype=jnp.float32)
+        assert lm.q_lora_rank == 24
+        assert lm.n_group == 2 and lm.topk_group == 1
+        assert lm.first_k_dense == 1
+        assert lm.rope_style == "interleaved"
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
+
+    def test_no_q_lora_and_multi_shared(self, transformers, torch):
+        hf = _tiny_hf_deepseek(
+            transformers, torch, q_lora_rank=None, n_routed_experts=4,
+            n_group=1, topk_group=1, n_shared_experts=2,
+            routed_scaling_factor=2.0, num_hidden_layers=2).eval()
+        tokens = np.random.default_rng(1).integers(0, 64, size=(2, 12))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_deepseek(hf, compute_dtype=jnp.float32)
+        assert lm.q_lora_rank is None
+        assert lm.n_shared_experts == 2
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
+
+    def test_decode_cache_matches_full_forward(self, transformers,
+                                               torch):
+        """The compressed-latent decode cache (latent + shared rope key,
+        re-expanded through kv_b each step) must reproduce full-context
+        greedy decoding token-for-token."""
+        from cloud_tpu.models import generate
+
+        hf = _tiny_hf_deepseek(transformers, torch,
+                               num_hidden_layers=2).eval()
+        lm, variables = import_hf_deepseek(hf, compute_dtype=jnp.float32,
+                                           max_seq_len=20)
+        prompt = jnp.asarray(
+            np.random.default_rng(2).integers(0, 64, size=(2, 6)),
+            jnp.int32)
+        out = generate(lm, variables["params"], prompt, 6,
+                       rng=jax.random.PRNGKey(0), temperature=0.0)
+        tokens = np.asarray(prompt)
+        for _ in range(6):
+            logits = lm.apply(variables, jnp.asarray(tokens, jnp.int32))
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            tokens = np.concatenate([tokens, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), tokens)
+
+    def test_cache_stores_latent_not_expanded_kv(self, transformers,
+                                                 torch):
+        """The MLA memory win: the decode cache must hold the
+        [B, L, kv_lora_rank] latent + [B, L, 1, rope] key, not an
+        expanded [B, L, H, nope+v] tensor."""
+        hf = _tiny_hf_deepseek(transformers, torch,
+                               num_hidden_layers=2).eval()
+        lm, variables = import_hf_deepseek(hf, compute_dtype=jnp.float32,
+                                           max_seq_len=16)
+        decoder = lm.clone(decode=True, dropout_rate=0.0)
+        cache = decoder.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((2, 1), jnp.int32))["cache"]
+        attn = cache["block_0"]["attention"]
+        assert attn["cached_latent"].shape == (2, 16, 16)  # kv_rank 16
+        assert attn["cached_rope"].shape == (2, 16, 1, 4)  # rope dim 4
+        assert "cached_key" not in attn and "cached_value" not in attn
+
+    def test_v2_group_limited_greedy_matches_torch(self, transformers,
+                                                   torch):
+        """DeepSeek-V2: softmax router scores, group-MAX node-limited
+        selection, no correction bias, no top-k normalization."""
+        config = transformers.DeepseekV2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, num_hidden_layers=3,
+            num_attention_heads=4, num_key_value_heads=4,
+            q_lora_rank=24, kv_lora_rank=16,
+            qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+            n_routed_experts=8, num_experts_per_tok=2,
+            topk_method="group_limited_greedy", n_group=2, topk_group=1,
+            n_shared_experts=1, routed_scaling_factor=1.5,
+            first_k_dense_replace=1, max_position_embeddings=32,
+            pad_token_id=0, bos_token_id=1, eos_token_id=2,
+            tie_word_embeddings=False, attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.DeepseekV2ForCausalLM(config).eval()
+        tokens = np.random.default_rng(6).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_deepseek(hf, compute_dtype=jnp.float32)
+        assert lm.moe_scoring == "softmax"
+        assert lm.moe_group_select == "max"
+        assert lm.moe_route_bias is False
+        assert lm.norm_topk_prob is False
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
+
+    def test_v2_lite_greedy_matches_torch(self, transformers, torch):
+        """V2-Lite shape: plain top-k routing (no group limit), no
+        query LoRA."""
+        config = transformers.DeepseekV2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4,
+            q_lora_rank=None, kv_lora_rank=16,
+            qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+            n_routed_experts=4, num_experts_per_tok=2,
+            topk_method="greedy", n_shared_experts=2,
+            routed_scaling_factor=1.0, first_k_dense_replace=1,
+            max_position_embeddings=32, pad_token_id=0, bos_token_id=1,
+            eos_token_id=2, tie_word_embeddings=False,
+            attn_implementation="eager")
+        torch.manual_seed(1)
+        hf = transformers.DeepseekV2ForCausalLM(config).eval()
+        tokens = np.random.default_rng(7).integers(0, 64, size=(2, 12))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_deepseek(hf, compute_dtype=jnp.float32)
+        assert lm.n_group == 1
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
+
+    def test_yarn_with_mscale_matches_torch(self, transformers, torch):
+        """DeepSeek's 128k recipe: yarn frequency blend + the
+        mscale/mscale_all_dim attention-factor ratio on cos/sin + the
+        mscale(factor, mscale_all_dim)^2 softmax scale. Distinct
+        mscale values so each term is discriminating; seq past the
+        original context so the interpolation binds."""
+        hf = _tiny_hf_deepseek(
+            transformers, torch, num_hidden_layers=2,
+            max_position_embeddings=64,
+            n_routed_experts=4, n_group=1, topk_group=1,
+            rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                          "original_max_position_embeddings": 16,
+                          "beta_fast": 32, "beta_slow": 1,
+                          "mscale": 1.2, "mscale_all_dim": 0.8},
+        ).eval()
+        tokens = np.random.default_rng(5).integers(0, 64, size=(2, 40))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_deepseek(hf, compute_dtype=jnp.float32)
+        assert lm.rope_scaling.kind == "yarn"
+        assert lm.attn_scale is not None
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=3e-4, rtol=3e-4)
+
+
+class TestMLAttentionPaths:
+
+    def test_flash_matches_reference_impl(self):
+        """The padded-v flash path must equal the reference path — the
+        zero columns of V contribute exactly zero."""
+        from cloud_tpu.models.deepseek import MLAttention
+
+        def build(impl):
+            return MLAttention(num_heads=4, kv_lora_rank=16,
+                               qk_nope_head_dim=8, qk_rope_head_dim=8,
+                               v_head_dim=8, q_lora_rank=12,
+                               compute_dtype=jnp.float32,
+                               attention_impl=impl)
+
+        x = jnp.asarray(
+            np.random.default_rng(3).normal(size=(2, 128, 32)),
+            jnp.float32)
+        params = build("reference").init(jax.random.PRNGKey(0), x)
+        ref = build("reference").apply(params, x)
+        flash = build("flash").apply(params, x)
+        np.testing.assert_allclose(np.asarray(flash), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_trains_from_scratch_with_capacity(self):
+        """DeepseekLM with a binding capacity factor (the training
+        configuration, not the drop-free import one) fits through the
+        Trainer and the loss decreases."""
+        import optax
+
+        from cloud_tpu.models import DeepseekLM
+        from cloud_tpu.training import Trainer
+
+        lm = DeepseekLM(vocab_size=32, num_layers=2, num_heads=2,
+                        d_model=16, d_ff=32, max_seq_len=16,
+                        kv_lora_rank=8, qk_nope_head_dim=8,
+                        qk_rope_head_dim=4, v_head_dim=8,
+                        compute_dtype=jnp.float32, moe_experts=4,
+                        moe_top_k=2, moe_d_ff=16, first_k_dense=1,
+                        moe_capacity_factor=1.5)
+
+        def lm_loss(logits, y):
+            oh = jax.nn.one_hot(y, logits.shape[-1])
+            return -jnp.mean(
+                jnp.sum(oh * jax.nn.log_softmax(logits), -1))
+
+        x = np.random.default_rng(4).integers(
+            0, 32, size=(16, 12)).astype(np.int32)
+        y = np.roll(x, -1, axis=1)
+        trainer = Trainer(lm, optimizer=optax.adam(1e-2), loss=lm_loss,
+                          metrics=())
+        history = trainer.fit((x, y), epochs=3, batch_size=8,
+                              verbose=False)
+        assert history["loss"][-1] < history["loss"][0]

@@ -167,11 +167,31 @@ class TestHFImport:
             lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
         np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
 
-    def test_rope_scaling_yarn_rejected(self, transformers, torch):
+    def test_rope_scaling_yarn_matches_torch(self, transformers, torch):
+        """YaRN NTK-by-parts: logits parity at a sequence length past
+        the original context, where both the interpolated frequencies
+        and the attention factor bind."""
+        hf = _tiny_hf_llama(
+            transformers, torch, max_position_embeddings=64,
+            rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                          "original_max_position_embeddings": 16},
+        ).eval()
+        tokens = np.random.default_rng(17).integers(0, 64, size=(2, 48))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.rope_scaling.kind == "yarn"
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_rope_scaling_longrope_rejected(self, transformers, torch):
         """Unimplemented schemes must still fail loudly, not silently
         mis-rotate."""
         hf = _tiny_hf_llama(transformers, torch)
-        hf.config.rope_scaling = {"rope_type": "yarn", "factor": 8.0}
+        hf.config.rope_scaling = {
+            "rope_type": "longrope", "factor": 8.0,
+            "short_factor": [1.0] * 4, "long_factor": [2.0] * 4}
         with pytest.raises(NotImplementedError, match="rope_scaling"):
             import_hf_llama(hf)
 
