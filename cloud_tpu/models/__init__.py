@@ -1,7 +1,8 @@
 from cloud_tpu.models.llama import (GQAttention, LlamaLM, RopeScaling,
                                     llama_tensor_parallel_rules)
 from cloud_tpu.models.deepseek import (DeepseekLM, DeepseekMoE,
-                                       MLAttention)
+                                       MLAttention,
+                                       deepseek_tensor_parallel_rules)
 from cloud_tpu.models.mnist import MLP, ConvNet
 from cloud_tpu.models.resnet import (ResNet, ResNet18, ResNet34, ResNet50,
                                      ResNet101, ResNet152)

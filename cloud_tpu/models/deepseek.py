@@ -224,8 +224,14 @@ class DeepseekMoE(nn.Module):
       scores at the winners, optionally sum-normalized, then scaled by
       routed_scaling_factor.
     Routed output + always-on shared SwiGLU expert (d_ff scaled by
-    n_shared_experts). Returns the combined [B, S, d] output (no aux
-    loss — V3 balances via the bias, not a loss term).
+    n_shared_experts). Returns (output, aux_loss): the aux loss is the
+    Switch/Mixtral-style balance term over per-token-NORMALIZED scores
+    (checkpoint forward outputs are unaffected — it is only sown by
+    DeepseekBlock into "losses"). V3 checkpoints were TRAINED with
+    aux-free bias updates instead, so when fine-tuning an imported
+    model to match HF exactly set Trainer(aux_loss_weight=0); for
+    from-scratch training the aux term is what counteracts router
+    collapse (this implementation does not update the selection bias).
     """
 
     num_experts: int = 8
@@ -310,6 +316,16 @@ class DeepseekMoE(nn.Module):
             gates = gates / (gates.sum(axis=-1, keepdims=True) + 1e-20)
         gates = gates * self.routed_scaling_factor
 
+        # Balance term at the Mixtral scale (num_experts * sum f_e*P_e,
+        # = top_k when uniform), over per-token-normalized scores so
+        # sigmoid and softmax scoring share a scale.
+        sel = jax.nn.one_hot(top_idx, self.num_experts,
+                             dtype=jnp.float32)
+        norm_scores = scores / (scores.sum(axis=-1, keepdims=True)
+                                + 1e-20)
+        aux_loss = self.num_experts * jnp.sum(
+            sel.sum(axis=1).mean(axis=0) * norm_scores.mean(axis=0))
+
         if self.capacity_factor is None:
             capacity = tokens
         else:
@@ -321,8 +337,9 @@ class DeepseekMoE(nn.Module):
         shared = SwiGLU(self.d_ff * self.n_shared_experts,
                         self.compute_dtype, activation=self.activation,
                         name="shared")(x)
-        return (routed.reshape(batch, seq, d_model)
-                + shared).astype(x.dtype)
+        out = (routed.reshape(batch, seq, d_model) + shared).astype(
+            x.dtype)
+        return out, aux_loss
 
 
 class DeepseekBlock(nn.Module):
@@ -380,20 +397,26 @@ class DeepseekBlock(nn.Module):
         x = x + y
         y = norm("norm_mlp")(x)
         if self.moe_experts:
-            y = DeepseekMoE(num_experts=self.moe_experts,
-                            top_k=self.moe_top_k, d_ff=self.moe_d_ff,
-                            n_group=self.n_group,
-                            topk_group=self.topk_group,
-                            norm_topk_prob=self.norm_topk_prob,
-                            routed_scaling_factor=self.routed_scaling_factor,
-                            n_shared_experts=self.n_shared_experts,
-                            capacity_factor=self.moe_capacity_factor,
-                            compute_dtype=self.compute_dtype,
-                            activation=self.mlp_activation,
-                            scoring=self.moe_scoring,
-                            group_select=self.moe_group_select,
-                            route_bias=self.moe_route_bias,
-                            name="moe")(y, deterministic)
+            y, aux_loss = DeepseekMoE(
+                num_experts=self.moe_experts,
+                top_k=self.moe_top_k, d_ff=self.moe_d_ff,
+                n_group=self.n_group,
+                topk_group=self.topk_group,
+                norm_topk_prob=self.norm_topk_prob,
+                routed_scaling_factor=self.routed_scaling_factor,
+                n_shared_experts=self.n_shared_experts,
+                capacity_factor=self.moe_capacity_factor,
+                compute_dtype=self.compute_dtype,
+                activation=self.mlp_activation,
+                scoring=self.moe_scoring,
+                group_select=self.moe_group_select,
+                route_bias=self.moe_route_bias,
+                name="moe")(y, deterministic)
+            # Summed into the training loss by Trainer when "losses"
+            # is mutable; set aux_loss_weight=0 to fine-tune imported
+            # checkpoints exactly like HF (which emits no aux term).
+            self.sow("losses", "moe_aux_loss", aux_loss,
+                     reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0)
         else:
             y = SwiGLU(self.d_ff, self.compute_dtype,
                        activation=self.mlp_activation, name="mlp")(y)
@@ -491,4 +514,37 @@ class DeepseekLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-__all__ = ["MLAttention", "DeepseekMoE", "DeepseekBlock", "DeepseekLM"]
+def deepseek_tensor_parallel_rules(tp_axis: str = "tp"):
+    """Megatron-style layout for DeepseekLM, the MLA counterpart of
+    `llama_tensor_parallel_rules` (for `Trainer(param_sharding_rules=)`,
+    first-match-wins):
+
+    - the low-rank bottlenecks (q_a, kv_a) stay REPLICATED: they are
+      tiny, their RMSNorms need the full latent vector, and the shared
+      rope key must exist on every shard;
+    - the head-expanding projections (q_b / query / kv_b) are
+      column-parallel over heads and `out` is row-parallel — the same
+      two-collective block shape as the dense families (requires
+      num_heads % tp == 0);
+    - the always-on shared expert and the dense first-k MLPs split
+      gate/up column- and down row-parallel; the router (and its bias)
+      replicate, and the routed expert stacks are left for
+      `expert_parallel_rules` ("ep") to shard — compose the two rule
+      lists for tp x ep meshes.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"attention/(q_b|query|kv_b)/kernel", P(None, tp_axis, None)),
+        (r"attention/out/kernel", P(tp_axis, None, None)),
+        (r"moe/shared/(gate|up)/kernel", P(None, tp_axis)),
+        (r"moe/shared/down/kernel", P(tp_axis, None)),
+        (r"mlp/(gate|up)/kernel", P(None, tp_axis)),
+        (r"mlp/down/kernel", P(tp_axis, None)),
+        (r"(^|/)embed/embedding", P(tp_axis, None)),
+        (r"lm_head/kernel", P(None, tp_axis)),
+    ]
+
+
+__all__ = ["MLAttention", "DeepseekMoE", "DeepseekBlock", "DeepseekLM",
+           "deepseek_tensor_parallel_rules"]

@@ -169,6 +169,19 @@ def _check_all_consumed(state_dict, consumed, skip_pattern):
                 leftover[:8]))
 
 
+def _mlp_activation(act):
+    """HF hidden_act name -> SwiGLU activation name (shared by every
+    importer so new activations land everywhere at once)."""
+    try:
+        return {"silu": "silu",
+                "gelu_pytorch_tanh": "gelu_tanh",
+                "gelu": "gelu"}[act]
+    except KeyError:
+        raise NotImplementedError(
+            "hidden activation {!r} is not supported (silu / "
+            "gelu_pytorch_tanh / gelu import).".format(act))
+
+
 def import_hf_llama(model=None, state_dict=None, config=None,
                     compute_dtype=jnp.bfloat16, attention_impl="auto",
                     max_seq_len=None):
@@ -262,16 +275,9 @@ def import_hf_llama(model=None, state_dict=None, config=None,
             "supported; causal gemma3_text imports.")
     is_gemma = model_type == "gemma"
     gemma_family = is_gemma or is_gemma2 or is_gemma3
-    act = cfg("hidden_activation", False) or cfg("hidden_act", False) \
-        or ("gelu_pytorch_tanh" if gemma_family else "silu")
-    try:
-        mlp_activation = {"silu": "silu",
-                          "gelu_pytorch_tanh": "gelu_tanh",
-                          "gelu": "gelu"}[act]
-    except KeyError:
-        raise NotImplementedError(
-            "hidden activation {!r} is not supported (silu / "
-            "gelu_pytorch_tanh / gelu import).".format(act))
+    mlp_activation = _mlp_activation(
+        cfg("hidden_activation", False) or cfg("hidden_act", False)
+        or ("gelu_pytorch_tanh" if gemma_family else "silu"))
 
     def norm_scale(w):
         # HF Gemma RMSNorm computes x * (1 + weight); flax RMSNorm
@@ -703,14 +709,7 @@ def import_hf_deepseek(model=None, state_dict=None, config=None,
         moe_group_select = "top2sum"
         norm_topk = bool(cfg("norm_topk_prob", True))
 
-    act = cfg("hidden_act", "silu")
-    try:
-        mlp_activation = {"silu": "silu",
-                          "gelu_pytorch_tanh": "gelu_tanh",
-                          "gelu": "gelu"}[act]
-    except KeyError:
-        raise NotImplementedError(
-            "hidden activation {!r} is not supported.".format(act))
+    mlp_activation = _mlp_activation(cfg("hidden_act", "silu"))
 
     take, consumed = _taker(state_dict)
 
