@@ -470,8 +470,9 @@ def _kernel_parity_smoke(jax):
     Mosaic compile/layout failure would first surface during the
     benchmark itself. This runs the real kernel (forward AND grad) on
     whatever backend the worker measured on; on TPU that is the
-    compiled Mosaic kernel. ~30s budget, [2,256,4,64] shapes, three
-    configs: causal MHA, masked non-causal MHA, causal+masked GQA.
+    compiled Mosaic kernel. ~30s budget, [2,256,4,64] shapes, four
+    configs: causal MHA, masked non-causal MHA, causal+masked GQA,
+    and softcapped causal MHA (the Gemma2 tanh-capping path).
     Returns "ok", or "fail: ..."/"error: ..." without sinking the
     throughput record.
     """
@@ -492,25 +493,31 @@ def _kernel_parity_smoke(jax):
                 np.array([[s], [192]])).astype(bool)
         mask = jnp.asarray(mask)
         configs = [
-            ("causal", h, True, None),
-            ("masked", h, False, mask),
-            ("gqa", h // 2, True, mask),
+            ("causal", h, True, None, None),
+            ("masked", h, False, mask, None),
+            ("gqa", h // 2, True, mask, None),
+            # Gemma2-style tanh capping: exercises the softcap forward
+            # + backward kernel paths under real Mosaic lowering
+            # (interpret mode never checks layout/shape legality).
+            ("softcap", h, True, None, 30.0),
         ]
-        for name, h_kv, causal, m in configs:
+        for name, h_kv, causal, m, cap in configs:
             k = jax.random.normal(kk, (b, s, h_kv, d), dtype=jnp.float32)
             v = jax.random.normal(kv, (b, s, h_kv, d), dtype=jnp.float32)
 
-            def loss_flash(q, k, v, causal=causal, m=m):
+            def loss_flash(q, k, v, causal=causal, m=m, cap=cap):
                 return flash_attention(q, k, v, causal=causal,
-                                       mask=m).sum()
+                                       mask=m, logit_softcap=cap).sum()
 
-            def loss_ref(q, k, v, causal=causal, m=m):
+            def loss_ref(q, k, v, causal=causal, m=m, cap=cap):
                 return mha_reference(q, k, v, causal=causal,
-                                     mask=m).sum()
+                                     mask=m, logit_softcap=cap).sum()
 
             out = jax.jit(lambda q, k, v: flash_attention(
-                q, k, v, causal=causal, mask=m))(q, k, v)
-            ref = mha_reference(q, k, v, causal=causal, mask=m)
+                q, k, v, causal=causal, mask=m,
+                logit_softcap=cap))(q, k, v)
+            ref = mha_reference(q, k, v, causal=causal, mask=m,
+                                logit_softcap=cap)
             fwd_err = float(jax.device_get(
                 jnp.max(jnp.abs(out - ref))))
             g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(
