@@ -312,13 +312,37 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     if is_gemma2 or is_gemma3:
         attn_scale = float(cfg("query_pre_attn_scalar")) ** -0.5
 
-    # Mixtral: top-k routed MoE FFN in every block. Imported drop-free
-    # (capacity_factor=None) so inference matches HF exactly — HF
-    # never drops tokens; set a capacity factor for large-scale
-    # fine-tuning and let the aux loss balance load.
+    # Qwen3-style per-head q/k RMSNorm (standard scale, unlike Gemma3's
+    # (1+w) fold which norm_scale handles) — detected from the weights;
+    # the module-side mechanism is shared with Gemma3.
+    has_qk_norm = ("model.layers.0.self_attn.q_norm.weight"
+                   in state_dict)
+
+    # Mixtral / Qwen3-MoE: top-k routed MoE FFN in every block.
+    # Imported drop-free (capacity_factor=None) so inference matches HF
+    # exactly — HF never drops tokens; set a capacity factor for
+    # large-scale fine-tuning and let the aux loss balance load.
     is_mixtral = model_type == "mixtral"
-    moe_experts = int(cfg("num_local_experts", 8)) if is_mixtral else 0
-    moe_top_k = int(cfg("num_experts_per_tok", 2)) if is_mixtral else 2
+    is_qwen3_moe = model_type == "qwen3_moe"
+    if is_qwen3_moe:
+        if cfg("mlp_only_layers", False) or \
+                int(cfg("decoder_sparse_step", 1) or 1) != 1:
+            raise NotImplementedError(
+                "qwen3_moe with dense layers interleaved "
+                "(mlp_only_layers / decoder_sparse_step != 1) is not "
+                "supported; LlamaLM's MoE applies to every block.")
+        moe_experts = int(cfg("num_experts"))
+        d_ff = int(cfg("moe_intermediate_size"))
+    else:
+        moe_experts = (int(cfg("num_local_experts", 8))
+                       if is_mixtral else 0)
+        d_ff = cfg("intermediate_size")
+    moe_top_k = (int(cfg("num_experts_per_tok", 2))
+                 if (is_mixtral or is_qwen3_moe) else 2)
+    # Qwen3MoeConfig defaults norm_topk_prob to FALSE — a raw config
+    # dict missing the key must import with HF's default, not ours.
+    moe_norm_topk = (bool(cfg("norm_topk_prob", False))
+                     if is_qwen3_moe else True)
 
     take, consumed = _taker(state_dict)
 
@@ -373,8 +397,9 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         o = take(hf + "self_attn.o_proj.weight")  # [d, H*hd]
         attention = dict(
             qkv, out={"kernel": o.T.reshape(heads, head_dim, d_model)})
-        if is_gemma3:
-            # Per-head q/k RMSNorm, scale shared across heads ([hd]).
+        if has_qk_norm:
+            # Per-head q/k RMSNorm, scale shared across heads ([hd]);
+            # Gemma3 and Qwen3 (norm_scale folds Gemma's +1 only).
             attention["q_norm"] = {"scale": norm_scale(
                 take(hf + "self_attn.q_norm.weight"))}
             attention["k_norm"] = {"scale": norm_scale(
@@ -384,21 +409,24 @@ def import_hf_llama(model=None, state_dict=None, config=None,
                 take(hf + "input_layernorm.weight"))},
             "attention": attention,
         }
-        if is_mixtral:
-            # block_sparse_moe: gate.weight [E, d] is the router;
-            # experts.{e}.{w1,w3,w2} are the SwiGLU gate/up/down,
-            # stacked on a leading expert dim for TopKMoEMLP.
-            moe = hf + "block_sparse_moe."
+        if is_mixtral or is_qwen3_moe:
+            # Mixtral block_sparse_moe.{gate, experts.e.w1/w3/w2} or
+            # Qwen3-MoE mlp.{gate, experts.e.gate/up/down_proj}:
+            # gate.weight [E, d] is the router; experts stack on a
+            # leading expert dim for TopKMoEMLP.
+            moe = hf + ("block_sparse_moe." if is_mixtral else "mlp.")
+            g, u, dn = (("w1", "w3", "w2") if is_mixtral
+                        else ("gate_proj", "up_proj", "down_proj"))
             block["moe"] = {
                 "router": take(moe + "gate.weight").T,  # [d, E]
                 "expert_gate": np.stack([
-                    take(moe + "experts.{}.w1.weight".format(e)).T
+                    take(moe + "experts.{}.{}.weight".format(e, g)).T
                     for e in range(moe_experts)]),      # [E, d, f]
                 "expert_up": np.stack([
-                    take(moe + "experts.{}.w3.weight".format(e)).T
+                    take(moe + "experts.{}.{}.weight".format(e, u)).T
                     for e in range(moe_experts)]),
                 "expert_down": np.stack([
-                    take(moe + "experts.{}.w2.weight".format(e)).T
+                    take(moe + "experts.{}.{}.weight".format(e, dn)).T
                     for e in range(moe_experts)]),      # [E, f, d]
             }
         elif fused_gate_up:
@@ -441,7 +469,7 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         num_heads=heads,
         num_kv_heads=kv_heads,
         d_model=d_model,
-        d_ff=cfg("intermediate_size"),
+        d_ff=d_ff,
         max_seq_len=horizon,
         rope_theta=float(cfg("rope_theta", 10000.0)),
         rope_style="rotate_half",
@@ -462,7 +490,7 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         final_logit_softcap=(
             float(cfg("final_logit_softcapping", 0) or 0) or None
             if is_gemma2 else None),
-        qk_norm=is_gemma3,
+        qk_norm=has_qk_norm,
         attn_kinds=attn_kinds,
         rope_theta_local=(float(cfg("rope_local_base_freq", 10000.0))
                           if is_gemma3 else None),
@@ -475,6 +503,7 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         moe_experts=moe_experts,
         moe_top_k=moe_top_k,
         moe_capacity_factor=None,  # drop-free: exact HF semantics
+        moe_norm_topk=moe_norm_topk,
     )
     return lm, {"params": params}
 

@@ -423,6 +423,7 @@ class LlamaBlock(nn.Module):
     moe_experts: int = 0  # > 0: Mixtral-style top-k MoE replaces the MLP
     moe_top_k: int = 2
     moe_capacity_factor: Optional[float] = 2.0  # None = drop-free
+    moe_norm_topk: bool = True  # False for some Qwen3-MoE checkpoints
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
@@ -459,7 +460,8 @@ class LlamaBlock(nn.Module):
                 d_ff=self.d_ff,
                 capacity_factor=self.moe_capacity_factor,
                 compute_dtype=self.compute_dtype,
-                activation=self.mlp_activation, name="moe")(
+                activation=self.mlp_activation,
+                norm_topk=self.moe_norm_topk, name="moe")(
                     y, deterministic)
             # Surfaced via mutable=["losses"] and summed into the
             # training loss by Trainer, same as TransformerBlock's
@@ -519,10 +521,11 @@ class LlamaLM(nn.Module):
     attn_kinds: Optional[Tuple[str, ...]] = None
     rope_theta_local: Optional[float] = None  # Gemma3: 10_000
     rope_scaling_local: Optional[RopeScaling] = None
-    # Mixtral family: top-k routed MoE FFN in every block.
+    # Mixtral/Qwen3-MoE family: top-k routed MoE FFN in every block.
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: Optional[float] = 2.0  # None = drop-free
+    moe_norm_topk: bool = True
 
     def _layer_attn(self, i):
         """(window, theta, scaling) for layer i under attn_kinds."""
@@ -578,6 +581,7 @@ class LlamaLM(nn.Module):
                            moe_experts=self.moe_experts,
                            moe_top_k=self.moe_top_k,
                            moe_capacity_factor=self.moe_capacity_factor,
+                           moe_norm_topk=self.moe_norm_topk,
                            name="block_%d" % i)(x, mask, deterministic)
         x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
                        name="norm_final")(x)

@@ -135,6 +135,7 @@ class TopKMoEMLP(nn.Module):
     capacity_factor: Optional[float] = 2.0  # None = drop-free
     compute_dtype: jnp.dtype = jnp.bfloat16
     activation: str = "silu"
+    norm_topk: bool = True  # Qwen3-MoE checkpoints may set False
 
     @nn.compact
     def __call__(self, x, deterministic=True):
@@ -163,7 +164,11 @@ class TopKMoEMLP(nn.Module):
             tokens, d_model) @ router_kernel              # [T, E]
         probs = jax.nn.softmax(logits, axis=-1)
         top_probs, top_idx = jax.lax.top_k(probs, k)      # [T, k]
-        gates = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+        if self.norm_topk:
+            gates = top_probs / jnp.sum(top_probs, axis=-1,
+                                        keepdims=True)
+        else:  # Qwen3-MoE norm_topk_prob=False: raw softmax mass
+            gates = top_probs
 
         # Load-balancing aux loss at HF Mixtral's scale
         # (load_balancing_loss_func): per-expert assignment counts are

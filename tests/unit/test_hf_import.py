@@ -348,6 +348,61 @@ class TestHFImport:
             lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
         np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
 
+    def test_qwen3_qk_norm_matches_torch(self, transformers, torch):
+        """Qwen3: per-head q/k RMSNorm (standard scale, no Gemma +1
+        fold), bias-free projections, explicit head_dim — logits
+        parity."""
+        config = transformers.Qwen3Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16,
+            max_position_embeddings=32, rope_theta=10000.0,
+            rms_norm_eps=1e-6, tie_word_embeddings=False,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.Qwen3ForCausalLM(config).eval()
+        tokens = np.random.default_rng(18).integers(0, 64, size=(2, 16))
+        with torch.no_grad():
+            expected = hf(torch.tensor(tokens)).logits.float().numpy()
+        lm, variables = import_hf_llama(hf, compute_dtype=jnp.float32)
+        assert lm.qk_norm is True
+        assert lm.qkv_bias is False
+        got = np.asarray(
+            lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=2e-4)
+
+    def test_qwen3_moe_matches_torch(self, transformers, torch):
+        """Qwen3-MoE: qk-norm + Mixtral-shaped routed experts under
+        mlp.experts.{e}.{gate,up,down}_proj naming, norm_topk_prob
+        honored both ways."""
+        for norm_topk in (True, False):
+            config = transformers.Qwen3MoeConfig(
+                vocab_size=64, hidden_size=32, intermediate_size=64,
+                moe_intermediate_size=24, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=2,
+                head_dim=16, max_position_embeddings=32,
+                num_experts=4, num_experts_per_tok=2,
+                norm_topk_prob=norm_topk, decoder_sparse_step=1,
+                mlp_only_layers=[], tie_word_embeddings=False,
+                attn_implementation="eager")
+            torch.manual_seed(0)
+            hf = transformers.Qwen3MoeForCausalLM(config).eval()
+            tokens = np.random.default_rng(19).integers(
+                0, 64, size=(2, 16))
+            with torch.no_grad():
+                expected = hf(
+                    torch.tensor(tokens)).logits.float().numpy()
+            lm, variables = import_hf_llama(hf,
+                                            compute_dtype=jnp.float32)
+            assert lm.moe_experts == 4 and lm.qk_norm is True
+            assert lm.moe_norm_topk is norm_topk
+            got = np.asarray(
+                lm.apply(variables, jnp.asarray(tokens, jnp.int32)))
+            np.testing.assert_allclose(got, expected, atol=3e-4,
+                                       rtol=3e-4,
+                                       err_msg="norm_topk={}".format(
+                                           norm_topk))
+
     def test_mixtral_matches_torch(self, transformers, torch):
         """Mixtral: top-2 routed MoE FFN with renormalized softmax
         gates — logits parity against the torch model (the importer
