@@ -337,7 +337,11 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         moe_experts = (int(cfg("num_local_experts", 8))
                        if is_mixtral else 0)
         d_ff = cfg("intermediate_size")
-    moe_top_k = (int(cfg("num_experts_per_tok", 2))
+    # Fallbacks follow each family's HF config default (Mixtral 2,
+    # Qwen3-MoE 8) so a raw config dict missing the key imports with
+    # HF's routing, not ours.
+    moe_top_k = (int(cfg("num_experts_per_tok",
+                         8 if is_qwen3_moe else 2))
                  if (is_mixtral or is_qwen3_moe) else 2)
     # Qwen3MoeConfig defaults norm_topk_prob to FALSE — a raw config
     # dict missing the key must import with HF's default, not ours.

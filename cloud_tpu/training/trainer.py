@@ -466,16 +466,62 @@ class Trainer:
             return self._apply_fn(variables, x, **extra, **kwargs)
         return self._apply_fn(params, x, **kwargs)
 
-    def build(self, sample_x):
-        """Initializes parameters/optimizer state (lazily called by fit)."""
+    def build(self, sample_x, variables=None):
+        """Initializes parameters/optimizer state (lazily called by fit).
+
+        variables: optional pre-trained variables to build FROM —
+        e.g. the dict `models.import_hf_llama`/`import_hf_gpt2`/
+        `import_hf_deepseek` return — instead of random init (the
+        fine-tuning entry point; the Keras analogue of building a
+        model with loaded weights). Provided collections override the
+        freshly initialized ones per collection ({"params": ...} alone
+        keeps fresh batch_stats etc.); params must match the model's
+        structure and shapes exactly, checked loudly. Optimizer state,
+        shardings, and trainable= masking are derived from the
+        provided weights like any other build.
+        """
         if self.state is not None:
+            if variables is not None:
+                # Returning the existing (possibly random-init) state
+                # while the caller believes a checkpoint was loaded is
+                # the silent-divergence failure mode this API exists
+                # to avoid.
+                raise RuntimeError(
+                    "build(variables=...) called on an already-built "
+                    "Trainer: the provided weights would be ignored. "
+                    "Load weights before the first fit/evaluate/"
+                    "predict/build call.")
             return self.state
         rng = jax.random.PRNGKey(self.seed)
         init_rng, state_rng = jax.random.split(rng)
         sample = jax.tree_util.tree_map(
             lambda a: jnp.asarray(a[:1]), sample_x)
         init_kwargs = dict(self.train_kwargs)
-        variables = self._init_fn(init_rng, sample, **init_kwargs)
+        init_variables = self._init_fn(init_rng, sample, **init_kwargs)
+        if variables is not None:
+            if not (self._is_flax and "params" in init_variables):
+                raise ValueError(
+                    "build(variables=...) needs a flax model (the "
+                    "(init_fn, apply_fn) path has no collections).")
+            if "params" not in variables:
+                raise ValueError(
+                    "build(variables=...) must include a 'params' "
+                    "collection (got {}).".format(sorted(variables)))
+            init_shapes = jax.tree_util.tree_map(
+                jnp.shape, init_variables["params"])
+            try:
+                given_shapes = jax.tree_util.tree_map(
+                    jnp.shape, variables["params"])
+                matches = init_shapes == given_shapes
+            except ValueError:
+                matches = False
+            if not matches:
+                raise ValueError(
+                    "build(variables=...): provided params do not "
+                    "match the model's structure/shapes — wrong "
+                    "checkpoint for this model configuration?")
+            init_variables = {**dict(init_variables), **dict(variables)}
+        variables = init_variables
         if self._is_flax and "params" in variables:
             variables = dict(variables)
             params = variables.pop("params")

@@ -24,6 +24,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(
 NOTEBOOK = os.path.join(REPO_ROOT, "examples", "mnist_notebook_fit.ipynb")
 IMAGE_NOTEBOOK = os.path.join(REPO_ROOT, "examples",
                               "image_classification_notebook.ipynb")
+LLM_NOTEBOOK = os.path.join(REPO_ROOT, "examples",
+                            "llm_finetune_notebook.ipynb")
 
 
 def _mesh_env(**extra):
@@ -99,3 +101,26 @@ class TestNotebookExample:
         assert "final loss:" in result.stdout
         assert "eval accuracy:" in result.stdout
         assert "predicted classes:" in result.stdout
+
+    def test_llm_finetune_notebook(self, tmp_path, monkeypatch):
+        """The LLM-scale notebook: import a (tiny random) GPT-2
+        checkpoint, fine-tune head+last-block with trainable=, sample
+        with top-p — converted and executed on the mesh in smoke
+        mode."""
+        monkeypatch.chdir(REPO_ROOT)
+        artifact = preprocess.get_preprocessed_entry_point(
+            os.path.relpath(LLM_NOTEBOOK, REPO_ROOT),
+            COMMON_MACHINE_CONFIGS["TPU_V5E_8"], None, 0, "auto")
+        content = open(artifact).read()
+        assert "pip list" not in content  # magics stripped
+        assert "%config" not in content
+        assert "load_checkpoint" in content
+        assert 'runtime.initialize(strategy="tpu_slice")' in content
+
+        result = subprocess.run(
+            [sys.executable, artifact], capture_output=True, text=True,
+            env=_mesh_env(CLOUD_TPU_EXAMPLE_SMOKE="1"), cwd=tmp_path,
+            timeout=420)
+        assert result.returncode == 0, result.stderr
+        assert "final loss:" in result.stdout
+        assert "generated:" in result.stdout

@@ -1782,3 +1782,72 @@ class TestTrainableFreeze:
             assert not any("Dense_1" in k for k in moments)
         finally:
             runtime.reset()
+
+
+class TestBuildFromVariables:
+    """build(variables=): the fine-tuning entry point — start from
+    imported/pretrained weights instead of random init."""
+
+    def test_provided_params_are_used(self):
+        x, y = _toy_classification()
+        ref = Trainer(MLP(hidden=16, num_classes=4), seed=0)
+        ref.build(x[:4])
+        pretrained = jax.tree_util.tree_map(
+            lambda a: np.asarray(a) + 1.0, ref.state.params)
+
+        trainer = Trainer(MLP(hidden=16, num_classes=4), seed=1)
+        trainer.build(x[:4], variables={"params": pretrained})
+        for path_got, path_want in zip(
+                jax.tree_util.tree_leaves(trainer.state.params),
+                jax.tree_util.tree_leaves(pretrained)):
+            np.testing.assert_array_equal(np.asarray(path_got),
+                                          path_want)
+        history = trainer.fit(x, y, epochs=1, batch_size=64,
+                              verbose=False)
+        assert np.isfinite(history["loss"][-1])
+
+    def test_shape_mismatch_is_loud(self):
+        x, _ = _toy_classification()
+        donor = Trainer(MLP(hidden=32, num_classes=4), seed=0)
+        donor.build(x[:4])
+        trainer = Trainer(MLP(hidden=16, num_classes=4), seed=1)
+        with pytest.raises(ValueError, match="structure/shapes"):
+            trainer.build(x[:4],
+                          variables={"params": donor.state.params})
+
+    def test_missing_params_collection_is_loud(self):
+        x, _ = _toy_classification()
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        with pytest.raises(ValueError, match="params"):
+            trainer.build(x[:4], variables={"batch_stats": {}})
+
+    def test_partial_collections_keep_fresh_extras(self):
+        """Providing only params keeps freshly initialized batch_stats
+        (ResNet): the per-collection override contract."""
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 4, size=8).astype(np.int32)
+        ref = Trainer(ResNet18(num_classes=4), seed=0,
+                      train_kwargs={"train": True},
+                      eval_kwargs={"train": False})
+        ref.build(x[:2])
+        pretrained = jax.tree_util.tree_map(np.asarray,
+                                            ref.state.params)
+        trainer = Trainer(ResNet18(num_classes=4), seed=1,
+                          train_kwargs={"train": True},
+                          eval_kwargs={"train": False})
+        trainer.build(x[:2], variables={"params": pretrained})
+        assert "batch_stats" in trainer.state.extra_vars
+        history = trainer.fit(x, y, epochs=1, batch_size=4,
+                              verbose=False)
+        assert np.isfinite(history["loss"][-1])
+
+    def test_variables_on_built_trainer_is_loud(self):
+        """Loading weights after a lazy build must raise, not silently
+        keep the random init."""
+        x, y = _toy_classification()
+        trainer = Trainer(MLP(hidden=16, num_classes=4))
+        trainer.fit(x, y, epochs=1, batch_size=64, verbose=False)
+        with pytest.raises(RuntimeError, match="already-built"):
+            trainer.build(x[:4],
+                          variables={"params": trainer.state.params})
