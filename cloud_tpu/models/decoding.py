@@ -1,0 +1,72 @@
+"""Shared KV-cache slot bookkeeping for decode-mode attention.
+
+Every decode attention (`CausalSelfAttention`, `GQAttention`,
+`MLAttention`) appends incoming tokens at the cache write pointer and
+attends over everything valid so far. The left-padded-prompt contract
+(`generate(prompt_mask=)`) adds per-example bookkeeping on top: padded
+slots must never be attended, and rotary angles / learned-position
+lookups / sliding-window bands must count only REAL tokens. This
+module holds that recipe ONCE so the three families cannot drift.
+
+Cache variables created on the calling module ("cache" collection):
+  cache_index  []       slot write pointer (shared across examples)
+  slot_valid   [B, L]   True where a real token was written
+  slot_pos     [B, L]   the slot's LOGICAL position (real tokens only)
+  token_count  [B]      number of real tokens seen per example
+"""
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+
+def decode_slot_update(module, mask, batch, seq, cache_len):
+    """Advance the decode cache's slot bookkeeping for one call.
+
+    module: the flax module (inside @nn.compact) owning the cache.
+    mask: optional [B, S] marking REAL incoming tokens (None = all).
+
+    Returns (idx, positions, allowed):
+      idx        the write pointer BEFORE this call (callers write
+                 their k/v tensors at slots [idx, idx+S));
+      positions  [B, S] int32 logical position of each incoming token
+                 (#real tokens before it, per example) — feed to RoPE
+                 or a learned position table; padded entries carry a
+                 harmless placeholder (their slots are invalid);
+      allowed    [B, S, L] bool attention mask: slot-order causality
+                 (append-only writes make slot index the causal order)
+                 AND slot validity (padded + never-written slots
+                 excluded).
+
+    The sliding-window band is the caller's concern: compare the
+    module's `slot_pos` cache variable (logical key positions) against
+    `positions` — see `GQAttention._decode_attention`.
+    """
+    index = module.variable(
+        "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+    slot_valid = module.variable(
+        "cache", "slot_valid", jnp.zeros, (batch, cache_len), jnp.bool_)
+    slot_pos = module.variable(
+        "cache", "slot_pos", jnp.zeros, (batch, cache_len), jnp.int32)
+    token_count = module.variable(
+        "cache", "token_count", jnp.zeros, (batch,), jnp.int32)
+
+    m = (jnp.ones((batch, seq), jnp.int32) if mask is None
+         else mask.astype(jnp.int32))
+    idx = index.value
+    positions = token_count.value[:, None] + jnp.cumsum(m, 1) - m
+
+    slot_valid.value = lax.dynamic_update_slice(
+        slot_valid.value, m.astype(jnp.bool_), (0, idx))
+    slot_pos.value = lax.dynamic_update_slice(
+        slot_pos.value, positions.astype(jnp.int32), (0, idx))
+    index.value = idx + seq
+    token_count.value = token_count.value + m.sum(axis=1)
+
+    key_slots = jnp.arange(cache_len)
+    allowed = (slot_valid.value[:, None, :]
+               & (key_slots[None, None, :]
+                  <= idx + jnp.arange(seq)[None, :, None]))
+    return idx, positions, allowed
+
+
+__all__ = ["decode_slot_update"]

@@ -119,12 +119,10 @@ class MLAttention(nn.Module):
                       self.qk_nope_head_dim + self.v_head_dim), "kv_b")
 
         if self.decode:
-            if mask is not None:
-                raise NotImplementedError(
-                    "decode mode does not take a padding mask; left-pad "
-                    "prompts or decode per example.")
+            # mask (optional [B, S]) marks REAL incoming tokens — the
+            # left-padded-prompt contract (generate(prompt_mask=)).
             out = self._decode_attention(q_nope, q_rot, latent, k_rot,
-                                         kv_b)
+                                         kv_b, mask)
         else:
             positions = jnp.arange(x.shape[1])
             q_rot = self._rope(q_rot, positions)
@@ -151,7 +149,8 @@ class MLAttention(nn.Module):
         return nn.DenseGeneral(d_model, axis=(-2, -1), use_bias=False,
                                dtype=self.compute_dtype, name="out")(out)
 
-    def _decode_attention(self, q_nope, q_rot, latent, k_rot, kv_b):
+    def _decode_attention(self, q_nope, q_rot, latent, k_rot, kv_b,
+                          mask=None):
         """KV-cache attention over the COMPRESSED latent.
 
         The cache stores [B, L, kv_lora_rank] latents plus the shared
@@ -161,6 +160,8 @@ class MLAttention(nn.Module):
         is the same O(L) cost order as the attention itself.
         """
         import jax.lax as lax
+
+        from cloud_tpu.models.decoding import decode_slot_update
 
         batch, seq = q_nope.shape[:2]
         if not self.cache_len:
@@ -173,11 +174,9 @@ class MLAttention(nn.Module):
             "cache", "cached_rope", jnp.zeros,
             (batch, self.cache_len, 1, self.qk_rope_head_dim),
             self.compute_dtype)
-        index = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
 
-        idx = index.value
-        positions = idx + jnp.arange(seq)
+        idx, positions, allowed = decode_slot_update(
+            self, mask, batch, seq, self.cache_len)
         q_rot = self._rope(q_rot, positions)
         k_rot = self._rope(k_rot, positions)
 
@@ -187,14 +186,10 @@ class MLAttention(nn.Module):
         cached_rope.value = lax.dynamic_update_slice(
             cached_rope.value, k_rot.astype(self.compute_dtype),
             (0, idx, 0, 0))
-        index.value = idx + seq
 
         kv = kv_b(cached_latent.value)  # [B, L, H, nope+v]
         k_nope = kv[..., :self.qk_nope_head_dim]
         v = kv[..., self.qk_nope_head_dim:]
-
-        key_positions = jnp.arange(self.cache_len)
-        allowed = key_positions[None, :] <= positions[:, None]  # [S, L]
         scale = self.attn_scale or (
             self.qk_nope_head_dim + self.qk_rope_head_dim) ** -0.5
         # Two logit contributions, f32 on the MXU: per-head nope keys
@@ -204,7 +199,7 @@ class MLAttention(nn.Module):
                        preferred_element_type=jnp.float32)
             + jnp.einsum("bqhd,bkd->bhqk", q_rot, cached_rope.value[..., 0, :],
                          preferred_element_type=jnp.float32)) * scale
-        logits = jnp.where(allowed[None, None], logits, -1e30)
+        logits = jnp.where(allowed[:, None], logits, -1e30)
         weights = nn.softmax(logits, axis=-1).astype(self.compute_dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 

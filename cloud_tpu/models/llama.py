@@ -264,11 +264,11 @@ class GQAttention(nn.Module):
                            name="k_norm")(k)
 
         if self.decode:
-            if mask is not None:
-                raise NotImplementedError(
-                    "decode mode does not take a padding mask; left-pad "
-                    "prompts or decode per example.")
-            out = self._decode_attention(q, k, v)
+            # mask (optional [B, S]) marks REAL incoming tokens — the
+            # left-padded-prompt contract (generate(prompt_mask=)):
+            # padded slots are never attended and don't advance the
+            # per-example logical position.
+            out = self._decode_attention(q, k, v, mask)
         else:
             positions = jnp.arange(x.shape[1])
             q = self._rope(q, positions)
@@ -301,16 +301,22 @@ class GQAttention(nn.Module):
         return nn.DenseGeneral(d_model, axis=(-2, -1), use_bias=False,
                                dtype=self.compute_dtype, name="out")(out)
 
-    def _decode_attention(self, q, k, v):
+    def _decode_attention(self, q, k, v, mask=None):
         """KV-cache attention at H_kv width (the point of GQA: the cache
         is num_heads/num_kv_heads times smaller than MHA's).
 
         Mirrors `CausalSelfAttention._decode_attention`
         (transformer.py): one path serves prefill (whole prompt, index
-        0) and per-token steps (S=1); RoPE angles use absolute cache
-        positions so decode continues the training-time rotation.
+        0) and per-token steps (S=1). The cache is SLOT-addressed
+        (write pointer `cache_index`), but RoPE angles and the sliding
+        window band use per-example LOGICAL positions (`slot_pos`,
+        counting only real tokens), so left-padded prompts rotate and
+        band exactly like their unpadded equivalents; padded slots are
+        marked invalid and never attended.
         """
         import jax.lax as lax
+
+        from cloud_tpu.models.decoding import decode_slot_update
 
         batch, seq, _, head_dim = q.shape
         if not self.cache_len:
@@ -323,11 +329,9 @@ class GQAttention(nn.Module):
             "cache", "cached_value", jnp.zeros,
             (batch, self.cache_len, self.num_kv_heads, head_dim),
             self.compute_dtype)
-        index = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
 
-        idx = index.value
-        positions = idx + jnp.arange(seq)
+        idx, positions, allowed = decode_slot_update(
+            self, mask, batch, seq, self.cache_len)
         q = self._rope(q, positions)
         k = self._rope(k, positions)
 
@@ -335,18 +339,17 @@ class GQAttention(nn.Module):
             cached_k.value, k.astype(self.compute_dtype), (0, idx, 0, 0))
         cached_v.value = lax.dynamic_update_slice(
             cached_v.value, v.astype(self.compute_dtype), (0, idx, 0, 0))
-        index.value = idx + seq
 
-        key_positions = jnp.arange(self.cache_len)
-        allowed = key_positions[None, :] <= positions[:, None]  # [S, L]
         if self.sliding_window:
-            # Same band as the training-time kernel: keys in
-            # (pos - window, pos]. Cached entries older than the window
-            # are masked (not evicted — the cache stays positionally
-            # addressed; rolling eviction is a memory optimization this
-            # path doesn't need at cache_len scale).
-            allowed = allowed & (key_positions[None, :]
-                                 > positions[:, None] - self.sliding_window)
+            # Same band as the training-time kernel, on LOGICAL
+            # positions: keys in (pos - window, pos]. Cached entries
+            # older than the window are masked (not evicted — the
+            # cache stays slot-addressed; rolling eviction is a memory
+            # optimization this path doesn't need at cache_len scale).
+            slot_pos = self.get_variable("cache", "slot_pos")
+            allowed = allowed & (slot_pos[:, None, :]
+                                 > positions[:, :, None]
+                                 - self.sliding_window)
         scale = self.attn_scale or 1.0 / np.sqrt(head_dim)
         group = self.num_heads // self.num_kv_heads
         # Grouped einsum: q reshaped [B,S,H_kv,G,D] attends its own kv
@@ -357,7 +360,7 @@ class GQAttention(nn.Module):
         if self.logit_softcap:
             cap = float(self.logit_softcap)
             logits = cap * jnp.tanh(logits / cap)
-        logits = jnp.where(allowed[None, None, None], logits, -1e30)
+        logits = jnp.where(allowed[:, None, None], logits, -1e30)
         weights = nn.softmax(logits, axis=-1).astype(self.compute_dtype)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", weights, cached_v.value)
         return out.reshape(batch, seq, self.num_heads, head_dim)

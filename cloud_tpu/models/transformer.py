@@ -47,11 +47,9 @@ class CausalSelfAttention(nn.Module):
         v = dense((self.num_heads, head_dim), "value")(x)
 
         if self.decode:
-            if mask is not None:
-                raise NotImplementedError(
-                    "decode mode does not take a padding mask; left-pad "
-                    "prompts or decode per example.")
-            out = self._decode_attention(q, k, v)
+            # mask (optional [B, S]) marks REAL incoming tokens — the
+            # left-padded-prompt contract (generate(prompt_mask=)).
+            out = self._decode_attention(q, k, v, mask)
         elif self.attention_impl in SEQUENCE_PARALLEL_IMPLS:
             # Sequence-parallel long-context paths over the mesh's "sp"
             # axis: "ring" rotates K/V around a ppermute ring
@@ -72,18 +70,20 @@ class CausalSelfAttention(nn.Module):
         return nn.DenseGeneral(d_model, axis=(-2, -1),
                                dtype=self.compute_dtype, name="out")(out)
 
-    def _decode_attention(self, q, k, v):
+    def _decode_attention(self, q, k, v, mask=None):
         """KV-cache attention: append this call's K/V to the cache, then
         attend q against everything cached so far.
 
         One code path serves both phases of generation: prefill (the
         whole prompt in one call, cache index 0) and single-token decode
-        steps (S=1). The position mask `key <= query position` is the
-        causal mask within the incoming block and the "only attend to
-        the past" mask against the cache simultaneously. O(cache_len)
-        work per step — the standard autoregressive trade.
+        steps (S=1). Causality is slot order (append-only writes);
+        `slot_valid` excludes left-padded prompt slots (mask=0) and the
+        never-written tail. O(cache_len) work per step — the standard
+        autoregressive trade.
         """
         import jax.lax as lax
+
+        from cloud_tpu.models.decoding import decode_slot_update
 
         batch, seq, heads, head_dim = q.shape
         if not self.cache_len:
@@ -94,26 +94,19 @@ class CausalSelfAttention(nn.Module):
         cached_v = self.variable(
             "cache", "cached_value", jnp.zeros,
             (batch, self.cache_len, heads, head_dim), self.compute_dtype)
-        index = self.variable(
-            "cache", "cache_index",
-            lambda: jnp.zeros((), jnp.int32))
 
-        idx = index.value
+        idx, _, allowed = decode_slot_update(
+            self, mask, batch, seq, self.cache_len)
         cached_k.value = lax.dynamic_update_slice(
             cached_k.value, k.astype(self.compute_dtype), (0, idx, 0, 0))
         cached_v.value = lax.dynamic_update_slice(
             cached_v.value, v.astype(self.compute_dtype), (0, idx, 0, 0))
-        index.value = idx + seq
-
-        positions = idx + jnp.arange(seq)  # query positions
-        key_positions = jnp.arange(self.cache_len)
-        allowed = key_positions[None, :] <= positions[:, None]  # [S, L]
         scale = 1.0 / np.sqrt(head_dim)
         # f32 MXU accumulation, like every training attention path —
         # bf16 logits would round before the argmax/softmax.
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, cached_k.value,
                             preferred_element_type=jnp.float32) * scale
-        logits = jnp.where(allowed[None, None], logits, -1e30)
+        logits = jnp.where(allowed[:, None], logits, -1e30)
         weights = nn.softmax(logits, axis=-1).astype(self.compute_dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", weights, cached_v.value)
 
@@ -193,16 +186,22 @@ class TransformerLM(nn.Module):
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.compute_dtype, name="embed")(tokens)
         if self.decode:
-            # Positions continue from where the cache left off.
-            pos_index = self.variable("cache", "pos_index",
-                                      lambda: jnp.zeros((), jnp.int32))
-            positions = pos_index.value + jnp.arange(seq)
-            pos_index.value = pos_index.value + seq
+            # Per-example LOGICAL positions (only real tokens count),
+            # so left-padded prompts look up the same position rows as
+            # their unpadded equivalents; padded entries reuse row 0
+            # harmlessly (their slots are never attended).
+            batch = tokens.shape[0]
+            pos_count = self.variable("cache", "pos_count",
+                                      jnp.zeros, (batch,), jnp.int32)
+            m = (jnp.ones((batch, seq), jnp.int32) if mask is None
+                 else mask.astype(jnp.int32))
+            positions = pos_count.value[:, None] + jnp.cumsum(m, 1) - m
+            pos_count.value = pos_count.value + m.sum(axis=1)
         else:
-            positions = jnp.arange(seq)
+            positions = jnp.arange(seq)[None, :]
         pos = nn.Embed(self.max_seq_len, self.d_model,
                        dtype=self.compute_dtype,
-                       name="pos_embed")(positions[None, :])
+                       name="pos_embed")(positions)
         x = x + pos
         for i in range(self.num_layers):
             x = TransformerBlock(self.num_heads, self.d_ff,
@@ -299,7 +298,8 @@ def generate(model,
              temperature=1.0,
              top_k=None,
              top_p=None,
-             eos_token=None):
+             eos_token=None,
+             prompt_mask=None):
     """Autoregressive sampling with a KV cache.
 
     The inference counterpart of Trainer.fit for `TransformerLM` (no
@@ -324,9 +324,16 @@ def generate(model,
             truncation, the HF warper order). (0, 1]; 1.0 = no-op.
         eos_token: Optional stop token: positions after a sampled eos
             are filled with eos_token.
+        prompt_mask: Optional [B, S] bool marking REAL prompt tokens —
+            the variable-length-batch contract. Prompts must be
+            LEFT-padded (every example's last column real): padded
+            slots are never attended, and positions (learned table or
+            RoPE) count only real tokens, so each row generates
+            exactly as its unpadded equivalent would.
 
     Returns:
-        [B, S + max_new_tokens] int32: prompt + generated continuation.
+        [B, S + max_new_tokens] int32: prompt + generated continuation
+        (left-padded rows keep their padding in the prompt columns).
     """
     import jax
 
@@ -354,6 +361,17 @@ def generate(model,
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(
             "top_p must be in (0, 1]; got {}.".format(top_p))
+    if prompt_mask is not None:
+        pm = np.asarray(prompt_mask)
+        if pm.shape != (batch, prompt_len):
+            raise ValueError(
+                "prompt_mask must be [batch, prompt_len] = {}; got "
+                "{}.".format((batch, prompt_len), pm.shape))
+        if not pm[:, -1].all():
+            raise ValueError(
+                "prompt_mask must be LEFT-padded (last column all "
+                "real): sampling reads the logits at the final prompt "
+                "position.")
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
@@ -373,7 +391,9 @@ def generate(model,
         None if eos_token is None else int(eos_token))
 
     rng, prefill_rng = jax.random.split(rng)
-    cache, first = prefill(params, cache, prompt, prefill_rng)
+    mask_arg = (None if prompt_mask is None
+                else jnp.asarray(prompt_mask, bool))
+    cache, first = prefill(params, cache, prompt, prefill_rng, mask_arg)
     out = [first[:, None]]
     if max_new_tokens > 1:
         toks = decode_steps(params, cache, first,
@@ -422,9 +442,10 @@ def _decode_fns(decoder, temperature, top_k, top_p, eos_token):
                                       axis=-1).astype(jnp.int32)
 
     @jax.jit
-    def prefill(params, cache, prompt, rng):
+    def prefill(params, cache, prompt, rng, prompt_mask=None):
         logits, vars_ = decoder.apply({"params": params, "cache": cache},
-                                      prompt, mutable=["cache"])
+                                      prompt, prompt_mask,
+                                      mutable=["cache"])
         return vars_["cache"], sample(logits[:, -1], rng)
 
     @jax.jit

@@ -222,3 +222,72 @@ class TestTopP:
         with pytest.raises(ValueError, match="top_p"):
             generate(model, params, prompt, 4, top_p=1.5,
                      rng=jax.random.PRNGKey(0))
+
+
+class TestLeftPaddedPrompts:
+    """generate(prompt_mask=): variable-length batched prompts,
+    left-padded. Oracle: each row must generate exactly what it would
+    alone, unpadded — positions (learned table or RoPE) count only
+    real tokens and padded slots are never attended."""
+
+    def _check(self, model, lengths=(3, 7), new=6):
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 4), jnp.int32))["params"]
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, model.vocab_size, size=n)
+                   for n in lengths]
+        S = max(lengths)
+        batch = np.zeros((len(lengths), S), np.int32)
+        mask = np.zeros((len(lengths), S), bool)
+        for b, p in enumerate(prompts):
+            batch[b, S - len(p):] = p
+            mask[b, S - len(p):] = True
+        out = generate(model, params, jnp.asarray(batch), new,
+                       rng=jax.random.PRNGKey(1), temperature=0.0,
+                       prompt_mask=jnp.asarray(mask))
+        gen = np.asarray(out)[:, S:]
+        for b, p in enumerate(prompts):
+            solo = generate(model, params,
+                            jnp.asarray(p[None, :], jnp.int32), new,
+                            rng=jax.random.PRNGKey(1), temperature=0.0)
+            np.testing.assert_array_equal(
+                gen[b], np.asarray(solo)[0, len(p):],
+                err_msg="row {} (len {})".format(b, len(p)))
+
+    def test_transformer_lm_learned_positions(self):
+        self._check(_model())
+
+    def test_llama_with_sliding_window(self):
+        from cloud_tpu.models import LlamaLM
+        self._check(LlamaLM(vocab_size=64, num_layers=2, num_heads=2,
+                            num_kv_heads=1, d_model=32, d_ff=64,
+                            max_seq_len=16, compute_dtype=jnp.float32,
+                            sliding_window=4))
+
+    def test_deepseek_mla_latent_cache(self):
+        from cloud_tpu.models import DeepseekLM
+        self._check(DeepseekLM(
+            vocab_size=64, num_layers=2, num_heads=2, d_model=32,
+            d_ff=64, max_seq_len=16, kv_lora_rank=16,
+            qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+            compute_dtype=jnp.float32))
+
+    def test_right_padding_rejected(self):
+        model = _model()
+        params = _params(model, _prompt())
+        prompt = _prompt()
+        bad = np.ones((2, prompt.shape[1]), bool)
+        bad[0, -1] = False  # right-padded row
+        with pytest.raises(ValueError, match="LEFT-padded"):
+            generate(model, params, prompt, 4,
+                     rng=jax.random.PRNGKey(0), temperature=0.0,
+                     prompt_mask=bad)
+
+    def test_mask_shape_validated(self):
+        model = _model()
+        prompt = _prompt()
+        params = _params(model, prompt)
+        with pytest.raises(ValueError, match="prompt_mask"):
+            generate(model, params, prompt, 4,
+                     rng=jax.random.PRNGKey(0), temperature=0.0,
+                     prompt_mask=np.ones((2, 3), bool))
