@@ -15,6 +15,7 @@ Cache variables created on the calling module ("cache" collection):
   token_count  [B]      number of real tokens seen per example
 """
 
+import jax
 import jax.lax as lax
 import jax.numpy as jnp
 
@@ -69,4 +70,15 @@ def decode_slot_update(module, mask, batch, seq, cache_len):
     return idx, positions, allowed
 
 
-__all__ = ["decode_slot_update"]
+def empty_cache(decoder, batch):
+    """Zero-initialized decode-cache pytree for a decode-mode module
+    (shared by `generate` and `generate_speculative`): built from the
+    abstract init so no second params copy is ever materialized."""
+    shapes = jax.eval_shape(
+        lambda: decoder.init(jax.random.PRNGKey(0),
+                             jnp.zeros((batch, 1), jnp.int32)))["cache"]
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+__all__ = ["decode_slot_update", "empty_cache"]

@@ -1,0 +1,202 @@
+"""Greedy speculative decoding: draft proposes, target verifies.
+
+The latency optimization for single-stream decoding: a small DRAFT
+model proposes `num_draft` tokens one at a time (cheap steps), and the
+large TARGET model scores all of them in ONE forward pass (a single
+large, MXU-friendly dispatch instead of `num_draft` small ones). Every
+proposal matching the target's own greedy choice is accepted; the
+first mismatch is replaced by the target's token — so the output is
+TOKEN-IDENTICAL to plain greedy decoding with the target model
+(tested), only faster wall-clock when the draft's acceptance rate is
+decent. Greedy only: the stochastic accept/reject scheme
+(Leviathan et al., arXiv 2211.17192) changes the sampling math and is
+not implemented.
+
+Works with any pair of decode-capable models sharing a vocabulary
+(`TransformerLM`, `LlamaLM`, `DeepseekLM` — e.g. a 2-layer draft for
+a 16-layer target, or an imported small checkpoint drafting for a
+large one). Batch size 1: acceptance counts differ per example, which
+would force per-row cache rewinds; speculative decoding is a
+latency (not throughput) tool, so the single-stream restriction is
+the standard one.
+
+Cache bookkeeping rides the slot-addressed decode caches
+(models/decoding.py): rejected draft entries are rolled back by
+rewinding the write pointer, slot validity, and token counts — the
+stale k/v values beyond the pointer are overwritten by the next
+write and never attended in between.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cloud_tpu.models.decoding import empty_cache
+from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
+
+_BOOKKEEPING = ("cache_index", "token_count", "pos_count")
+
+
+def _rewind_cache(cache, n):
+    """Roll back the last n cache slots (bookkeeping only)."""
+    if n == 0:
+        return cache
+    # All layers share one write pointer value; read it off any leaf.
+    flat = jax.tree_util.tree_flatten_with_path(cache)[0]
+    old_idx = None
+    for path, leaf in flat:
+        if getattr(path[-1], "key", None) == "cache_index":
+            old_idx = int(leaf)
+            break
+    new_idx = old_idx - n
+
+    def fix(path, leaf):
+        key = getattr(path[-1], "key", None)
+        if key in _BOOKKEEPING:
+            return leaf - n
+        if key == "slot_valid":
+            length = leaf.shape[-1]
+            return leaf & (jnp.arange(length)[None, :] < new_idx)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.lru_cache(maxsize=128)
+def _chunk_fn(decoder):
+    """Jitted chunk feed: returns (new_cache, greedy tokens [B, S])."""
+
+    @jax.jit
+    def chunk(params, cache, tokens):
+        logits, vars_ = decoder.apply(
+            {"params": params, "cache": cache}, tokens,
+            mutable=["cache"])
+        return vars_["cache"], jnp.argmax(
+            logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+
+    return chunk
+
+
+def generate_speculative(model, params, draft_model, draft_params,
+                         prompt, max_new_tokens, num_draft=4,
+                         eos_token=None):
+    """Greedy decode with draft-model speculation.
+
+    Args:
+        model / params: the TARGET model (its greedy output is what
+            this function reproduces, token for token).
+        draft_model / draft_params: the cheap proposal model (same
+            vocabulary; any decode-capable family).
+        prompt: [1, S] int32 (batch 1 — see module docstring).
+        max_new_tokens: tokens to generate beyond the prompt.
+        num_draft: proposals per verification round. Each round costs
+            num_draft draft steps + ONE target forward over
+            num_draft+1 tokens, and commits between 1 and num_draft+1
+            tokens.
+        eos_token: optional stop token; the tail is filled with it.
+
+    Returns:
+        [1, S + max_new_tokens] int32 — identical to
+        `generate(model, params, prompt, max_new_tokens,
+        temperature=0.0)`.
+    """
+    batch, prompt_len = prompt.shape
+    if batch != 1:
+        raise ValueError(
+            "generate_speculative is single-stream (batch 1); got "
+            "batch={}. Use generate() for batched decoding.".format(
+                batch))
+    if num_draft < 1:
+        raise ValueError("num_draft must be >= 1; got {}.".format(
+            num_draft))
+    if max_new_tokens < 0:
+        raise ValueError("max_new_tokens must be >= 0; got {}.".format(
+            max_new_tokens))
+    if max_new_tokens == 0:
+        return prompt
+    for m, name in ((model, "model"), (draft_model, "draft_model")):
+        if m.attention_impl in SEQUENCE_PARALLEL_IMPLS:
+            raise NotImplementedError(
+                "generate_speculative decodes on a single mesh shard; "
+                "{} uses a sequence-parallel attention_impl.".format(
+                    name))
+    total = prompt_len + max_new_tokens
+    for m, name in ((model, "model"), (draft_model, "draft_model")):
+        # Final rounds clamp their draft count to the remaining token
+        # budget, so the caches never need slack past `total` — the
+        # same bound generate() has.
+        if total > m.max_seq_len:
+            raise ValueError(
+                "prompt ({}) + max_new_tokens ({}) exceeds {}'s "
+                "max_seq_len {}.".format(prompt_len, max_new_tokens,
+                                         name, m.max_seq_len))
+
+    target = model.clone(decode=True, dropout_rate=0.0)
+    draft = draft_model.clone(decode=True, dropout_rate=0.0)
+    target_chunk = _chunk_fn(target)
+    draft_chunk = _chunk_fn(draft)
+    t_cache = empty_cache(target, 1)
+    d_cache = empty_cache(draft, 1)
+
+    seq = [int(t) for t in np.asarray(prompt)[0]]
+    # Invariant between rounds: both caches hold entries for seq[:-1].
+    if prompt_len > 1:
+        prefix = jnp.asarray([seq[:-1]], jnp.int32)
+        t_cache, _ = target_chunk(params, t_cache, prefix)
+        d_cache, _ = draft_chunk(draft_params, d_cache, prefix)
+
+    while len(seq) < total:
+        # Clamp the final rounds to the remaining budget: a round
+        # commits at most k+1 tokens, so k = remaining-1 caps the peak
+        # cache write at exactly `total` slots (and skips draft steps
+        # whose proposals could never be used). At most num_draft
+        # distinct k values, so compilations stay bounded.
+        k = min(num_draft, total - len(seq))
+
+        # --- Draft k proposals, one cheap step at a time ---
+        drafts = []
+        tok = seq[-1]
+        for _ in range(k):
+            d_cache, out = draft_chunk(
+                draft_params, d_cache, jnp.asarray([[tok]], jnp.int32))
+            tok = int(np.asarray(out)[0, -1])
+            drafts.append(tok)
+
+        # --- Verify all k in ONE target forward over k+1 tokens ---
+        verify_in = jnp.asarray([[seq[-1]] + drafts], jnp.int32)
+        t_cache, greedy = target_chunk(params, t_cache, verify_in)
+        greedy = np.asarray(greedy)[0]  # g[i] = target token after d_i
+
+        accepted = 0
+        while accepted < k and drafts[accepted] == int(greedy[accepted]):
+            accepted += 1
+        committed = drafts[:accepted] + [int(greedy[accepted])]
+
+        # --- Restore the invariant ---
+        # Target wrote k+1 entries (seq[-1], d1..dk); keep accepted+1.
+        t_cache = _rewind_cache(t_cache, k - accepted)
+        # Draft wrote k entries (seq[-1], d1..d_{k-1}); its cache must
+        # end holding (seq[-1], d1..d_accepted). Rejections rewind for
+        # free; only full acceptance needs the one missing d_k entry.
+        if accepted < k:
+            d_cache = _rewind_cache(d_cache, k - accepted - 1)
+        else:
+            d_cache, _ = draft_chunk(
+                draft_params, d_cache,
+                jnp.asarray([[drafts[-1]]], jnp.int32))
+
+        seq.extend(committed)
+        if eos_token is not None and eos_token in committed:
+            seq = seq[:len(seq) - len(committed)
+                      + committed.index(eos_token) + 1]
+            break
+
+    seq = seq[:total]
+    if eos_token is not None and len(seq) < total:
+        seq = seq + [eos_token] * (total - len(seq))
+    return jnp.asarray([seq], jnp.int32)
+
+
+__all__ = ["generate_speculative"]

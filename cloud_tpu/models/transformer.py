@@ -375,14 +375,10 @@ def generate(model,
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
+    from cloud_tpu.models.decoding import empty_cache
+
     decoder = model.clone(decode=True, dropout_rate=0.0)
-    # Cache entries are all zero-initialized; build them from the
-    # abstract init (no second params copy is ever materialized).
-    cache_shapes = jax.eval_shape(
-        lambda: decoder.init(jax.random.PRNGKey(0),
-                             jnp.zeros((batch, 1), jnp.int32)))["cache"]
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+    cache = empty_cache(decoder, batch)
 
     prefill, decode_steps = _decode_fns(
         decoder, float(temperature),

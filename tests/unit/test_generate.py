@@ -291,3 +291,77 @@ class TestLeftPaddedPrompts:
             generate(model, params, prompt, 4,
                      rng=jax.random.PRNGKey(0), temperature=0.0,
                      prompt_mask=np.ones((2, 3), bool))
+
+
+class TestSpeculativeDecoding:
+    """generate_speculative: greedy output must be TOKEN-IDENTICAL to
+    plain greedy decoding with the target model — the draft only
+    changes wall-clock, never tokens."""
+
+    def _models(self):
+        target = _model(num_layers=3)
+        draft = _model(num_layers=1)
+        prompt = _prompt()
+        t_params = _params(target, prompt)
+        d_params = draft.init(jax.random.PRNGKey(7), prompt)["params"]
+        return target, t_params, draft, d_params, prompt
+
+    @pytest.mark.parametrize("num_draft", [1, 3, 5])
+    def test_matches_plain_greedy(self, num_draft):
+        from cloud_tpu.models import generate_speculative
+        target, t_params, draft, d_params, prompt = self._models()
+        want = generate(target, t_params, prompt[:1], 10,
+                        temperature=0.0)
+        got = generate_speculative(target, t_params, draft, d_params,
+                                   prompt[:1], 10,
+                                   num_draft=num_draft)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_self_draft_accepts_everything(self):
+        """Draft == target: every proposal accepted, output still
+        exactly greedy."""
+        from cloud_tpu.models import generate_speculative
+        target, t_params, _, _, prompt = self._models()
+        want = generate(target, t_params, prompt[:1], 8,
+                        temperature=0.0)
+        got = generate_speculative(target, t_params, target, t_params,
+                                   prompt[:1], 8, num_draft=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_llama_target_transformer_draft(self):
+        """Cross-family pair (shared vocab): LlamaLM target drafted by
+        a TransformerLM."""
+        from cloud_tpu.models import LlamaLM, generate_speculative
+        target = LlamaLM(vocab_size=64, num_layers=2, num_heads=2,
+                         num_kv_heads=1, d_model=32, d_ff=64,
+                         max_seq_len=32, compute_dtype=jnp.float32)
+        prompt = _prompt()
+        t_params = target.init(jax.random.PRNGKey(0),
+                               prompt)["params"]
+        draft = _model(num_layers=1)
+        d_params = draft.init(jax.random.PRNGKey(7), prompt)["params"]
+        want = generate(target, t_params, prompt[:1], 8,
+                        temperature=0.0)
+        got = generate_speculative(target, t_params, draft, d_params,
+                                   prompt[:1], 8, num_draft=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_eos_fills_tail(self):
+        from cloud_tpu.models import generate_speculative
+        target, t_params, draft, d_params, prompt = self._models()
+        want = generate(target, t_params, prompt[:1], 10,
+                        temperature=0.0, eos_token=5)
+        got = generate_speculative(target, t_params, draft, d_params,
+                                   prompt[:1], 10, num_draft=3,
+                                   eos_token=5)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_batch_and_budget_validated(self):
+        from cloud_tpu.models import generate_speculative
+        target, t_params, draft, d_params, prompt = self._models()
+        with pytest.raises(ValueError, match="batch"):
+            generate_speculative(target, t_params, draft, d_params,
+                                 prompt, 4)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            generate_speculative(target, t_params, draft, d_params,
+                                 prompt[:1], 30, num_draft=4)
