@@ -74,7 +74,8 @@ def stages():
         Stage("pinned", [_PY, b], 700,
               _bench_env(BENCH_DEADLINE=600)),
         Stage("kernels", [_PY, os.path.join(_HERE, "run_all.py"),
-                          "6", "7", "8", "9"], 2400, check="rc0"),
+                          "6", "7", "8", "9", "10"], 2400,
+              check="rc0"),
         Stage("pipeline_tpu", [_PY, os.path.join(
             _HERE, "pipeline_schedule_bench.py"), "--run"], 1800,
               check="rc0"),

@@ -7,10 +7,15 @@ large, MXU-friendly dispatch instead of `num_draft` small ones). Every
 proposal matching the target's own greedy choice is accepted; the
 first mismatch is replaced by the target's token — so the output is
 TOKEN-IDENTICAL to plain greedy decoding with the target model
-(tested), only faster wall-clock when the draft's acceptance rate is
-decent. Greedy only: the stochastic accept/reject scheme
-(Leviathan et al., arXiv 2211.17192) changes the sampling math and is
-not implemented.
+whenever the two paths' logits agree on every argmax, only faster
+wall-clock when the draft's acceptance rate is decent. The parity
+tests pin exact equality in f32; in bf16 on TPU, XLA may tile the
+(k+1)-token verification forward differently from generate()'s
+single-token steps, and a near-exact argmax tie could flip — rare in
+practice, and benchmark config 10 reports the measured match fraction
+rather than assuming it. Greedy only: the stochastic accept/reject
+scheme (Leviathan et al., arXiv 2211.17192) changes the sampling math
+and is not implemented.
 
 Works with any pair of decode-capable models sharing a vocabulary
 (`TransformerLM`, `LlamaLM`, `DeepseekLM` — e.g. a 2-layer draft for
@@ -39,18 +44,15 @@ from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
 _BOOKKEEPING = ("cache_index", "token_count", "pos_count")
 
 
-def _rewind_cache(cache, n):
-    """Roll back the last n cache slots (bookkeeping only)."""
+def _rewind_cache(cache, n, new_idx):
+    """Roll back the last n cache slots (bookkeeping only).
+
+    new_idx: the write pointer AFTER the rewind — the caller tracks it
+    host-side (it equals the number of committed cache entries), so no
+    device fetch is needed on the latency-critical round loop.
+    """
     if n == 0:
         return cache
-    # All layers share one write pointer value; read it off any leaf.
-    flat = jax.tree_util.tree_flatten_with_path(cache)[0]
-    old_idx = None
-    for path, leaf in flat:
-        if getattr(path[-1], "key", None) == "cache_index":
-            old_idx = int(leaf)
-            break
-    new_idx = old_idx - n
 
     def fix(path, leaf):
         key = getattr(path[-1], "key", None)
@@ -148,11 +150,13 @@ def generate_speculative(model, params, draft_model, draft_params,
         d_cache, _ = draft_chunk(draft_params, d_cache, prefix)
 
     while len(seq) < total:
-        # Clamp the final rounds to the remaining budget: a round
-        # commits at most k+1 tokens, so k = remaining-1 caps the peak
-        # cache write at exactly `total` slots (and skips draft steps
-        # whose proposals could never be used). At most num_draft
-        # distinct k values, so compilations stay bounded.
+        # Clamp the final rounds to the remaining budget: with
+        # k = remaining, the verification writes len(seq)-1 + (k+1) =
+        # `total` cache entries at peak — the same bound generate()
+        # has — and a full-acceptance round overshoots the budget by
+        # at most one committed token, trimmed by seq[:total] below.
+        # At most num_draft distinct k values, so compilations stay
+        # bounded.
         k = min(num_draft, total - len(seq))
 
         # --- Draft k proposals, one cheap step at a time ---
@@ -175,13 +179,16 @@ def generate_speculative(model, params, draft_model, draft_params,
         committed = drafts[:accepted] + [int(greedy[accepted])]
 
         # --- Restore the invariant ---
+        # Both caches must end holding entries for seq[:-1] after the
+        # commit, i.e. len(seq) + accepted committed entries.
+        kept = len(seq) + accepted
         # Target wrote k+1 entries (seq[-1], d1..dk); keep accepted+1.
-        t_cache = _rewind_cache(t_cache, k - accepted)
+        t_cache = _rewind_cache(t_cache, k - accepted, kept)
         # Draft wrote k entries (seq[-1], d1..d_{k-1}); its cache must
         # end holding (seq[-1], d1..d_accepted). Rejections rewind for
         # free; only full acceptance needs the one missing d_k entry.
         if accepted < k:
-            d_cache = _rewind_cache(d_cache, k - accepted - 1)
+            d_cache = _rewind_cache(d_cache, k - accepted - 1, kept)
         else:
             d_cache, _ = draft_chunk(
                 draft_params, d_cache,
