@@ -9,6 +9,7 @@ from cloud_tpu.models.resnet import (ResNet, ResNet18, ResNet34, ResNet50,
 from cloud_tpu.models.moe import (MoEMLP, TopKMoEMLP,
                                   expert_parallel_rules)
 from cloud_tpu.models.pipelined import PipelinedLM, pipelined_lm_rules
+from cloud_tpu.models.beam import generate_beam
 from cloud_tpu.models.speculative import generate_speculative
 from cloud_tpu.models.hf_import import (import_hf_deepseek,
                                         import_hf_gpt2, import_hf_llama)
