@@ -2,8 +2,11 @@
 
 NATIVE_DIR := src/cpp/monitoring
 NATIVE_BUILD := $(NATIVE_DIR)/build
+# Release leg: -DNDEBUG must not compile the checks out (round-4
+# regression: assert-based tests segfaulted under Release).
+NATIVE_BUILD_REL := $(NATIVE_DIR)/build_rel
 
-.PHONY: native native-test test all clean
+.PHONY: native native-release native-test test all clean
 
 all: native
 
@@ -11,11 +14,17 @@ native:
 	cmake -B $(NATIVE_BUILD) -G Ninja $(NATIVE_DIR)
 	cmake --build $(NATIVE_BUILD)
 
-native-test: native
+native-release:
+	cmake -B $(NATIVE_BUILD_REL) -G Ninja \
+	  -DCMAKE_BUILD_TYPE=Release $(NATIVE_DIR)
+	cmake --build $(NATIVE_BUILD_REL)
+
+native-test: native native-release
 	$(NATIVE_BUILD)/monitoring_test
+	$(NATIVE_BUILD_REL)/monitoring_test
 
 test: native-test
 	python -m pytest tests/ -q
 
 clean:
-	rm -rf $(NATIVE_BUILD)
+	rm -rf $(NATIVE_BUILD) $(NATIVE_BUILD_REL)

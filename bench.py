@@ -47,6 +47,12 @@ import numpy as np
 _PIN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "benchmarks", "best_pin.json")
 _PINNABLE = ("BENCH_BATCH", "BENCH_SPE", "BENCH_BF16_INPUT")
+# BENCH_* keys whose values came from the pin file. Seeded from
+# BENCH_PIN_APPLIED so the worker subprocess — which inherits the
+# parent's post-pin env and therefore sees every pinned key as
+# "explicitly set" — still records honest pin provenance.
+_PIN_APPLIED = [k for k in
+                os.environ.get("BENCH_PIN_APPLIED", "").split(",") if k]
 try:
     if os.environ.get("BENCH_IGNORE_PIN", "0") != "1":
         with open(_PIN_PATH) as _f:
@@ -55,16 +61,34 @@ try:
             for _k in _PINNABLE:
                 if _k in _pin and _k not in os.environ:
                     os.environ[_k] = str(int(_pin[_k]))
+                    _PIN_APPLIED.append(_k)
+                    # Export per-iteration: a later malformed key
+                    # aborts the loop, but keys already applied to
+                    # os.environ must still reach the worker with
+                    # their provenance marker.
+                    os.environ["BENCH_PIN_APPLIED"] = ",".join(
+                        _PIN_APPLIED)
 except (OSError, ValueError, TypeError):
     # A malformed pin must degrade to defaults, never kill the
     # harness (its contract: the JSON line is never empty).
     pass
 
-BATCH = int(os.environ.get("BENCH_BATCH", 256))
-IMAGE = int(os.environ.get("BENCH_IMAGE", 224))
-WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP", 3))
-TIMED_STEPS = int(os.environ.get("BENCH_STEPS", 20))
-CHUNK = min(int(os.environ.get("BENCH_CHUNK", 5)), TIMED_STEPS)
+
+def _env_int(key, default):
+    """os.environ int with the harness's never-crash contract: a
+    malformed value degrades to the default (the fallback path calls
+    this — an uncaught ValueError there would violate 'the JSON line
+    is never empty')."""
+    try:
+        return int(os.environ.get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+BATCH = _env_int("BENCH_BATCH", 256)
+IMAGE = _env_int("BENCH_IMAGE", 224)
+WARMUP_STEPS = _env_int("BENCH_WARMUP", 3)
+TIMED_STEPS = _env_int("BENCH_STEPS", 20)
+CHUNK = min(_env_int("BENCH_CHUNK", 5), TIMED_STEPS)
 BASELINE_IMAGES_PER_SEC = 350.0  # one V100, fp16 ResNet50 (8xV100 / 8)
 
 # ResNet50 fwd+bwd+update FLOPs per image at 224^2 (PERF.md roofline
@@ -334,13 +358,79 @@ def _load_last_green():
     return record
 
 
+def _requested_config():
+    """The fair-game measurement knobs THIS invocation was asked for.
+
+    Attached to every emission so a consumer can always tell which
+    configuration the number claims to describe — and, on a stale
+    re-serve, whether the cached green was captured under a DIFFERENT
+    config (round-4 gap: captures/bench_spe5.json served the flagship
+    number under an SPE-contrast filename with nothing marking the
+    mismatch). Values reflect the post-pin environment; `pinned` lists
+    the keys best_pin.json supplied.
+    """
+    cfg = {
+        "batch": BATCH,
+        "image": IMAGE,
+        "steps_per_execution": max(_env_int("BENCH_SPE", 1), 1),
+        "bf16_input": os.environ.get("BENCH_BF16_INPUT", "0") == "1",
+        "space_to_depth": os.environ.get("BENCH_S2D", "0") == "1",
+    }
+    for key in ("CLOUD_TPU_FLASH_BLOCK_Q", "CLOUD_TPU_FLASH_BLOCK_K"):
+        if os.environ.get(key):
+            cfg[key.lower()] = _env_int(key, 0)
+    if _PIN_APPLIED:
+        cfg["pinned"] = list(_PIN_APPLIED)
+    return cfg
+
+
+def _captured_config(record):
+    """The config a (possibly pre-round-5) record was captured under.
+
+    New records carry `requested_config` verbatim; legacy cached
+    records are reconstructed from the fields the worker has always
+    emitted (spe/stem/input_dtype are written only when non-default).
+    """
+    if isinstance(record.get("requested_config"), dict):
+        return record["requested_config"]
+    return {
+        "batch": record.get("batch"),
+        "image": record.get("image"),
+        "steps_per_execution": record.get("steps_per_execution", 1),
+        "bf16_input": record.get("input_dtype") == "bfloat16",
+        "space_to_depth": record.get("stem") == "space_to_depth",
+    }
+
+
+def _config_mismatch(requested, captured):
+    """True iff any knob differs. `pinned` is provenance, not a knob;
+    a key absent on one side compares as its absent-default (None for
+    sizes, which only happens on hand-seeded records — an honest
+    mismatch)."""
+    keys = (set(requested) | set(captured)) - {"pinned"}
+    return any(requested.get(k) != captured.get(k) for k in keys)
+
+
 def _emit_fallback(last_err, extra=None):
-    """The never-empty exit: cached green (marked stale) or error JSON."""
+    """The never-empty exit: cached green (marked stale) or error JSON.
+
+    A stale re-serve is self-describing: it carries the config THIS
+    run requested and, when the cached green was captured under a
+    different config, `config_mismatch: true` plus that cached config
+    — a consumer diffing e.g. SPE-on vs SPE-off can no longer read a
+    never-measured 0% delta off two re-serves of the same capture.
+    """
+    requested = _requested_config()
     cached = _load_last_green()
     if cached is not None:
         stale = dict(cached)
         stale["stale"] = True
         stale["stale_reason"] = last_err
+        stale["requested_config"] = requested
+        captured = _captured_config(cached)
+        if _config_mismatch(requested, captured):
+            stale["config_mismatch"] = True
+            stale["captured_config"] = captured
         if stale.get("self_reported"):
             # A hand measurement must fail safe for consumers that read
             # `value` without checking provenance flags: move the number
@@ -360,6 +450,7 @@ def _emit_fallback(last_err, extra=None):
         "unit": "images/sec",
         "vs_baseline": 0.0,
         "error": last_err,
+        "requested_config": requested,
     }
     record.update(extra or {})
     _print_record(record)
@@ -576,7 +667,7 @@ def worker():
     # on the tunneled chip every dispatch costs a ~66ms round-trip
     # (PERF.md), so amortizing it across the chunk measures the chip,
     # not the tunnel. BENCH_SPE=1 preserves the round-2 methodology.
-    spe = max(int(os.environ.get("BENCH_SPE", 1)), 1)
+    spe = max(_env_int("BENCH_SPE", 1), 1)
     if spe > 1:
         inner = trainer._make_train_step_body()
 
@@ -667,6 +758,9 @@ def worker():
         "pct_peak": round(100.0 * tflops / V5E_PEAK_TFLOPS, 1),
         "flops_source": ("xla_cost_analysis" if xla_flops is not None
                          else "estimate_12.3gflops_per_image"),
+        # Self-describing capture: lets a later stale re-serve compare
+        # what it is asked for against what this record measured.
+        "requested_config": _requested_config(),
     }
     if xla_flops is not None:
         record["xla_flops_per_dispatch"] = xla_flops

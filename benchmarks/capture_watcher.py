@@ -53,7 +53,7 @@ class Stage(object):
         self.argv = argv
         self.timeout = timeout
         self.env = env or dict(os.environ)
-        self.check = check  # "tpu_json" | "rc0"
+        self.check = check  # "tpu_json" | "rc0" | "tpu_line"
         self.attempts = 0
         self.state = "pending"  # pending | done | exhausted
         self.note = ""
@@ -76,6 +76,12 @@ def stages():
         Stage("kernels", [_PY, os.path.join(_HERE, "run_all.py"),
                           "6", "7", "8", "9", "10"], 2400,
               check="rc0"),
+        # Profiler trace for the MFU gap attribution (PERF.md roofline
+        # section); prints "trace written to ... platform <backend>",
+        # not JSON — the check greps for a TPU backend so a silent
+        # CPU fallback can't mark the stage done.
+        Stage("profile", [_PY, os.path.join(_HERE, "profile_resnet.py"),
+                          "--steps", "10"], 1200, check="tpu_line"),
         Stage("pipeline_tpu", [_PY, os.path.join(
             _HERE, "pipeline_schedule_bench.py"), "--run"], 1800,
               check="rc0"),
@@ -136,6 +142,15 @@ def run_stage(stage):
     if stage.check == "tpu_json":
         ok = (record is not None and record.get("platform") == "tpu"
               and not record.get("stale") and record.get("value"))
+    elif stage.check == "tpu_line":
+        # Non-JSON tools print their backend; a clean exit on a CPU
+        # fallback is NOT a capture.
+        try:
+            with open(out_path) as f:
+                out_text = f.read()
+        except OSError:
+            out_text = ""
+        ok = rc == 0 and "platform tpu" in out_text
     else:
         ok = rc == 0 and record is not None
     stage.note = "rc={} {:.0f}s".format(rc, elapsed)

@@ -33,6 +33,17 @@ def main(argv=None):
         jax.config.update("jax_platforms", "cpu")
     import optax
 
+    # One measurement driver on the chip at a time (same advisory lock
+    # as bench.py/sweep.py): a concurrent capture would contend through
+    # the tunnel and distort both the trace and the other run's timing.
+    try:
+        sys.path.insert(0, os.path.join(_REPO_ROOT, "benchmarks"))
+        from _subproc import hold_chip_lock
+        global _CHIP_LOCK
+        _CHIP_LOCK = hold_chip_lock(timeout=900.0)
+    except ImportError:
+        pass
+
     from cloud_tpu.models import ResNet50
     from cloud_tpu.monitoring import profiler
     from cloud_tpu.training import Trainer

@@ -1,9 +1,11 @@
-// Assert-based tests (gtest is not in this image). Mirrors the
+// CHECK-based tests (gtest is not in this image). Mirrors the
 // reference's golden-request tests (stackdriver_client_test.cc:86-212):
 // exact serialized-request matching for both RPC builders, plus
 // registry/whitelist/exporter behavior with a capturing transport.
+// CHECK (below) is always-on — unlike assert, which -DNDEBUG compiles
+// out, silently skipping every test in a Release build; the reference's
+// gtest assertions survive any build type, so must these.
 
-#include <cassert>
 #include <cstdio>
 #include <cstdlib>
 #include <string>
@@ -22,6 +24,15 @@ using cloud_tpu::monitoring::MetricKind;
 using cloud_tpu::monitoring::MetricSnapshot;
 using cloud_tpu::monitoring::MetricsRegistry;
 using cloud_tpu::monitoring::StackdriverClient;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "CHECK failed %s:%d: %s\n", __FILE__,      \
+                   __LINE__, #cond);                                  \
+      std::exit(1);                                                   \
+    }                                                                 \
+  } while (0)
 
 #define CHECK_CONTAINS(haystack, needle)                              \
   do {                                                                \
@@ -57,7 +68,7 @@ void TestTimeSeriesGolden() {
       "\"points\":[{\"interval\":{\"startTime\":{\"seconds\":1400000000,"
       "\"nanos\":0},\"endTime\":{\"seconds\":1500000000,"
       "\"nanos\":0}},\"value\":{\"int64Value\":42}}]}]}";
-  assert(json == expected);
+  CHECK(json == expected);
 }
 
 void TestDistributionConversion() {
@@ -67,13 +78,13 @@ void TestDistributionConversion() {
   MetricsRegistry::Get()->ObserveHistogram("/h", 5.0, bounds);
   MetricsRegistry::Get()->ObserveHistogram("/h", 500.0, bounds);
   auto snaps = MetricsRegistry::Get()->Snapshot();
-  assert(snaps.size() == 1);
+  CHECK(snaps.size() == 1);
   const HistogramData& h = snaps[0].histogram;
-  assert(h.count == 3);
-  assert(h.bucket_counts.size() == 4);
-  assert(h.bucket_counts[0] == 1);  // 0.5 <= 1
-  assert(h.bucket_counts[1] == 1);  // 5 <= 10
-  assert(h.bucket_counts[3] == 1);  // 500 overflow
+  CHECK(h.count == 3);
+  CHECK(h.bucket_counts.size() == 4);
+  CHECK(h.bucket_counts[0] == 1);  // 0.5 <= 1
+  CHECK(h.bucket_counts[1] == 1);  // 5 <= 10
+  CHECK(h.bucket_counts[3] == 1);  // 500 overflow
   std::string json = StackdriverClient::TimeSeriesJson("p", snaps);
   CHECK_CONTAINS(json, "\"distributionValue\"");
   CHECK_CONTAINS(json, "\"count\":3");
@@ -92,7 +103,7 @@ void TestDescriptorGolden() {
       "\"custom.googleapis.com/cloud_tpu/training/steps\","
       "\"metricKind\":\"CUMULATIVE\",\"valueType\":\"INT64\","
       "\"description\":\"Completed training steps\"}}";
-  assert(json == expected);
+  CHECK(json == expected);
 }
 
 void TestWhitelistAndGate() {
@@ -100,18 +111,18 @@ void TestWhitelistAndGate() {
   unsetenv(cloud_tpu::monitoring::kWhitelistEnvVar);
   unsetenv(cloud_tpu::monitoring::kEnabledEnvVar);
   const Config* config = Config::Get();
-  assert(config->IsWhitelisted("/cloud_tpu/training/steps"));
-  assert(!config->IsWhitelisted("/not/registered"));
-  assert(!config->enabled());
+  CHECK(config->IsWhitelisted("/cloud_tpu/training/steps"));
+  CHECK(!config->IsWhitelisted("/not/registered"));
+  CHECK(!config->enabled());
 
   Config::ResetForTesting();
   setenv(cloud_tpu::monitoring::kWhitelistEnvVar, "/a,/b", 1);
   setenv(cloud_tpu::monitoring::kEnabledEnvVar, "true", 1);
   config = Config::Get();
-  assert(config->IsWhitelisted("/a"));
-  assert(config->IsWhitelisted("/b"));
-  assert(!config->IsWhitelisted("/cloud_tpu/training/steps"));
-  assert(config->enabled());
+  CHECK(config->IsWhitelisted("/a"));
+  CHECK(config->IsWhitelisted("/b"));
+  CHECK(!config->IsWhitelisted("/cloud_tpu/training/steps"));
+  CHECK(config->enabled());
   Config::ResetForTesting();
   unsetenv(cloud_tpu::monitoring::kWhitelistEnvVar);
   unsetenv(cloud_tpu::monitoring::kEnabledEnvVar);
@@ -138,14 +149,14 @@ void TestExporterFiltersAndDedups() {
 
   // Pass 1: descriptor + series; pass 2: series only (descriptor
   // dedup, reference exporter.cc:105-126).
-  assert(sent.size() == 3);
-  assert(sent[0].first == "CreateMetricDescriptor");
-  assert(sent[1].first == "CreateTimeSeries");
-  assert(sent[2].first == "CreateTimeSeries");
+  CHECK(sent.size() == 3);
+  CHECK(sent[0].first == "CreateMetricDescriptor");
+  CHECK(sent[1].first == "CreateTimeSeries");
+  CHECK(sent[2].first == "CreateTimeSeries");
   CHECK_CONTAINS(sent[1].second, "/cloud_tpu/training/steps");
   // The non-whitelisted metric never leaves the process.
-  assert(sent[1].second.find("/not/whitelisted") == std::string::npos);
-  assert(exporter.export_count() == 2);
+  CHECK(sent[1].second.find("/not/whitelisted") == std::string::npos);
+  CHECK(exporter.export_count() == 2);
 
   Config::ResetForTesting();
   unsetenv(cloud_tpu::monitoring::kWhitelistEnvVar);
@@ -157,7 +168,8 @@ void TestPeriodicGate() {
   StackdriverClient client("proj", nullptr);
   Exporter exporter(&client);
   // Gate off -> refuses to start (reference exporter.cc:31-36).
-  assert(!exporter.PeriodicallyExportMetrics());
+  bool started = exporter.PeriodicallyExportMetrics();
+  CHECK(!started);
   Config::ResetForTesting();
 }
 
@@ -178,10 +190,13 @@ void TestTransportDispatch() {
   g_callback_sent = &sent;
   SetTransportCallback(&CapturingCallback);
   auto transport = DispatchTransport();
-  assert(transport("CreateTimeSeries", "{\"k\":1}"));
-  assert(sent.size() == 1);
-  assert(sent[0].first == "CreateTimeSeries");
-  assert(sent[0].second == "{\"k\":1}");
+  // The call under test stays OUTSIDE the check macro: even though
+  // CHECK is always-on, the action must read as an action.
+  bool dispatched = transport("CreateTimeSeries", "{\"k\":1}");
+  CHECK(dispatched);
+  CHECK(sent.size() == 1);
+  CHECK(sent[0].first == "CreateTimeSeries");
+  CHECK(sent[0].second == "{\"k\":1}");
 
   // Clearing it restores the env-selected (file) transport.
   SetTransportCallback(nullptr);
@@ -190,11 +205,13 @@ void TestTransportDispatch() {
   std::remove(path);
   setenv(cloud_tpu::monitoring::kExportPathEnvVar, path, 1);
   unsetenv(cloud_tpu::monitoring::kTransportEnvVar);
-  assert(transport("CreateTimeSeries", "{\"k\":2}"));
+  dispatched = transport("CreateTimeSeries", "{\"k\":2}");
+  CHECK(dispatched);
   std::FILE* f = std::fopen(path, "r");
-  assert(f != nullptr);
+  CHECK(f != nullptr);
   char buf[256] = {0};
-  assert(std::fgets(buf, sizeof(buf), f) != nullptr);
+  char* line_read = std::fgets(buf, sizeof(buf), f);
+  CHECK(line_read != nullptr);
   std::fclose(f);
   CHECK_CONTAINS(std::string(buf), "\"k\":2");
   std::remove(path);
@@ -209,12 +226,12 @@ void TestRestBodyShapes() {
   std::string descriptor_wrapper =
       "{\"name\":\"projects/p\",\"metricDescriptor\":{\"type\":\"t\","
       "\"metricKind\":\"CUMULATIVE\"}}";
-  assert(RestBody("CreateMetricDescriptor", descriptor_wrapper) ==
+  CHECK(RestBody("CreateMetricDescriptor", descriptor_wrapper) ==
          "{\"type\":\"t\",\"metricKind\":\"CUMULATIVE\"}");
   // timeSeries.create takes {"timeSeries": [...]}.
   std::string series_wrapper =
       "{\"name\":\"projects/p\",\"timeSeries\":[{\"metric\":1}]}";
-  assert(RestBody("CreateTimeSeries", series_wrapper) ==
+  CHECK(RestBody("CreateTimeSeries", series_wrapper) ==
          "{\"timeSeries\":[{\"metric\":1}]}");
 }
 
@@ -228,7 +245,7 @@ void TestHttpSendFailsFastWhenUnreachable() {
   // Port 9 (discard) refuses connections: a clean false, no crash/hang.
   bool ok = cloud_tpu::monitoring::HttpSend(
       "http://127.0.0.1:9", "proj", "CreateTimeSeries", "{}");
-  assert(!ok);
+  CHECK(!ok);
   unsetenv("CLOUD_TPU_MONITORING_TOKEN");
 }
 

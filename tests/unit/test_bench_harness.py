@@ -77,6 +77,103 @@ class TestStaleFallback:
         assert record["value"] == 0.0
         assert record["error"] == "tunnel down"
         assert record["probes"] == 3
+        # Even the error record says what it was asked to measure.
+        assert record["requested_config"]["batch"] == bench.BATCH
+
+
+class TestSelfDescribingConfig:
+    """Round-5 contract: every emission records the REQUESTED config;
+    a stale re-serve captured under a different config says so
+    (config_mismatch + captured_config) instead of silently serving a
+    number measured under other knobs (the bench_spe5.json ambiguity)."""
+
+    def _green(self, bench, **extra):
+        record = {"metric": bench.METRIC, "value": 2243.4,
+                  "unit": "images/sec", "vs_baseline": 6.41,
+                  "platform": "tpu", "kernel_parity": "ok",
+                  "batch": 256, "image": 224}
+        record.update(extra)
+        with open(bench.LAST_GREEN_PATH, "w") as f:
+            json.dump(record, f)
+
+    def test_stale_reserve_same_config_no_mismatch(self, bench,
+                                                   capsys):
+        self._green(bench)
+        bench._emit_fallback("tunnel down")
+        record = _emitted_record(capsys)
+        assert record["stale"] is True
+        assert record["requested_config"]["steps_per_execution"] == 1
+        assert "config_mismatch" not in record
+
+    def test_stale_reserve_under_spe_request_flags_mismatch(
+            self, bench, capsys, monkeypatch):
+        """The exact round-4 failure: SPE=5 requested, cache holds the
+        SPE=1 flagship — the re-serve must flag the mismatch and show
+        what the cached number was actually measured with."""
+        self._green(bench)  # legacy record: no steps_per_execution key
+        monkeypatch.setenv("BENCH_SPE", "5")
+        bench._emit_fallback("tunnel down")
+        record = _emitted_record(capsys)
+        assert record["stale"] is True
+        assert record["requested_config"]["steps_per_execution"] == 5
+        assert record["config_mismatch"] is True
+        assert record["captured_config"]["steps_per_execution"] == 1
+
+    def test_captured_config_prefers_recorded_over_reconstruction(
+            self, bench, capsys, monkeypatch):
+        self._green(bench, requested_config={
+            "batch": 256, "image": 224, "steps_per_execution": 5,
+            "bf16_input": False, "space_to_depth": False})
+        monkeypatch.setenv("BENCH_SPE", "5")
+        bench._emit_fallback("tunnel down")
+        record = _emitted_record(capsys)
+        assert "config_mismatch" not in record
+
+    def test_pin_provenance_is_not_a_mismatch(self, bench, capsys):
+        """`pinned` records where values came from, not what was
+        measured — a green captured with explicit env must re-serve
+        clean when the same values later arrive via best_pin.json."""
+        self._green(bench, requested_config={
+            "batch": 256, "image": 224, "steps_per_execution": 1,
+            "bf16_input": False, "space_to_depth": False})
+        bench._PIN_APPLIED = ["BENCH_BATCH"]
+        bench._emit_fallback("tunnel down")
+        record = _emitted_record(capsys)
+        assert record["requested_config"]["pinned"] == ["BENCH_BATCH"]
+        assert "config_mismatch" not in record
+
+    def test_worker_flash_pins_enter_requested_config(
+            self, bench, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_FLASH_BLOCK_Q", "512")
+        cfg = bench._requested_config()
+        assert cfg["cloud_tpu_flash_block_q"] == 512
+
+    def test_malformed_env_never_crashes_the_fallback(
+            self, bench, capsys, monkeypatch):
+        """_requested_config runs inside the never-empty fallback path
+        (including the SIGTERM handler): a garbage env value must
+        degrade to the default, not raise."""
+        monkeypatch.setenv("BENCH_SPE", "garbage")
+        monkeypatch.setenv("CLOUD_TPU_FLASH_BLOCK_Q", "auto")
+        bench._emit_fallback("tunnel down")
+        record = _emitted_record(capsys)
+        assert record["requested_config"]["steps_per_execution"] == 1
+        assert record["requested_config"]["cloud_tpu_flash_block_q"] == 0
+
+
+def test_worker_inherits_pin_provenance(monkeypatch):
+    """The worker subprocess sees pin-applied keys as explicitly-set
+    env; BENCH_PIN_APPLIED (exported by the parent's pin loop) must
+    carry the provenance across so worker-captured records still list
+    `pinned` honestly."""
+    monkeypatch.setenv("BENCH_IGNORE_PIN", "1")
+    monkeypatch.setenv("BENCH_PIN_APPLIED", "BENCH_SPE,BENCH_BATCH")
+    spec = importlib.util.spec_from_file_location(
+        "bench_pin_inherit", os.path.abspath(_BENCH_PATH))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod._requested_config()["pinned"] == [
+        "BENCH_SPE", "BENCH_BATCH"]
 
 
 class TestCrashedWorker:
@@ -269,6 +366,35 @@ class TestBestPin:
             # nothing leaks into later tests.
             for key in ("BENCH_BATCH", "BENCH_BF16_INPUT"):
                 os.environ.pop(key, None)
+
+
+def test_malformed_pin_key_keeps_applied_provenance(tmp_path,
+                                                    monkeypatch):
+    """A malformed later pin key aborts the pin loop, but keys already
+    applied to os.environ must still carry BENCH_PIN_APPLIED into the
+    worker — provenance is exported per-iteration, not after the loop."""
+    import importlib.util
+    import json as json_lib
+
+    pin_path = tmp_path / "best_pin.json"
+    pin_path.write_text(json_lib.dumps(
+        {"BENCH_BATCH": 512, "BENCH_SPE": None}))
+    for key in ("BENCH_BATCH", "BENCH_SPE", "BENCH_PIN_APPLIED"):
+        monkeypatch.delenv(key, raising=False)
+    monkeypatch.delenv("BENCH_IGNORE_PIN", raising=False)
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_pin_malformed", os.path.abspath(_BENCH_PATH))
+    mod = importlib.util.module_from_spec(spec)
+    monkeypatch.setattr("os.path.join", _join_redirect(str(pin_path)))
+    try:
+        spec.loader.exec_module(mod)
+        assert mod.BATCH == 512
+        assert os.environ["BENCH_PIN_APPLIED"] == "BENCH_BATCH"
+        assert mod._requested_config()["pinned"] == ["BENCH_BATCH"]
+    finally:
+        for key in ("BENCH_BATCH", "BENCH_PIN_APPLIED"):
+            os.environ.pop(key, None)
 
 
 def _join_redirect(pin_path):
