@@ -70,6 +70,43 @@ def decode_slot_update(module, mask, batch, seq, cache_len):
     return idx, positions, allowed
 
 
+def warp_logits(logits, temperature, top_k=None, top_p=None):
+    """HF-warper-order logits processing: top-k (on raw logits) →
+    temperature → top-p nucleus. Shared by `generate()`'s sampler and
+    stochastic speculative decoding, so the speculative accept/reject
+    math targets EXACTLY the distribution `generate()` samples from.
+
+    temperature must be > 0 (greedy argmax is a separate path).
+    Nucleus membership is decided in sorted order and scattered back
+    through the inverse permutation — exact logit ties at the cutoff
+    are split by descending-sort position (jnp.argsort is stable, so
+    equal logits keep vocab-index order), matching HF's sorted-index
+    scatter rather than a value threshold that would keep every tied
+    token (reference semantics: transformers TopPLogitsWarper).
+    """
+    logits = logits.astype(jnp.float32)
+    if top_k is not None:
+        # O(V log k), not a full vocab sort per decode step.
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    scaled = logits / temperature
+    if top_p is not None and top_p < 1.0:
+        # Keep the smallest top-probability set whose cumulative mass
+        # reaches top_p: `cum - probs < top_p` keeps every token whose
+        # EXCLUSIVE prefix mass is below the threshold — the set up to
+        # and including the first token that crosses it, so at least
+        # one always survives.
+        sort_idx = jnp.argsort(-scaled, axis=-1)
+        sorted_scaled = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_scaled, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_p
+        inv = jnp.argsort(sort_idx, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        scaled = jnp.where(keep, scaled, -1e30)
+    return scaled
+
+
 def empty_cache(decoder, batch):
     """Zero-initialized decode-cache pytree for a decode-mode module
     (shared by `generate` and `generate_speculative`): built from the
@@ -81,4 +118,4 @@ def empty_cache(decoder, batch):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
-__all__ = ["decode_slot_update", "empty_cache"]
+__all__ = ["decode_slot_update", "empty_cache", "warp_logits"]

@@ -227,6 +227,17 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     # cache masks the same band), so the imported model attends exactly
     # the keys the checkpoint was trained on at any sequence length.
     window = cfg("sliding_window", False)
+    if window:
+        # Qwen2/Qwen3-family gate: HF applies the band only when
+        # use_sliding_window is true, default FALSE
+        # (configuration_qwen2.py: `self.sliding_window =
+        # sliding_window if self.use_sliding_window else None`) — real
+        # config objects null the window themselves, so this fires
+        # only for raw dict configs. Families without the gate
+        # (mistral, ...) default to applying the window.
+        gated_family = str(cfg("model_type", "llama")).startswith("qwen")
+        if not cfg("use_sliding_window", not gated_family):
+            window = False
     horizon = max_seq_len or cfg("max_position_embeddings", 2048)
 
     rope_scaling = _translate_rope_scaling(
@@ -307,6 +318,14 @@ def import_hf_llama(model=None, state_dict=None, config=None,
         attn_kinds = tuple(
             "local" if (i + 1) % pattern else "global"
             for i in range(layers))
+    elif window and str(cfg("model_type", "llama")).startswith("qwen"):
+        # Qwen2 without explicit layer_types: HF bands only layers
+        # i >= max_window_layers (configuration_qwen2.py layer_types
+        # derivation); the early layers stay full attention.
+        mwl = int(cfg("max_window_layers", layers))
+        if mwl > 0:
+            attn_kinds = tuple("global" if i < mwl else "local"
+                               for i in range(layers))
 
     attn_scale = None
     if is_gemma2 or is_gemma3:
@@ -729,6 +748,19 @@ def import_hf_deepseek(model=None, state_dict=None, config=None,
     if is_v2:
         moe_scoring, moe_route_bias = "softmax", False
         moe_group_select = "max"
+        # norm_topk_prob=true is contested for V2: the HF port ignores
+        # it (DeepseekV2MoEGate.forward scales by
+        # routed_scaling_factor only) while DeepSeek's remote-code
+        # modeling honors it when top_k > 1. No shipped V2/V2-Lite
+        # checkpoint sets it, so refuse loudly instead of silently
+        # picking a side.
+        if cfg("norm_topk_prob", False):
+            raise NotImplementedError(
+                "DeepSeek-V2 config sets norm_topk_prob=true: the HF "
+                "port ignores it while DeepSeek's own modeling "
+                "normalizes the top-k gates — no shipped checkpoint "
+                "sets it, and importing one would silently pick a "
+                "side. Set it false (the shipped default) to import.")
         norm_topk = False
         topk_method = cfg("topk_method", "greedy")
         if topk_method == "greedy":

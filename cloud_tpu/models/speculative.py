@@ -1,21 +1,38 @@
-"""Greedy speculative decoding: draft proposes, target verifies.
+"""Speculative decoding: draft proposes, target verifies.
 
 The latency optimization for single-stream decoding: a small DRAFT
 model proposes `num_draft` tokens one at a time (cheap steps), and the
 large TARGET model scores all of them in ONE forward pass (a single
-large, MXU-friendly dispatch instead of `num_draft` small ones). Every
-proposal matching the target's own greedy choice is accepted; the
-first mismatch is replaced by the target's token — so the output is
-TOKEN-IDENTICAL to plain greedy decoding with the target model
-whenever the two paths' logits agree on every argmax, only faster
-wall-clock when the draft's acceptance rate is decent. The parity
-tests pin exact equality in f32; in bf16 on TPU, XLA may tile the
-(k+1)-token verification forward differently from generate()'s
-single-token steps, and a near-exact argmax tie could flip — rare in
-practice, and benchmark config 10 reports the measured match fraction
-rather than assuming it. Greedy only: the stochastic accept/reject
-scheme (Leviathan et al., arXiv 2211.17192) changes the sampling math
-and is not implemented.
+large, MXU-friendly dispatch instead of `num_draft` small ones).
+
+Two verification modes, selected by `temperature`:
+
+- Greedy (temperature=0, the default): every proposal matching the
+  target's own greedy choice is accepted; the first mismatch is
+  replaced by the target's token — so the output is TOKEN-IDENTICAL
+  to plain greedy decoding with the target model whenever the two
+  paths' logits agree on every argmax, only faster wall-clock when
+  the draft's acceptance rate is decent. The parity tests pin exact
+  equality in f32; in bf16 on TPU, XLA may tile the (k+1)-token
+  verification forward differently from generate()'s single-token
+  steps, and a near-exact argmax tie could flip — rare in practice,
+  and benchmark config 10 reports the measured match fraction rather
+  than assuming it.
+
+- Stochastic (temperature>0): the Leviathan et al. accept/reject
+  scheme (arXiv 2211.17192). The draft SAMPLES each proposal from its
+  warped distribution q; the target computes its warped distribution
+  p at every position in the one verification forward; proposal i is
+  accepted with probability min(1, p(x_i)/q(x_i)), and the first
+  rejection is replaced by a sample from norm(max(p - q, 0)) — after
+  full acceptance a bonus token is sampled from p. The committed
+  stream is distributed EXACTLY as target-only sampling (the paper's
+  Theorem 3.5), and because both sides share `generate()`'s warper
+  (models/decoding.py warp_logits: top-k → temperature → top-p), the
+  scheme composes with the whole sampling surface. The accept/reject
+  math itself lives in `_accept_and_residual` (pure, unit-tested
+  against a numpy oracle; the distribution-parity statistical test
+  drives the same function through vmap).
 
 Works with any pair of decode-capable models sharing a vocabulary
 (`TransformerLM`, `LlamaLM`, `DeepseekLM` — e.g. a 2-layer draft for
@@ -38,7 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cloud_tpu.models.decoding import empty_cache
+from cloud_tpu.models.decoding import empty_cache, warp_logits
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
 
 _BOOKKEEPING = ("cache_index", "token_count", "pos_count")
@@ -81,14 +98,103 @@ def _chunk_fn(decoder):
     return chunk
 
 
-def generate_speculative(model, params, draft_model, draft_params,
-                         prompt, max_new_tokens, num_draft=4,
-                         eos_token=None):
-    """Greedy decode with draft-model speculation.
+@functools.lru_cache(maxsize=128)
+def _sample_step_fn(decoder, temperature, top_k, top_p):
+    """Jitted single-token sampling step for the stochastic draft:
+    returns (new_cache, next token [B], warped logits [B, V]) — the
+    warped logits are the q-distribution the accept/reject math needs,
+    captured at the moment of sampling so q is exactly what the token
+    was drawn from."""
+
+    @jax.jit
+    def step(params, cache, token, rng):
+        logits, vars_ = decoder.apply(
+            {"params": params, "cache": cache}, token,
+            mutable=["cache"])
+        warped = warp_logits(logits[:, -1], temperature, top_k, top_p)
+        nxt = jax.random.categorical(rng, warped,
+                                     axis=-1).astype(jnp.int32)
+        return vars_["cache"], nxt, warped
+
+    return step
+
+
+def _accept_and_residual(p, q, d_tokens, uniforms):
+    """Leviathan et al. accept/reject math (pure; oracle-tested).
 
     Args:
-        model / params: the TARGET model (its greedy output is what
-            this function reproduces, token for token).
+        p: [k+1, V] target probabilities (post-warp softmax) at the
+            k+1 verification positions.
+        q: [k, V] draft probabilities the k proposals were drawn from.
+        d_tokens: [k] int32 proposals.
+        uniforms: [k] U[0,1) draws, one per proposal.
+
+    Returns (n_acc, resid):
+        n_acc: number of LEADING proposals accepted — proposal i is
+            accepted iff uniforms[i] < min(1, p_i(x_i)/q_i(x_i)), and
+            acceptance stops at the first failure.
+        resid: [V] the distribution for the extra committed token —
+            norm(max(p - q, 0)) at the first rejected position, or
+            p[k] (the bonus position) when all k were accepted. The
+            committed stream (accepted proposals + this sample) is
+            then distributed exactly as target-only sampling.
+    """
+    k = q.shape[0]
+    idx = jnp.arange(k)
+    p_tok = p[idx, d_tokens]
+    q_tok = q[idx, d_tokens]
+    # q(x_i) > 0 by construction (x_i was sampled from q); the
+    # denominator guard is numerical only.
+    accept = uniforms < jnp.minimum(
+        1.0, p_tok / jnp.maximum(q_tok, 1e-38))
+    n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+    p_row = p[n_acc]
+    q_row = jnp.where(n_acc < k, q[jnp.minimum(n_acc, k - 1)],
+                      jnp.zeros_like(p_row))
+    resid = jnp.maximum(p_row - q_row, 0.0)
+    total = jnp.sum(resid)
+    # total == 0 would need a rejection at a position where p == q,
+    # which has probability 0 in exact arithmetic; the fallback to
+    # p_row guards float underflow only.
+    resid = jnp.where(total > 0.0, resid / total, p_row)
+    return n_acc, resid
+
+
+@functools.lru_cache(maxsize=128)
+def _verify_fn(decoder, temperature, top_k, top_p):
+    """Jitted stochastic verification: one target forward over the
+    k+1 verification tokens, accept/reject on device, and the
+    replacement/bonus sample — only two scalars (n_acc, token) ever
+    travel back to host per round."""
+
+    @jax.jit
+    def verify(params, cache, tokens, q_warped, d_tokens, uniforms,
+               rng):
+        logits, vars_ = decoder.apply(
+            {"params": params, "cache": cache}, tokens,
+            mutable=["cache"])
+        p_warped = warp_logits(logits[0], temperature, top_k, top_p)
+        n_acc, resid = _accept_and_residual(
+            jax.nn.softmax(p_warped, axis=-1),
+            jax.nn.softmax(q_warped, axis=-1), d_tokens, uniforms)
+        extra = jax.random.categorical(
+            rng, jnp.log(resid)).astype(jnp.int32)
+        return vars_["cache"], n_acc, extra
+
+    return verify
+
+
+def generate_speculative(model, params, draft_model, draft_params,
+                         prompt, max_new_tokens, num_draft=4,
+                         eos_token=None, rng=None, temperature=0.0,
+                         top_k=None, top_p=None, return_stats=False):
+    """Decode with draft-model speculation (greedy or stochastic).
+
+    Args:
+        model / params: the TARGET model. With temperature=0 its
+            greedy output is what this function reproduces, token for
+            token; with temperature>0 the committed stream is
+            distributed exactly as sampling from the target alone.
         draft_model / draft_params: the cheap proposal model (same
             vocabulary; any decode-capable family).
         prompt: [1, S] int32 (batch 1 — see module docstring).
@@ -98,11 +204,24 @@ def generate_speculative(model, params, draft_model, draft_params,
             num_draft+1 tokens, and commits between 1 and num_draft+1
             tokens.
         eos_token: optional stop token; the tail is filled with it.
+        rng: PRNGKey; required when temperature > 0.
+        temperature: 0 = greedy verification (the default, original
+            behavior); > 0 = stochastic accept/reject targeting the
+            temperature-scaled distribution.
+        top_k / top_p: sampling warpers, exactly `generate()`'s
+            semantics; applied to BOTH the draft's proposal
+            distribution and the target's verification distribution
+            (temperature > 0 only — greedy ignores them, as argmax is
+            warp-invariant).
+        return_stats: when True, returns (tokens, stats) where stats
+            has `rounds`, `proposed`, `accepted_drafts`, and
+            `acceptance_rate` (accepted_drafts / proposed) — the
+            number benchmark config 10 reports.
 
     Returns:
-        [1, S + max_new_tokens] int32 — identical to
-        `generate(model, params, prompt, max_new_tokens,
-        temperature=0.0)`.
+        [1, S + max_new_tokens] int32 — with temperature=0, identical
+        to `generate(model, params, prompt, max_new_tokens,
+        temperature=0.0)`. With return_stats, a (tokens, dict) pair.
     """
     batch, prompt_len = prompt.shape
     if batch != 1:
@@ -116,8 +235,27 @@ def generate_speculative(model, params, draft_model, draft_params,
     if max_new_tokens < 0:
         raise ValueError("max_new_tokens must be >= 0; got {}.".format(
             max_new_tokens))
+    stochastic = bool(temperature)
+    if stochastic and rng is None:
+        raise ValueError("Sampling (temperature > 0) needs `rng`.")
+    if top_k is not None and not 1 <= top_k <= model.vocab_size:
+        raise ValueError(
+            "top_k must be in [1, vocab_size={}]; got {}.".format(
+                model.vocab_size, top_k))
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            "top_p must be in (0, 1]; got {}.".format(top_p))
+    stats = {"rounds": 0, "proposed": 0, "accepted_drafts": 0,
+             "acceptance_rate": 0.0}
+
+    def finish(tokens):
+        if stats["proposed"]:
+            stats["acceptance_rate"] = (
+                stats["accepted_drafts"] / stats["proposed"])
+        return (tokens, stats) if return_stats else tokens
+
     if max_new_tokens == 0:
-        return prompt
+        return finish(prompt)
     for m, name in ((model, "model"), (draft_model, "draft_model")):
         if m.attention_impl in SEQUENCE_PARALLEL_IMPLS:
             raise NotImplementedError(
@@ -139,6 +277,12 @@ def generate_speculative(model, params, draft_model, draft_params,
     draft = draft_model.clone(decode=True, dropout_rate=0.0)
     target_chunk = _chunk_fn(target)
     draft_chunk = _chunk_fn(draft)
+    if stochastic:
+        warp_key = (float(temperature),
+                    None if top_k is None else int(top_k),
+                    None if top_p is None else float(top_p))
+        draft_step = _sample_step_fn(draft, *warp_key)
+        verify_step = _verify_fn(target, *warp_key)
     t_cache = empty_cache(target, 1)
     d_cache = empty_cache(draft, 1)
 
@@ -159,24 +303,57 @@ def generate_speculative(model, params, draft_model, draft_params,
         # bounded.
         k = min(num_draft, total - len(seq))
 
-        # --- Draft k proposals, one cheap step at a time ---
-        drafts = []
-        tok = seq[-1]
-        for _ in range(k):
-            d_cache, out = draft_chunk(
-                draft_params, d_cache, jnp.asarray([[tok]], jnp.int32))
-            tok = int(np.asarray(out)[0, -1])
-            drafts.append(tok)
+        if stochastic:
+            # --- Sample k proposals from the warped draft dist ---
+            rng, uni_rng, extra_rng, *step_rngs = jax.random.split(
+                rng, k + 3)
+            tok = jnp.asarray([[seq[-1]]], jnp.int32)
+            toks, warps = [], []
+            for i in range(k):
+                d_cache, nxt, warped = draft_step(
+                    draft_params, d_cache, tok, step_rngs[i])
+                toks.append(nxt)
+                warps.append(warped)
+                tok = nxt[:, None]
+            d_tokens = jnp.concatenate(toks)         # [k]
+            q_warped = jnp.concatenate(warps)        # [k, V]
 
-        # --- Verify all k in ONE target forward over k+1 tokens ---
-        verify_in = jnp.asarray([[seq[-1]] + drafts], jnp.int32)
-        t_cache, greedy = target_chunk(params, t_cache, verify_in)
-        greedy = np.asarray(greedy)[0]  # g[i] = target token after d_i
+            # --- One target forward + on-device accept/reject ---
+            verify_in = jnp.concatenate(
+                [jnp.asarray([[seq[-1]]], jnp.int32),
+                 d_tokens[None, :]], axis=1)
+            uniforms = jax.random.uniform(uni_rng, (k,))
+            t_cache, n_acc, extra = verify_step(
+                params, t_cache, verify_in, q_warped, d_tokens,
+                uniforms, extra_rng)
+            accepted = int(np.asarray(n_acc))
+            drafts = [int(t) for t in np.asarray(d_tokens)]
+            committed = drafts[:accepted] + [int(np.asarray(extra))]
+        else:
+            # --- Draft k greedy proposals, one cheap step at a time
+            drafts = []
+            tok = seq[-1]
+            for _ in range(k):
+                d_cache, out = draft_chunk(
+                    draft_params, d_cache,
+                    jnp.asarray([[tok]], jnp.int32))
+                tok = int(np.asarray(out)[0, -1])
+                drafts.append(tok)
 
-        accepted = 0
-        while accepted < k and drafts[accepted] == int(greedy[accepted]):
-            accepted += 1
-        committed = drafts[:accepted] + [int(greedy[accepted])]
+            # --- Verify all k in ONE target forward over k+1 tokens
+            verify_in = jnp.asarray([[seq[-1]] + drafts], jnp.int32)
+            t_cache, greedy = target_chunk(params, t_cache, verify_in)
+            greedy = np.asarray(greedy)[0]  # g[i] = token after d_i
+
+            accepted = 0
+            while (accepted < k
+                   and drafts[accepted] == int(greedy[accepted])):
+                accepted += 1
+            committed = drafts[:accepted] + [int(greedy[accepted])]
+
+        stats["rounds"] += 1
+        stats["proposed"] += k
+        stats["accepted_drafts"] += accepted
 
         # --- Restore the invariant ---
         # Both caches must end holding entries for seq[:-1] after the
@@ -203,7 +380,7 @@ def generate_speculative(model, params, draft_model, draft_params,
     seq = seq[:total]
     if eos_token is not None and len(seq) < total:
         seq = seq + [eos_token] * (total - len(seq))
-    return jnp.asarray([seq], jnp.int32)
+    return finish(jnp.asarray([seq], jnp.int32))
 
 
 __all__ = ["generate_speculative"]

@@ -413,28 +413,15 @@ def _decode_fns(decoder, temperature, top_k, top_p, eos_token):
 
     def sample(logits, rng):
         logits = logits.astype(jnp.float32)
-        if top_k is not None:
-            # O(V log k), not a full vocab sort per decode step.
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
-            logits = jnp.where(logits < kth, -1e30, logits)
         if not temperature:
+            # top-k/top-p never change the argmax; greedy skips them.
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / temperature
-        if top_p is not None and top_p < 1.0:
-            # Nucleus: keep the smallest top-probability set whose
-            # cumulative mass reaches top_p. `cum - probs < top_p`
-            # keeps every token whose EXCLUSIVE prefix mass is below
-            # the threshold — i.e. the set up to and including the
-            # first token that crosses it, so at least one survives.
-            sorted_scaled = jnp.flip(jnp.sort(scaled, axis=-1), -1)
-            probs = jax.nn.softmax(sorted_scaled, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep = (cum - probs) < top_p
-            cutoff = jnp.min(
-                jnp.where(keep, sorted_scaled, jnp.inf),
-                axis=-1, keepdims=True)
-            scaled = jnp.where(scaled < cutoff, -1e30, scaled)
-        return jax.random.categorical(rng, scaled,
+        # Shared warper (models/decoding.py): top-k → temperature →
+        # top-p with sorted-order nucleus membership, the exact
+        # distribution the speculative accept/reject math targets.
+        from cloud_tpu.models.decoding import warp_logits
+        warped = warp_logits(logits, temperature, top_k, top_p)
+        return jax.random.categorical(rng, warped,
                                       axis=-1).astype(jnp.int32)
 
     @jax.jit

@@ -359,3 +359,27 @@ class TestDeepseekAuxLoss:
         _, zstate = lm.apply(zeroed, tokens, mutable=["losses"])
         zl = jax.tree_util.tree_leaves(zstate["losses"])
         assert all(abs(float(l) - 2.0) < 1e-5 for l in zl)
+
+
+class TestV2NormTopkContested:
+    def test_v2_norm_topk_prob_true_rejected_loudly(self, transformers,
+                                                    torch):
+        """norm_topk_prob=true on V2 is contested between the HF port
+        (ignores it) and DeepSeek's own modeling (honors it); no
+        shipped checkpoint sets it, so the importer must refuse
+        instead of silently picking a side."""
+        config = transformers.DeepseekV2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=24, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4,
+            q_lora_rank=24, kv_lora_rank=16,
+            qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+            n_routed_experts=8, num_experts_per_tok=2,
+            norm_topk_prob=True, n_shared_experts=1,
+            first_k_dense_replace=1, max_position_embeddings=32,
+            pad_token_id=0, bos_token_id=1, eos_token_id=2,
+            tie_word_embeddings=False, attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.DeepseekV2ForCausalLM(config).eval()
+        with pytest.raises(NotImplementedError, match="norm_topk_prob"):
+            import_hf_deepseek(hf)

@@ -624,3 +624,71 @@ class TestHFImport:
         with pytest.raises(NotImplementedError,
                            match="scale_attn_weights"):
             import_hf_gpt2(hf)
+
+
+class TestSlidingWindowGate:
+    """Raw-dict configs must honor the Qwen2/Qwen3 use_sliding_window
+    gate exactly as the HF config object would (configuration_qwen2.py
+    nulls sliding_window unless the gate is on, default OFF); families
+    without the gate (mistral) keep the window."""
+
+    def _qwen_dict_import(self, transformers, torch, **cfg_overrides):
+        config = transformers.Qwen2Config(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            tie_word_embeddings=False, attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.Qwen2ForCausalLM(config).eval()
+        raw = {
+            "model_type": "qwen2", "vocab_size": 64, "hidden_size": 32,
+            "intermediate_size": 64, "num_hidden_layers": 4,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+            "max_position_embeddings": 64, "rope_theta": 10000.0,
+            "rms_norm_eps": 1e-6, "tie_word_embeddings": False,
+        }
+        raw.update(cfg_overrides)
+        return import_hf_llama(state_dict=hf.state_dict(), config=raw,
+                               compute_dtype=jnp.float32)
+
+    def test_gate_absent_defaults_off_for_qwen(self, transformers,
+                                               torch):
+        lm, _ = self._qwen_dict_import(transformers, torch,
+                                       sliding_window=4)
+        assert lm.sliding_window is None
+
+    def test_gate_false_drops_window(self, transformers, torch):
+        lm, _ = self._qwen_dict_import(transformers, torch,
+                                       sliding_window=4,
+                                       use_sliding_window=False)
+        assert lm.sliding_window is None
+
+    def test_gate_true_bands_from_max_window_layers(self, transformers,
+                                                    torch):
+        lm, _ = self._qwen_dict_import(transformers, torch,
+                                       sliding_window=4,
+                                       use_sliding_window=True,
+                                       max_window_layers=2)
+        assert lm.sliding_window == 4
+        assert lm.attn_kinds == ("global", "global", "local", "local")
+
+    def test_ungated_family_dict_keeps_window(self, transformers,
+                                              torch):
+        config = transformers.MistralConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            tie_word_embeddings=False, attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.MistralForCausalLM(config).eval()
+        raw = {
+            "model_type": "mistral", "vocab_size": 64,
+            "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "max_position_embeddings": 64,
+            "rope_theta": 10000.0, "rms_norm_eps": 1e-6,
+            "tie_word_embeddings": False, "sliding_window": 4,
+        }
+        lm, _ = import_hf_llama(state_dict=hf.state_dict(), config=raw,
+                                compute_dtype=jnp.float32)
+        assert lm.sliding_window == 4

@@ -245,8 +245,9 @@ class TestNativeReleaseBuild:
         # every C++ source (a full configure+build per pytest run would
         # duplicate the Makefile leg); otherwise build into tmp_path.
         prebuilt = os.path.join(src, "build_rel", "monitoring_test")
-        sources = glob.glob(os.path.join(src, "*.cc")) + glob.glob(
-            os.path.join(src, "*.h"))
+        sources = (glob.glob(os.path.join(src, "*.cc"))
+                   + glob.glob(os.path.join(src, "*.h"))
+                   + [os.path.join(src, "CMakeLists.txt")])
         if (os.path.exists(prebuilt) and os.path.getmtime(prebuilt) >
                 max(os.path.getmtime(p) for p in sources)):
             binary = prebuilt
