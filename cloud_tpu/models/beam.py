@@ -1,19 +1,34 @@
 """Beam search decoding over the slot-addressed KV caches.
 
-Single-stream beam search (`beam_width` hypotheses) for any
+Batched beam search (`B` prompts × `beam_width` hypotheses) for any
 decode-capable model (`TransformerLM`, `LlamaLM`, `DeepseekLM`): the
-beam rides the BATCH dimension of one decode cache, so each step is a
-single [W, 1] forward, and beam reordering is a gather on the leading
-axis of every cache leaf (the caches are batch-first throughout —
-models/decoding.py). Scoring is accumulated log-probability with
-optional length normalization (score / length**length_penalty, the
-standard GNMT-style alpha). Finished hypotheses (eos) are frozen: their
-row keeps re-feeding eos with score held fixed, so the [W] scan shape
-never changes.
+B×W hypothesis grid rides the BATCH dimension of one decode cache
+(row-major: prompt b, beam w → row b*W + w), so each step is a single
+[B*W, 1] forward, and beam reordering is a gather on the leading axis
+of every cache leaf (the caches are batch-first throughout —
+models/decoding.py). Variable-length prompt batches use the same
+left-padded `prompt_mask` contract as `generate()`: each row's beams
+expand exactly as that prompt's solo beam search would.
 
-`beam_width=1` reduces exactly to greedy decoding (tested), and with a
-beam wide enough to cover every alive prefix the search is exhaustive
-(tested against brute force on a tiny vocabulary).
+Ranking runs ON DEVICE: per prompt, `jax.lax.top_k` over the [W*V]
+candidate scores — only the [B, W] winners (score, source row, token)
+travel to host per step, not the whole [B*W, V] log-prob matrix (a
+128k-vocab imported checkpoint would otherwise pay an O(W·V log W·V)
+host sort plus the transfer every token).
+
+Scoring is accumulated log-probability with optional length
+normalization (score / length**length_penalty, the standard GNMT-style
+alpha). Scores accumulate in float32 ON DEVICE (TPUs have no f64;
+keeping the ranking on device is the point) — two hypotheses whose
+true summed log-probs differ by less than f32 resolution at the
+accumulated magnitude can rank either way, the same tolerance every
+TPU decode stack accepts. Finished hypotheses (eos) are frozen: their row keeps
+re-feeding eos with score held fixed, so shapes never change.
+
+`beam_width=1` reduces exactly to greedy decoding (tested), a padded
+batch row matches its solo beam search (tested), and with a beam wide
+enough to cover every alive prefix the search is exhaustive (tested
+against brute force on a tiny vocabulary).
 """
 
 import functools
@@ -22,18 +37,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cloud_tpu.models.decoding import empty_cache
+from cloud_tpu.models.decoding import empty_cache, validate_prompt_mask
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
 
 
 @functools.lru_cache(maxsize=64)
 def _logprob_fn(decoder):
-    """Jitted chunk feed returning (new_cache, log-probs [W, V])."""
+    """Jitted chunk feed returning (new_cache, log-probs [rows, V])."""
 
     @jax.jit
-    def step(params, cache, tokens):
+    def step(params, cache, tokens, mask=None):
         logits, vars_ = decoder.apply(
-            {"params": params, "cache": cache}, tokens,
+            {"params": params, "cache": cache}, tokens, mask,
             mutable=["cache"])
         logp = jax.nn.log_softmax(
             logits[:, -1].astype(jnp.float32), axis=-1)
@@ -42,14 +57,44 @@ def _logprob_fn(decoder):
     return step
 
 
+@functools.lru_cache(maxsize=64)
+def _rank_fn(width, eos_token):
+    """Jitted per-prompt beam ranking: candidate scores, frozen-row
+    handling, and lax.top_k — all on device."""
+
+    @jax.jit
+    def rank(scores, logp, finished):
+        # scores/finished [B, W]; logp [B*W, V].
+        b = scores.shape[0]
+        vocab = logp.shape[-1]
+        cand = scores[:, :, None] + logp.reshape(b, width, vocab)
+        if eos_token is not None:
+            # A frozen row contributes exactly one continuation (eos,
+            # score unchanged) so it survives ranking without forking.
+            frozen = jnp.full((vocab,), -jnp.inf,
+                              jnp.float32).at[eos_token].set(0.0)
+            cand = jnp.where(finished[:, :, None],
+                             scores[:, :, None] + frozen[None, None, :],
+                             cand)
+        top_scores, flat = jax.lax.top_k(cand.reshape(b, width * vocab),
+                                         width)
+        rows, toks = flat // vocab, flat % vocab
+        new_finished = jnp.take_along_axis(finished, rows, axis=1)
+        if eos_token is not None:
+            new_finished = new_finished | (toks == eos_token)
+        return top_scores, rows, toks.astype(jnp.int32), new_finished
+
+    return rank
+
+
 def _reorder(cache, order):
-    """Gather beam rows: every batch-first cache leaf follows the
+    """Gather hypothesis rows: every batch-first cache leaf follows the
     surviving hypotheses; scalars (the shared write pointer) pass
     through."""
-    width = order.shape[0]
+    rows = order.shape[0]
 
     def pick(leaf):
-        if leaf.ndim and leaf.shape[0] == width:
+        if leaf.ndim and leaf.shape[0] == rows:
             return leaf[order]
         return leaf
 
@@ -57,16 +102,16 @@ def _reorder(cache, order):
 
 
 def generate_beam(model, params, prompt, max_new_tokens, beam_width=4,
-                  length_penalty=0.0, eos_token=None):
-    """Beam-search decode; returns the best hypothesis.
+                  length_penalty=0.0, eos_token=None, prompt_mask=None):
+    """Beam-search decode; returns the best hypothesis per prompt.
 
     Args:
         model / params: a decode-capable model (same contract as
             `generate`).
-        prompt: [1, S] int32 (single stream; the beam occupies the
-            batch dimension internally).
+        prompt: [B, S] int32 — every row runs its own `beam_width`-wide
+            search in one shared forward/ranking pipeline.
         max_new_tokens: tokens to generate beyond the prompt.
-        beam_width: hypotheses kept per step.
+        beam_width: hypotheses kept per prompt per step.
         length_penalty: 0.0 = raw summed log-prob; alpha > 0 divides
             each hypothesis' score by (generated_length ** alpha) when
             ranking FINAL hypotheses. In-loop pruning compares RAW
@@ -76,16 +121,17 @@ def generate_beam(model, params, prompt, max_new_tokens, beam_width=4,
             normalization can be pruned mid-loop.
         eos_token: optional stop token; a hypothesis sampling it is
             frozen and its tail is filled with eos_token.
+        prompt_mask: optional [B, S] bool marking REAL prompt tokens,
+            LEFT-padded (`generate()`'s variable-length contract):
+            each row's search behaves exactly as its unpadded solo
+            search would.
 
     Returns:
-        ([1, S + max_new_tokens] int32 best sequence,
-         float final score of that sequence).
+        ([B, S + max_new_tokens] int32 best sequences,
+         score) — `score` is a float for B == 1 (back-compat) and a
+         [B] float numpy array otherwise.
     """
     batch, prompt_len = prompt.shape
-    if batch != 1:
-        raise ValueError(
-            "generate_beam is single-stream (batch 1); the beam rides "
-            "the batch dimension. Got batch={}.".format(batch))
     if beam_width < 1:
         raise ValueError("beam_width must be >= 1; got {}.".format(
             beam_width))
@@ -93,7 +139,7 @@ def generate_beam(model, params, prompt, max_new_tokens, beam_width=4,
         raise ValueError("max_new_tokens must be >= 0; got {}.".format(
             max_new_tokens))
     if max_new_tokens == 0:
-        return prompt, 0.0
+        return prompt, (0.0 if batch == 1 else np.zeros(batch))
     if model.attention_impl in SEQUENCE_PARALLEL_IMPLS:
         raise NotImplementedError(
             "generate_beam decodes on a single mesh shard; use a "
@@ -103,76 +149,92 @@ def generate_beam(model, params, prompt, max_new_tokens, beam_width=4,
         raise ValueError(
             "prompt ({}) + max_new_tokens ({}) exceeds max_seq_len {}."
             .format(prompt_len, max_new_tokens, model.max_seq_len))
+    if prompt_mask is not None:
+        validate_prompt_mask(prompt_mask, batch, prompt_len,
+                             "beam ranking")
 
     width = int(beam_width)
     decoder = model.clone(decode=True, dropout_rate=0.0)
     step = _logprob_fn(decoder)
+    rank = _rank_fn(width, None if eos_token is None else int(eos_token))
 
-    # Prefill ONCE at batch 1, then tile the cache to the beam width:
-    # the W rows would be byte-identical, so W prompt forwards would
-    # buy nothing (the scalar write pointer passes through the tile
-    # exactly as it passes through _reorder's gather).
-    cache1, logp = step(params, empty_cache(decoder, 1), prompt)
+    # Prefill ONCE at batch B, then tile each prompt's cache rows to
+    # the beam width (jnp.repeat keeps the b*W + w row-major layout):
+    # the W copies would be byte-identical, so B*W prompt forwards
+    # would buy nothing. Per-example bookkeeping (slot_valid,
+    # token_count) repeats with its prompt; the scalar write pointer
+    # passes through exactly as it passes through _reorder's gather.
+    mask_arg = (None if prompt_mask is None
+                else jnp.asarray(prompt_mask, bool))
+    cache_b, logp = step(params, empty_cache(decoder, batch), prompt,
+                         mask_arg)
     cache = jax.tree_util.tree_map(
-        lambda leaf: (jnp.broadcast_to(
-            leaf, (width,) + leaf.shape[1:])
-            if leaf.ndim and leaf.shape[0] == 1 else leaf),
-        cache1)
-    logp0 = np.asarray(logp)[0]
-    vocab = logp0.shape[-1]
-    # width > vocab (the exhaustive-search configuration): only vocab
-    # distinct first expansions exist; surplus rows duplicate the best
-    # one at -inf so they can never win a ranking.
-    first = np.argsort(-logp0)[:min(width, vocab)]
-    scores = logp0[first].astype(np.float64)
+        lambda leaf: (jnp.repeat(leaf, width, axis=0)
+                      if leaf.ndim and leaf.shape[0] == batch else leaf),
+        cache_b)
+
+    vocab = logp.shape[-1]
+    # First expansion: top width tokens per prompt. width > vocab (the
+    # exhaustive-search configuration): only vocab distinct first
+    # expansions exist; surplus rows duplicate the best one at -inf so
+    # they can never win a ranking.
+    s0, t0 = jax.lax.top_k(logp, min(width, vocab))
+    s0 = np.asarray(s0, np.float32)
+    t0 = np.asarray(t0)
     if width > vocab:
         pad = width - vocab
-        first = np.concatenate([first, np.repeat(first[:1], pad)])
-        scores = np.concatenate([scores, np.full(pad, -np.inf)])
-    seqs = [[int(t)] for t in first]
-    finished = np.array(
-        [eos_token is not None and t == eos_token for t in first])
+        t0 = np.concatenate([t0, np.repeat(t0[:, :1], pad, axis=1)], 1)
+        s0 = np.concatenate(
+            [s0, np.full((batch, pad), -np.inf, np.float32)], 1)
+    scores = jnp.asarray(s0)                                 # [B, W]
+    seqs = [[[int(t)] for t in t0[b]] for b in range(batch)]
+    fin_host = np.array([[eos_token is not None and t == eos_token
+                          for t in t0[b]] for b in range(batch)])
+    finished = jnp.asarray(fin_host)
+    feed = jnp.asarray(t0.reshape(-1, 1), jnp.int32)         # [B*W, 1]
 
     for _ in range(max_new_tokens - 1):
-        if finished.all():
+        if fin_host.all():
             break
-        feed = jnp.asarray([[s[-1]] for s in seqs], jnp.int32)
-        cache, logp = step(params, cache, feed)
-        logp = np.asarray(logp).astype(np.float64)  # [W, V]
-        # Frozen rows contribute exactly one continuation (eos, no
-        # score change) so they survive ranking without forking.
-        cand = scores[:, None] + logp
-        for w in range(width):
-            if finished[w]:
-                cand[w, :] = -np.inf
-                cand[w, eos_token] = scores[w]
-        flat = np.argsort(-cand.reshape(-1))[:width]
-        rows, toks = flat // vocab, flat % vocab
-        scores = cand.reshape(-1)[flat]
-        seqs = [seqs[r] + [int(t)] for r, t in zip(rows, toks)]
-        finished = np.array(
-            [finished[r]
-             or (eos_token is not None and t == eos_token)
-             for r, t in zip(rows, toks)])
-        cache = _reorder(cache, jnp.asarray(rows, jnp.int32))
+        cache, logp = step(params, cache, feed, None)
+        scores, rows, toks, finished = rank(scores, logp, finished)
+        # The only per-step device→host traffic: [B, W] winners.
+        rows_h, toks_h, fin_host = jax.device_get(
+            (rows, toks, finished))
+        seqs = [[seqs[b][r] + [int(t)]
+                 for r, t in zip(rows_h[b], toks_h[b])]
+                for b in range(batch)]
+        order = (np.arange(batch)[:, None] * width + rows_h).reshape(-1)
+        cache = _reorder(cache, jnp.asarray(order, jnp.int32))
+        feed = toks.reshape(-1, 1)
 
-    def final_score(w):
+    scores_h = np.asarray(jax.device_get(scores), np.float64)  # [B, W]
+
+    def final_score(b, w):
         if length_penalty:
-            n = len(seqs[w])
-            if eos_token is not None and eos_token in seqs[w]:
-                n = seqs[w].index(eos_token) + 1
-            return scores[w] / (n ** length_penalty)
-        return scores[w]
+            n = len(seqs[b][w])
+            if eos_token is not None and eos_token in seqs[b][w]:
+                n = seqs[b][w].index(eos_token) + 1
+            return scores_h[b, w] / (n ** length_penalty)
+        return scores_h[b, w]
 
-    best = max(range(width), key=final_score)
-    out = seqs[best]
-    if eos_token is not None and eos_token in out:
-        cut = out.index(eos_token) + 1
-        out = out[:cut] + [eos_token] * (len(out) - cut)
-    full = [int(t) for t in np.asarray(prompt)[0]] + out
-    if len(full) < total:  # early all-finished exit
-        full = full + [eos_token] * (total - len(full))
-    return jnp.asarray([full], jnp.int32), float(final_score(best))
+    prompt_h = np.asarray(prompt)
+    full_rows, best_scores = [], []
+    for b in range(batch):
+        best = max(range(width), key=lambda w: final_score(b, w))
+        out = seqs[b][best]
+        if eos_token is not None and eos_token in out:
+            cut = out.index(eos_token) + 1
+            out = out[:cut] + [eos_token] * (len(out) - cut)
+        row = [int(t) for t in prompt_h[b]] + out
+        if len(row) < total:  # early all-finished exit
+            row = row + [eos_token] * (total - len(row))
+        full_rows.append(row)
+        best_scores.append(float(final_score(b, best)))
+    tokens = jnp.asarray(full_rows, jnp.int32)
+    if batch == 1:
+        return tokens, best_scores[0]
+    return tokens, np.asarray(best_scores)
 
 
 __all__ = ["generate_beam"]

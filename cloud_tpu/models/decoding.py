@@ -70,6 +70,24 @@ def decode_slot_update(module, mask, batch, seq, cache_len):
     return idx, positions, allowed
 
 
+def validate_prompt_mask(prompt_mask, batch, prompt_len, reader):
+    """The left-padded variable-length prompt contract, checked ONCE
+    for every decode entry point (`generate`, `generate_beam`):
+    prompt_mask is [batch, prompt_len] with every row's LAST column
+    real — the position whose logits/log-probs `reader` consumes."""
+    import numpy as np
+
+    pm = np.asarray(prompt_mask)
+    if pm.shape != (batch, prompt_len):
+        raise ValueError(
+            "prompt_mask must be [batch, prompt_len] = {}; got "
+            "{}.".format((batch, prompt_len), pm.shape))
+    if not pm[:, -1].all():
+        raise ValueError(
+            "prompt_mask must be LEFT-padded (last column all real): "
+            "{} reads the final prompt position.".format(reader))
+
+
 def warp_logits(logits, temperature, top_k=None, top_p=None):
     """HF-warper-order logits processing: top-k (on raw logits) →
     temperature → top-p nucleus. Shared by `generate()`'s sampler and
@@ -118,4 +136,5 @@ def empty_cache(decoder, batch):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
-__all__ = ["decode_slot_update", "empty_cache", "warp_logits"]
+__all__ = ["decode_slot_update", "empty_cache", "validate_prompt_mask",
+           "warp_logits"]

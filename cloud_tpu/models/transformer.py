@@ -362,16 +362,8 @@ def generate(model,
         raise ValueError(
             "top_p must be in (0, 1]; got {}.".format(top_p))
     if prompt_mask is not None:
-        pm = np.asarray(prompt_mask)
-        if pm.shape != (batch, prompt_len):
-            raise ValueError(
-                "prompt_mask must be [batch, prompt_len] = {}; got "
-                "{}.".format((batch, prompt_len), pm.shape))
-        if not pm[:, -1].all():
-            raise ValueError(
-                "prompt_mask must be LEFT-padded (last column all "
-                "real): sampling reads the logits at the final prompt "
-                "position.")
+        from cloud_tpu.models.decoding import validate_prompt_mask
+        validate_prompt_mask(prompt_mask, batch, prompt_len, "sampling")
     if rng is None:
         rng = jax.random.PRNGKey(0)
 

@@ -15,11 +15,15 @@ import sys
 
 import jax
 
-# Each process contributes 4 virtual CPU devices -> 8-device global mesh.
-# The site hook pins JAX_PLATFORMS to the TPU tunnel, so the CPU switch
-# must be a config update, not an env var.
+# Each process contributes CLOUD_TPU_TEST_LOCAL_DEVICES virtual CPU
+# devices (default 4 -> the 2-process x 4 = 8-device pod; the 4-process
+# test runs 4 x 2 = same 8-device global mesh over twice the process
+# grid). The site hook pins JAX_PLATFORMS to the TPU tunnel, so the CPU
+# switch must be a config update, not an env var.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+jax.config.update("jax_num_cpu_devices",
+                  int(os.environ.get("CLOUD_TPU_TEST_LOCAL_DEVICES",
+                                     "4")))
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))

@@ -454,7 +454,64 @@ class TestBeamSearch:
         model = _model()
         prompt = _prompt()
         params = _params(model, prompt)
-        with pytest.raises(ValueError, match="batch"):
-            generate_beam(model, params, prompt, 4)
         with pytest.raises(ValueError, match="beam_width"):
             generate_beam(model, params, prompt[:1], 4, beam_width=0)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            generate_beam(model, params, prompt[:1], -1)
+        with pytest.raises(ValueError, match="LEFT-padded"):
+            bad = np.ones((1, prompt.shape[1]), bool)
+            bad[0, -1] = False
+            generate_beam(model, params, prompt[:1], 4,
+                          prompt_mask=jnp.asarray(bad))
+
+    def test_batched_rows_match_solo_beams(self):
+        """B prompts × W beams in one search: every row must equal its
+        own solo beam search (tokens exactly, score to float noise)."""
+        from cloud_tpu.models import generate_beam
+        model = _model()
+        prompt = _prompt(b=3)
+        params = _params(model, prompt)
+        out, scores = generate_beam(model, params, prompt, 8,
+                                    beam_width=4, length_penalty=0.6,
+                                    eos_token=3)
+        assert out.shape == (3, prompt.shape[1] + 8)
+        assert scores.shape == (3,)
+        for b in range(3):
+            solo, solo_score = generate_beam(
+                model, params, prompt[b:b + 1], 8, beam_width=4,
+                length_penalty=0.6, eos_token=3)
+            np.testing.assert_array_equal(np.asarray(out)[b],
+                                          np.asarray(solo)[0],
+                                          err_msg="row {}".format(b))
+            assert abs(scores[b] - solo_score) < 1e-4
+
+    def test_left_padded_batch_matches_solo_beams(self):
+        """Variable-length prompts, left-padded with prompt_mask: each
+        row's beam search must match its unpadded solo search — the
+        same oracle as generate()'s padded-vs-solo cases."""
+        from cloud_tpu.models import generate_beam
+        model = _model()
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 4), jnp.int32))["params"]
+        rng = np.random.default_rng(0)
+        lengths, new = (3, 7), 6
+        prompts = [rng.integers(0, model.vocab_size, size=n)
+                   for n in lengths]
+        S = max(lengths)
+        batch = np.zeros((len(lengths), S), np.int32)
+        mask = np.zeros((len(lengths), S), bool)
+        for b, p in enumerate(prompts):
+            batch[b, S - len(p):] = p
+            mask[b, S - len(p):] = True
+        out, scores = generate_beam(model, params, jnp.asarray(batch),
+                                    new, beam_width=3,
+                                    prompt_mask=jnp.asarray(mask))
+        gen = np.asarray(out)[:, S:]
+        for b, p in enumerate(prompts):
+            solo, solo_score = generate_beam(
+                model, params, jnp.asarray(p[None, :], jnp.int32), new,
+                beam_width=3)
+            np.testing.assert_array_equal(
+                gen[b], np.asarray(solo)[0, len(p):],
+                err_msg="row {} (len {})".format(b, len(p)))
+            assert abs(scores[b] - solo_score) < 1e-4
