@@ -114,7 +114,12 @@ def warp_logits(logits, temperature, top_k=None, top_p=None):
         # EXCLUSIVE prefix mass is below the threshold — the set up to
         # and including the first token that crosses it, so at least
         # one always survives.
-        sort_idx = jnp.argsort(-scaled, axis=-1)
+        # Descending order as HF's ascending stable sort, flipped:
+        # among EXACT logit ties the higher vocab index outranks the
+        # lower (TopPLogitsWarper removes the ascending prefix, so the
+        # low-index tie is dropped first) — verified identical keep
+        # sets against the torch warper incl. forced ties.
+        sort_idx = jnp.flip(jnp.argsort(scaled, axis=-1), -1)
         sorted_scaled = jnp.take_along_axis(scaled, sort_idx, axis=-1)
         probs = jax.nn.softmax(sorted_scaled, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)

@@ -515,3 +515,83 @@ class TestBeamSearch:
                 gen[b], np.asarray(solo)[0, len(p):],
                 err_msg="row {} (len {})".format(b, len(p)))
             assert abs(scores[b] - solo_score) < 1e-4
+
+
+class TestWarpHFParity:
+    """warp_logits vs the transformers warpers: identical keep sets,
+    including EXACT logit ties at the nucleus cutoff (the sorted-order
+    scatter semantics — a value threshold would keep both tied tokens;
+    HF drops the lower vocab index first)."""
+
+    def test_keep_sets_match_torch_warpers_with_ties(self):
+        torch = pytest.importorskip("torch")
+        lp = pytest.importorskip("transformers.generation.logits_process")
+        from cloud_tpu.models.decoding import warp_logits
+
+        rng = np.random.default_rng(0)
+        temp, top_p, top_k = 0.9, 0.7, 10
+        for trial in range(100):
+            V = 16
+            logits = rng.normal(size=(1, V)).astype(np.float32)
+            ties = rng.choice(V, size=4, replace=False)
+            logits[0, ties[1]] = logits[0, ties[0]]
+            logits[0, ties[3]] = logits[0, ties[2]]
+            t = torch.tensor(logits)
+            t = lp.TemperatureLogitsWarper(temp)(None, t)
+            t = lp.TopKLogitsWarper(top_k)(None, t)
+            t = lp.TopPLogitsWarper(top_p)(None, t)
+            hf_keep = (torch.isfinite(t[0]).numpy()
+                       & (t[0] > -1e30).numpy())
+            ours = np.asarray(
+                warp_logits(jnp.asarray(logits), temp, top_k, top_p))
+            np.testing.assert_array_equal(
+                ours[0] > -1e29, hf_keep, err_msg="trial {}".format(trial))
+
+
+class TestTensorParallelDecode:
+    """Decoding with Megatron tp-sharded params under a mesh: the
+    jitted prefill/decode executables take the params' NamedShardings
+    as-is and GSPMD inserts the per-block collectives — tokens must be
+    identical to replicated decode. (Serving-side tensor parallelism:
+    no resharding, no code path of its own.)"""
+
+    def _mesh(self):
+        from jax.sharding import Mesh
+        devs = np.array(jax.devices())
+        if devs.size < 8:
+            pytest.skip("needs 8 virtual devices")
+        return Mesh(devs[:8].reshape(4, 2), ("dp", "tp"))
+
+    def _sharded(self, model, params, mesh):
+        from cloud_tpu.models import tensor_parallel_rules
+        from cloud_tpu.parallel import sharding as shlib
+        specs = shlib.param_sharding(
+            params, rules=tensor_parallel_rules("tp"), mesh=mesh)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), params, specs)
+
+    def test_generate_matches_replicated(self):
+        model = _model(num_heads=4)
+        prompt = _prompt()
+        params = _params(model, prompt)
+        ref = generate(model, params, prompt, 6, temperature=0.0)
+        mesh = self._mesh()
+        with mesh:
+            out = generate(model, self._sharded(model, params, mesh),
+                           prompt, 6, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_beam_matches_replicated(self):
+        from cloud_tpu.models import generate_beam
+        model = _model(num_heads=4)
+        prompt = _prompt(b=1)
+        params = _params(model, prompt)
+        ref, ref_score = generate_beam(model, params, prompt, 5,
+                                       beam_width=3)
+        mesh = self._mesh()
+        with mesh:
+            out, score = generate_beam(
+                model, self._sharded(model, params, mesh), prompt, 5,
+                beam_width=3)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        assert abs(score - ref_score) < 1e-4
