@@ -1,0 +1,122 @@
+"""Every decoding entry point on one tiny trained LM.
+
+Trains a small `TransformerLM` on a synthetic copy task (the model
+learns to echo a repeating pattern, so decode quality is checkable),
+then decodes with the full inference surface:
+
+- `generate`: greedy, then temperature/top-k/top-p sampling, then a
+  left-padded variable-length batch (`prompt_mask`).
+- `generate_beam`: batched beam search (B prompts x W beams on the
+  cache batch dimension, on-device ranking).
+- `generate_speculative`: a 1-layer draft proposing for the trained
+  target — greedy (token-identical to the target's greedy decode) and
+  stochastic (Leviathan accept/reject; prints the acceptance rate).
+
+Run: python examples/text_generation.py
+(sizes are module constants so the example tests can shrink them).
+"""
+
+import numpy as np
+
+SEQ_LEN = 48
+VOCAB = 32
+EPOCHS = 25
+DRAFT_EPOCHS = 6
+PATTERN = 7  # the copy task's period
+
+
+def _dataset(rng, n=512):
+    """Sequences that repeat a random PATTERN-length motif: the LM can
+    learn next-token prediction almost perfectly, so greedy decode is
+    checkable against the motif."""
+    x = np.zeros((n, SEQ_LEN), np.int32)
+    for i in range(n):
+        motif = rng.integers(1, VOCAB, size=PATTERN)
+        x[i] = np.tile(motif, SEQ_LEN // PATTERN + 1)[:SEQ_LEN]
+    return x
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from cloud_tpu.models import (TransformerLM, generate,
+                                  generate_beam, generate_speculative)
+    from cloud_tpu.training import Trainer
+
+    rng = np.random.default_rng(0)
+    data = _dataset(rng)
+    inputs, targets = data[:, :-1], data[:, 1:]
+
+    target = TransformerLM(vocab_size=VOCAB, num_layers=2, num_heads=4,
+                           d_model=64, d_ff=128, max_seq_len=SEQ_LEN,
+                           compute_dtype=jnp.float32)
+
+    def lm_loss(logits, labels):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean(axis=-1)
+
+    trainer = Trainer(target, optimizer=optax.adam(1e-3), loss=lm_loss,
+                      metrics=())
+    history = trainer.fit(inputs, targets, epochs=EPOCHS,
+                          batch_size=64, verbose=False)
+    params = jax.device_get(trainer.state.params)
+    print("final loss: {:.4f}".format(history["loss"][-1]))
+
+    prompt = jnp.asarray(data[:1, :PATTERN * 2], jnp.int32)
+    new = PATTERN * 2
+
+    greedy = generate(target, params, prompt, new, temperature=0.0)
+    print("greedy continuation:", np.asarray(greedy)[0, prompt.shape[1]:])
+
+    sampled = generate(target, params, prompt, new,
+                       rng=jax.random.PRNGKey(1), temperature=0.7,
+                       top_k=8, top_p=0.95)
+    print("sampled continuation:",
+          np.asarray(sampled)[0, prompt.shape[1]:])
+
+    # Variable-length batch: left-pad a shorter prompt beside a longer
+    # one; each row generates exactly as it would alone.
+    s = prompt.shape[1]
+    batch = np.zeros((2, s), np.int32)
+    mask = np.zeros((2, s), bool)
+    batch[0], mask[0] = np.asarray(prompt)[0], True
+    short = np.asarray(prompt)[0, :PATTERN]
+    batch[1, s - PATTERN:], mask[1, s - PATTERN:] = short, True
+    padded = generate(target, params, jnp.asarray(batch), new,
+                      temperature=0.0, prompt_mask=jnp.asarray(mask))
+    print("padded-batch rows:", np.asarray(padded)[:, s:])
+
+    beams, scores = generate_beam(target, params, jnp.asarray(batch),
+                                  new, beam_width=4,
+                                  prompt_mask=jnp.asarray(mask))
+    print("beam rows:", np.asarray(beams)[:, s:],
+          "scores:", np.round(np.asarray(scores), 3))
+
+    # A briefly-trained 1-layer draft: the realistic speculative setup
+    # (a random draft would propose near-uniformly and the trained
+    # target would reject almost everything).
+    draft = TransformerLM(vocab_size=VOCAB, num_layers=1, num_heads=4,
+                          d_model=64, d_ff=128, max_seq_len=SEQ_LEN,
+                          compute_dtype=jnp.float32)
+    draft_trainer = Trainer(draft, optimizer=optax.adam(1e-3),
+                            loss=lm_loss, metrics=())
+    draft_trainer.fit(inputs, targets, epochs=DRAFT_EPOCHS,
+                      batch_size=64, verbose=False)
+    draft_params = jax.device_get(draft_trainer.state.params)
+    spec = generate_speculative(target, params, draft, draft_params,
+                                prompt, new, num_draft=3)
+    assert (np.asarray(spec) == np.asarray(greedy)).all(), \
+        "greedy speculative must be token-identical to greedy decode"
+    _, stats = generate_speculative(
+        target, params, draft, draft_params, prompt, new, num_draft=3,
+        rng=jax.random.PRNGKey(3), temperature=0.7, top_p=0.95,
+        return_stats=True)
+    print("speculative ok; stochastic acceptance rate: {:.2f}".format(
+        stats["acceptance_rate"]))
+    return history
+
+
+if __name__ == "__main__":
+    main()

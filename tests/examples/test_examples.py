@@ -82,3 +82,16 @@ def test_pipelined_lm_example(monkeypatch, capsys):
     history = mod.main()
     assert "final loss" in capsys.readouterr().out
     assert np.isfinite(history["loss"][-1])
+
+
+@pytest.mark.slow
+def test_text_generation_example(monkeypatch, capsys):
+    mod = _load("text_generation")
+    monkeypatch.setattr(mod, "EPOCHS", 6)
+    monkeypatch.setattr(mod, "DRAFT_EPOCHS", 2)
+    history = mod.main()
+    out = capsys.readouterr().out
+    assert "greedy continuation" in out
+    assert "beam rows" in out
+    assert "stochastic acceptance rate" in out
+    assert np.isfinite(history["loss"][-1])
