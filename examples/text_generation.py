@@ -53,12 +53,9 @@ def main():
                            d_model=64, d_ff=128, max_seq_len=SEQ_LEN,
                            compute_dtype=jnp.float32)
 
-    def lm_loss(logits, labels):
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels).mean(axis=-1)
-
-    trainer = Trainer(target, optimizer=optax.adam(1e-3), loss=lm_loss,
-                      metrics=())
+    # Default loss (sparse categorical cross-entropy) handles the
+    # [B, S, V]-vs-[B, S] next-token shapes directly.
+    trainer = Trainer(target, optimizer=optax.adam(1e-3), metrics=())
     history = trainer.fit(inputs, targets, epochs=EPOCHS,
                           batch_size=64, verbose=False)
     params = jax.device_get(trainer.state.params)
@@ -101,7 +98,7 @@ def main():
                           d_model=64, d_ff=128, max_seq_len=SEQ_LEN,
                           compute_dtype=jnp.float32)
     draft_trainer = Trainer(draft, optimizer=optax.adam(1e-3),
-                            loss=lm_loss, metrics=())
+                            metrics=())
     draft_trainer.fit(inputs, targets, epochs=DRAFT_EPOCHS,
                       batch_size=64, verbose=False)
     draft_params = jax.device_get(draft_trainer.state.params)
