@@ -10,11 +10,14 @@ models/decoding.py). Variable-length prompt batches use the same
 left-padded `prompt_mask` contract as `generate()`: each row's beams
 expand exactly as that prompt's solo beam search would.
 
-Ranking runs ON DEVICE: per prompt, `jax.lax.top_k` over the [W*V]
-candidate scores — only the [B, W] winners (score, source row, token)
-travel to host per step, not the whole [B*W, V] log-prob matrix (a
-128k-vocab imported checkpoint would otherwise pay an O(W·V log W·V)
-host sort plus the transfer every token).
+The WHOLE generation loop is device-resident: one `lax.scan` carries
+(cache, scores, finished, token buffer) through forward → per-prompt
+`jax.lax.top_k` ranking → cache reorder → token bookkeeping, so
+decoding costs one dispatch and ONE device→host fetch total — no
+per-token host sync (each costs ~66ms through the TPU tunnel,
+PERF.md) and no [B*W, V] log-prob transfer (a 128k-vocab imported
+checkpoint would otherwise pay an O(W·V log W·V) host sort every
+token).
 
 Scoring is accumulated log-probability with optional length
 normalization (score / length**length_penalty, the standard GNMT-style
@@ -41,50 +44,101 @@ from cloud_tpu.models.decoding import empty_cache, validate_prompt_mask
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
 
 
+def _step_logp(decoder, params, cache, tokens, mask=None):
+    """One decode forward → (new_cache, last-position log-probs
+    [rows, V]) — the single recipe shared by the prefill executable
+    and the scan body, so the two cannot drift."""
+    logits, vars_ = decoder.apply(
+        {"params": params, "cache": cache}, tokens, mask,
+        mutable=["cache"])
+    logp = jax.nn.log_softmax(
+        logits[:, -1].astype(jnp.float32), axis=-1)
+    return vars_["cache"], logp
+
+
 @functools.lru_cache(maxsize=64)
 def _logprob_fn(decoder):
     """Jitted chunk feed returning (new_cache, log-probs [rows, V])."""
 
     @jax.jit
     def step(params, cache, tokens, mask=None):
-        logits, vars_ = decoder.apply(
-            {"params": params, "cache": cache}, tokens, mask,
-            mutable=["cache"])
-        logp = jax.nn.log_softmax(
-            logits[:, -1].astype(jnp.float32), axis=-1)
-        return vars_["cache"], logp
+        return _step_logp(decoder, params, cache, tokens, mask)
 
     return step
 
 
 @functools.lru_cache(maxsize=64)
-def _rank_fn(width, eos_token):
-    """Jitted per-prompt beam ranking: candidate scores, frozen-row
-    handling, and lax.top_k — all on device."""
+def _beam_scan_fn(decoder, width, eos_token):
+    """Jitted device-resident beam loop: one `lax.scan` carrying
+    (cache, scores, finished, token buffer, feed) — forward, ranking
+    (`lax.top_k`), cache reorder, and token bookkeeping all stay on
+    device, so the whole generation costs ONE dispatch and ONE
+    device→host fetch regardless of length (a per-token host sync
+    costs ~66ms through the TPU tunnel — PERF.md). With eos set, an
+    all-frozen step short-circuits through `lax.cond` (the
+    device-resident analogue of a host-loop early exit). Like
+    generate()'s decode_steps, the scan length is baked into the
+    executable: distinct max_new_tokens values compile their own
+    specializations, as they must under static shapes."""
 
     @jax.jit
-    def rank(scores, logp, finished):
-        # scores/finished [B, W]; logp [B*W, V].
-        b = scores.shape[0]
-        vocab = logp.shape[-1]
-        cand = scores[:, :, None] + logp.reshape(b, width, vocab)
-        if eos_token is not None:
-            # A frozen row contributes exactly one continuation (eos,
-            # score unchanged) so it survives ranking without forking.
-            frozen = jnp.full((vocab,), -jnp.inf,
-                              jnp.float32).at[eos_token].set(0.0)
-            cand = jnp.where(finished[:, :, None],
-                             scores[:, :, None] + frozen[None, None, :],
-                             cand)
-        top_scores, flat = jax.lax.top_k(cand.reshape(b, width * vocab),
-                                         width)
-        rows, toks = flat // vocab, flat % vocab
-        new_finished = jnp.take_along_axis(finished, rows, axis=1)
-        if eos_token is not None:
-            new_finished = new_finished | (toks == eos_token)
-        return top_scores, rows, toks.astype(jnp.int32), new_finished
+    def run(params, cache, scores, finished, buf, feed, step_ids):
+        batch = scores.shape[0]
 
-    return rank
+        def expand(carry, t):
+            cache, scores, finished, buf, feed = carry
+            cache, logp = _step_logp(decoder, params, cache, feed)
+            vocab = logp.shape[-1]
+            cand = scores[:, :, None] + logp.reshape(batch, width,
+                                                     vocab)
+            if eos_token is not None:
+                # A frozen row contributes exactly one continuation
+                # (eos, score unchanged) so it survives ranking
+                # without forking.
+                frozen = jnp.full((vocab,), -jnp.inf,
+                                  jnp.float32).at[eos_token].set(0.0)
+                cand = jnp.where(
+                    finished[:, :, None],
+                    scores[:, :, None] + frozen[None, None, :], cand)
+            scores, flat = jax.lax.top_k(
+                cand.reshape(batch, width * vocab), width)
+            rows = flat // vocab
+            toks = (flat % vocab).astype(jnp.int32)
+            finished = jnp.take_along_axis(finished, rows, axis=1)
+            if eos_token is not None:
+                finished = finished | (toks == eos_token)
+            order = (jnp.arange(batch)[:, None] * width
+                     + rows).reshape(-1)
+            cache = _reorder(cache, order)
+            buf = jnp.take_along_axis(buf, rows[:, :, None], axis=1)
+            buf = buf.at[:, :, t].set(toks)
+            return (cache, scores, finished, buf,
+                    toks.reshape(-1, 1))
+
+        def body(carry, t):
+            if eos_token is None:
+                return expand(carry, t), None
+            # Every hypothesis of every prompt frozen: keep the frozen
+            # state (buf column t must still be eos for the tail fill)
+            # instead of running the forward — the device-resident
+            # analogue of the old host loop's early exit.
+            def frozen_step(carry, t=t):
+                cache, scores, finished, buf, feed = carry
+                buf = buf.at[:, :, t].set(eos_token)
+                return (cache, scores, finished, buf, feed)
+
+            carry = jax.lax.cond(
+                jnp.all(carry[2]),
+                frozen_step,
+                lambda c, t=t: expand(c, t),
+                carry)
+            return carry, None
+
+        (cache, scores, finished, buf, feed), _ = jax.lax.scan(
+            body, (cache, scores, finished, buf, feed), step_ids)
+        return scores, finished, buf
+
+    return run
 
 
 def _reorder(cache, order):
@@ -156,7 +210,6 @@ def generate_beam(model, params, prompt, max_new_tokens, beam_width=4,
     width = int(beam_width)
     decoder = model.clone(decode=True, dropout_rate=0.0)
     step = _logprob_fn(decoder)
-    rank = _rank_fn(width, None if eos_token is None else int(eos_token))
 
     # Prefill ONCE at batch B, then tile each prompt's cache rows to
     # the beam width (jnp.repeat keeps the b*W + w row-major layout):
@@ -174,41 +227,38 @@ def generate_beam(model, params, prompt, max_new_tokens, beam_width=4,
         cache_b)
 
     vocab = logp.shape[-1]
-    # First expansion: top width tokens per prompt. width > vocab (the
+    # First expansion: top width tokens per prompt, all in eager
+    # device ops (no host fetch — the shapes are static, so the
+    # width > vocab branch is plain Python). width > vocab (the
     # exhaustive-search configuration): only vocab distinct first
     # expansions exist; surplus rows duplicate the best one at -inf so
     # they can never win a ranking.
     s0, t0 = jax.lax.top_k(logp, min(width, vocab))
-    s0 = np.asarray(s0, np.float32)
-    t0 = np.asarray(t0)
     if width > vocab:
         pad = width - vocab
-        t0 = np.concatenate([t0, np.repeat(t0[:, :1], pad, axis=1)], 1)
-        s0 = np.concatenate(
-            [s0, np.full((batch, pad), -np.inf, np.float32)], 1)
-    scores = jnp.asarray(s0)                                 # [B, W]
-    seqs = [[[int(t)] for t in t0[b]] for b in range(batch)]
-    fin_host = np.array([[eos_token is not None and t == eos_token
-                          for t in t0[b]] for b in range(batch)])
-    finished = jnp.asarray(fin_host)
-    feed = jnp.asarray(t0.reshape(-1, 1), jnp.int32)         # [B*W, 1]
+        t0 = jnp.concatenate(
+            [t0, jnp.repeat(t0[:, :1], pad, axis=1)], axis=1)
+        s0 = jnp.concatenate(
+            [s0, jnp.full((batch, pad), -jnp.inf, s0.dtype)], axis=1)
+    t0 = t0.astype(jnp.int32)
+    scores = s0.astype(jnp.float32)                          # [B, W]
+    finished = (jnp.zeros(t0.shape, bool) if eos_token is None
+                else t0 == eos_token)
+    feed = t0.reshape(-1, 1)                                 # [B*W, 1]
+    buf = jnp.zeros((batch, width, max_new_tokens), jnp.int32)
+    buf = buf.at[:, :, 0].set(t0)
 
-    for _ in range(max_new_tokens - 1):
-        if fin_host.all():
-            break
-        cache, logp = step(params, cache, feed, None)
-        scores, rows, toks, finished = rank(scores, logp, finished)
-        # The only per-step device→host traffic: [B, W] winners.
-        rows_h, toks_h, fin_host = jax.device_get(
-            (rows, toks, finished))
-        seqs = [[seqs[b][r] + [int(t)]
-                 for r, t in zip(rows_h[b], toks_h[b])]
-                for b in range(batch)]
-        order = (np.arange(batch)[:, None] * width + rows_h).reshape(-1)
-        cache = _reorder(cache, jnp.asarray(order, jnp.int32))
-        feed = toks.reshape(-1, 1)
-
-    scores_h = np.asarray(jax.device_get(scores), np.float64)  # [B, W]
+    if max_new_tokens > 1:
+        run = _beam_scan_fn(decoder, width, None if eos_token is None
+                            else int(eos_token))
+        scores, finished, buf = run(params, cache, scores, finished,
+                                    buf, feed,
+                                    jnp.arange(1, max_new_tokens))
+    # The ONLY device→host fetch of the whole generation.
+    scores_h, buf_h = jax.device_get((scores, buf))
+    scores_h = np.asarray(scores_h, np.float64)                # [B, W]
+    seqs = [[buf_h[b, w].tolist() for w in range(width)]
+            for b in range(batch)]
 
     def final_score(b, w):
         if length_penalty:
@@ -226,9 +276,10 @@ def generate_beam(model, params, prompt, max_new_tokens, beam_width=4,
         if eos_token is not None and eos_token in out:
             cut = out.index(eos_token) + 1
             out = out[:cut] + [eos_token] * (len(out) - cut)
+        # buf always holds max_new_tokens entries (a frozen hypothesis
+        # keeps re-feeding eos), so rows are full-length by
+        # construction.
         row = [int(t) for t in prompt_h[b]] + out
-        if len(row) < total:  # early all-finished exit
-            row = row + [eos_token] * (total - len(row))
         full_rows.append(row)
         best_scores.append(float(final_score(b, best)))
     tokens = jnp.asarray(full_rows, jnp.int32)

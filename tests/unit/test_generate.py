@@ -595,3 +595,22 @@ class TestTensorParallelDecode:
                 beam_width=3)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
         assert abs(score - ref_score) < 1e-4
+
+
+def test_beam_all_frozen_cond_path_matches_greedy():
+    """With width 1 and eos = the first greedy token, every scan step
+    after the first runs the all-frozen lax.cond branch (the
+    device-resident early exit) — output must still match greedy
+    generate() with the same eos, tail filled with eos."""
+    from cloud_tpu.models import generate_beam
+    model = _model()
+    prompt = _prompt(b=1)
+    params = _params(model, prompt)
+    eos = int(np.asarray(generate(model, params, prompt, 1,
+                                  temperature=0.0))[0, -1])
+    want = generate(model, params, prompt, 8, temperature=0.0,
+                    eos_token=eos)
+    got, _ = generate_beam(model, params, prompt, 8, beam_width=1,
+                           eos_token=eos)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert (np.asarray(got)[0, prompt.shape[1]:] == eos).all()
