@@ -374,14 +374,14 @@ def config9_generate_decode():
     run(new_tokens)  # compile the full prefill + decode executables
     run(1)           # compile the prefill + single-sample variant
 
-    def best_of(n, reps=3):
+    def best_of(n, reps=3, run_fn=run):
         # min-of-N: the noise-robust latency estimator — a loaded host
         # once timed run(1) slower than run(new_tokens), producing an
         # absurd decode rate from the difference of two noisy numbers.
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
-            run(n)
+            run_fn(n)
             best = min(best, time.perf_counter() - t0)
         return best
 
@@ -425,20 +425,16 @@ def config9_generate_decode():
     run_beam(new_tokens)  # compile prefill + scan executables
     run_beam(1)           # compile the prefill-only variant
 
-    def beam_best_of(n, reps=3):
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            run_beam(n)
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    beam_decode_s = beam_best_of(new_tokens) - beam_best_of(1)
-    if beam_decode_s >= 1e-4:
-        record.update(
-            beam_tokens_per_sec=round(
-                (new_tokens - 1) / beam_decode_s, 1),
-            beam_width=beam_width, beam_batch=1)
+    beam_decode_s = (best_of(new_tokens, run_fn=run_beam)
+                     - best_of(1, run_fn=run_beam))
+    record.update(beam_width=beam_width, beam_batch=1)
+    if beam_decode_s < 1e-4:
+        record.update(beam_tokens_per_sec=0.0,
+                      beam_error="beam decode time not separable "
+                                 "from prefill (noisy host?)")
+    else:
+        record.update(beam_tokens_per_sec=round(
+            (new_tokens - 1) / beam_decode_s, 1))
     return record
 
 

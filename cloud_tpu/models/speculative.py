@@ -64,13 +64,12 @@ _BOOKKEEPING = ("cache_index", "token_count", "pos_count")
 def _rewind_cache(cache, n, new_idx):
     """Roll back the last n cache slots (bookkeeping only).
 
-    new_idx: the write pointer AFTER the rewind — the caller tracks it
-    host-side (it equals the number of committed cache entries), so no
-    device fetch is needed on the latency-critical round loop.
+    Runs INSIDE the fused round executable with a traced n (n == 0 is
+    a no-op by construction: pointer -= 0, and the slot mask keeps
+    exactly the already-valid entries when new_idx equals the current
+    count). new_idx: the write pointer AFTER the rewind — the number
+    of committed cache entries.
     """
-    if n == 0:
-        return cache
-
     def fix(path, leaf):
         key = getattr(path[-1], "key", None)
         if key in _BOOKKEEPING:
@@ -98,25 +97,74 @@ def _chunk_fn(decoder):
     return chunk
 
 
+def _fixup_caches(target_cache, draft, draft_params, d_cache, drafts,
+                  n_acc, k, base_len):
+    """Post-verification cache bookkeeping, on device (traced n_acc).
+
+    Both caches must end holding entries for the new seq[:-1], i.e.
+    base_len + n_acc committed entries. The target wrote k+1 entries
+    (last_tok, d1..dk): keep n_acc+1. The draft wrote k entries
+    (last_tok, d1..d_{k-1}): rejections rewind for free; only full
+    acceptance needs the one missing d_k entry, written under the
+    lax.cond so it costs a draft forward only when taken.
+    """
+    kept = base_len + n_acc
+    target_cache = _rewind_cache(target_cache, k - n_acc, kept)
+
+    def rewound(dc):
+        return _rewind_cache(dc, k - n_acc - 1, kept)
+
+    def caught_up(dc):
+        _, vars_ = draft.apply(
+            {"params": draft_params, "cache": dc},
+            drafts[-1][None, None], mutable=["cache"])
+        return vars_["cache"]
+
+    d_cache = jax.lax.cond(n_acc < k, rewound, caught_up, d_cache)
+    return target_cache, d_cache
+
+
 @functools.lru_cache(maxsize=128)
-def _sample_step_fn(decoder, temperature, top_k, top_p):
-    """Jitted single-token sampling step for the stochastic draft:
-    returns (new_cache, next token [B], warped logits [B, V]) — the
-    warped logits are the q-distribution the accept/reject math needs,
-    captured at the moment of sampling so q is exactly what the token
-    was drawn from."""
+def _greedy_round_fn(target, draft, k):
+    """One FUSED greedy speculative round: the k-step draft scan, the
+    target verification forward, argmax acceptance, and both cache
+    fix-ups — a single dispatch, with one [k+1]-token fetch per round
+    (the old loop paid k draft dispatches, each with a host sync for
+    the argmax token, plus the verify — ~66ms of tunnel latency per
+    dispatch, PERF.md)."""
 
     @jax.jit
-    def step(params, cache, token, rng):
-        logits, vars_ = decoder.apply(
-            {"params": params, "cache": cache}, token,
-            mutable=["cache"])
-        warped = warp_logits(logits[:, -1], temperature, top_k, top_p)
-        nxt = jax.random.categorical(rng, warped,
-                                     axis=-1).astype(jnp.int32)
-        return vars_["cache"], nxt, warped
+    def round_step(params, draft_params, t_cache, d_cache, last_tok,
+                   base_len):
+        def draft_body(carry, _):
+            d_cache, tok = carry
+            logits, vars_ = draft.apply(
+                {"params": draft_params, "cache": d_cache}, tok,
+                mutable=["cache"])
+            nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)[:, None]
+            return (vars_["cache"], nxt), nxt[0, 0]
 
-    return step
+        (d_cache, _), drafts = jax.lax.scan(
+            draft_body, (d_cache, last_tok), None, length=k)
+
+        verify_in = jnp.concatenate([last_tok[0], drafts])[None, :]
+        logits, vars_ = target.apply(
+            {"params": params, "cache": t_cache}, verify_in,
+            mutable=["cache"])
+        greedy = jnp.argmax(logits[0].astype(jnp.float32),
+                            axis=-1).astype(jnp.int32)  # [k+1]
+        accept = (drafts == greedy[:k]).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumprod(accept))
+        committed = jnp.concatenate(
+            [drafts, jnp.zeros((1,), jnp.int32)])
+        committed = committed.at[n_acc].set(greedy[n_acc])
+        t_cache, d_cache = _fixup_caches(
+            vars_["cache"], draft, draft_params, d_cache, drafts,
+            n_acc, k, base_len)
+        return t_cache, d_cache, committed, n_acc
+
+    return round_step
 
 
 def _accept_and_residual(p, q, d_tokens, uniforms):
@@ -161,27 +209,55 @@ def _accept_and_residual(p, q, d_tokens, uniforms):
 
 
 @functools.lru_cache(maxsize=128)
-def _verify_fn(decoder, temperature, top_k, top_p):
-    """Jitted stochastic verification: one target forward over the
-    k+1 verification tokens, accept/reject on device, and the
-    replacement/bonus sample — only two scalars (n_acc, token) ever
-    travel back to host per round."""
+def _stochastic_round_fn(decoder_pair, k, temperature, top_k, top_p):
+    """One FUSED stochastic speculative round: the k-step sampling
+    draft scan (each step's warped logits captured as the
+    q-distribution its token was drawn from), the target verification
+    forward, the Leviathan accept/reject + replacement/bonus sample,
+    and both cache fix-ups — a single dispatch, one [k+1]-token fetch
+    per round."""
+    target, draft = decoder_pair
 
     @jax.jit
-    def verify(params, cache, tokens, q_warped, d_tokens, uniforms,
-               rng):
-        logits, vars_ = decoder.apply(
-            {"params": params, "cache": cache}, tokens,
+    def round_step(params, draft_params, t_cache, d_cache, last_tok,
+                   base_len, rng):
+        rngs = jax.random.split(rng, k + 2)
+        step_rngs, uni_rng, extra_rng = rngs[:k], rngs[k], rngs[k + 1]
+
+        def draft_body(carry, step_rng):
+            d_cache, tok = carry
+            logits, vars_ = draft.apply(
+                {"params": draft_params, "cache": d_cache}, tok,
+                mutable=["cache"])
+            warped = warp_logits(logits[:, -1], temperature, top_k,
+                                 top_p)
+            nxt = jax.random.categorical(
+                step_rng, warped, axis=-1).astype(jnp.int32)[:, None]
+            return (vars_["cache"], nxt), (nxt[0, 0], warped[0])
+
+        (d_cache, _), (drafts, q_warped) = jax.lax.scan(
+            draft_body, (d_cache, last_tok), step_rngs)
+
+        verify_in = jnp.concatenate([last_tok[0], drafts])[None, :]
+        logits, vars_ = target.apply(
+            {"params": params, "cache": t_cache}, verify_in,
             mutable=["cache"])
         p_warped = warp_logits(logits[0], temperature, top_k, top_p)
         n_acc, resid = _accept_and_residual(
             jax.nn.softmax(p_warped, axis=-1),
-            jax.nn.softmax(q_warped, axis=-1), d_tokens, uniforms)
+            jax.nn.softmax(q_warped, axis=-1), drafts,
+            jax.random.uniform(uni_rng, (k,)))
         extra = jax.random.categorical(
-            rng, jnp.log(resid)).astype(jnp.int32)
-        return vars_["cache"], n_acc, extra
+            extra_rng, jnp.log(resid)).astype(jnp.int32)
+        committed = jnp.concatenate(
+            [drafts, jnp.zeros((1,), jnp.int32)])
+        committed = committed.at[n_acc].set(extra)
+        t_cache, d_cache = _fixup_caches(
+            vars_["cache"], draft, draft_params, d_cache, drafts,
+            n_acc, k, base_len)
+        return t_cache, d_cache, committed, n_acc
 
-    return verify
+    return round_step
 
 
 def generate_speculative(model, params, draft_model, draft_params,
@@ -199,10 +275,10 @@ def generate_speculative(model, params, draft_model, draft_params,
             vocabulary; any decode-capable family).
         prompt: [1, S] int32 (batch 1 — see module docstring).
         max_new_tokens: tokens to generate beyond the prompt.
-        num_draft: proposals per verification round. Each round costs
-            num_draft draft steps + ONE target forward over
-            num_draft+1 tokens, and commits between 1 and num_draft+1
-            tokens.
+        num_draft: proposals per verification round. Each round is ONE
+            fused dispatch (a num_draft-step draft scan + one target
+            forward over num_draft+1 tokens + accept math + cache
+            fix-ups) and commits between 1 and num_draft+1 tokens.
         eos_token: optional stop token; the tail is filled with it.
         rng: PRNGKey; required when temperature > 0.
         temperature: 0 = greedy verification (the default, original
@@ -281,8 +357,6 @@ def generate_speculative(model, params, draft_model, draft_params,
         warp_key = (float(temperature),
                     None if top_k is None else int(top_k),
                     None if top_p is None else float(top_p))
-        draft_step = _sample_step_fn(draft, *warp_key)
-        verify_step = _verify_fn(target, *warp_key)
     t_cache = empty_cache(target, 1)
     d_cache = empty_cache(draft, 1)
 
@@ -300,76 +374,32 @@ def generate_speculative(model, params, draft_model, draft_params,
         # has — and a full-acceptance round overshoots the budget by
         # at most one committed token, trimmed by seq[:total] below.
         # At most num_draft distinct k values, so compilations stay
-        # bounded.
+        # bounded (each k compiles its own fused round executable).
         k = min(num_draft, total - len(seq))
 
+        # One FUSED dispatch per round (draft scan + verify + accept
+        # + cache fix-ups), one [k+1]-token fetch. base_len rides as a
+        # device scalar so round executables are shared across rounds.
+        last = jnp.asarray([[seq[-1]]], jnp.int32)
+        base = jnp.asarray(len(seq), jnp.int32)
         if stochastic:
-            # --- Sample k proposals from the warped draft dist ---
-            rng, uni_rng, extra_rng, *step_rngs = jax.random.split(
-                rng, k + 3)
-            tok = jnp.asarray([[seq[-1]]], jnp.int32)
-            toks, warps = [], []
-            for i in range(k):
-                d_cache, nxt, warped = draft_step(
-                    draft_params, d_cache, tok, step_rngs[i])
-                toks.append(nxt)
-                warps.append(warped)
-                tok = nxt[:, None]
-            d_tokens = jnp.concatenate(toks)         # [k]
-            q_warped = jnp.concatenate(warps)        # [k, V]
-
-            # --- One target forward + on-device accept/reject ---
-            verify_in = jnp.concatenate(
-                [jnp.asarray([[seq[-1]]], jnp.int32),
-                 d_tokens[None, :]], axis=1)
-            uniforms = jax.random.uniform(uni_rng, (k,))
-            t_cache, n_acc, extra = verify_step(
-                params, t_cache, verify_in, q_warped, d_tokens,
-                uniforms, extra_rng)
-            accepted = int(np.asarray(n_acc))
-            drafts = [int(t) for t in np.asarray(d_tokens)]
-            committed = drafts[:accepted] + [int(np.asarray(extra))]
+            rng, round_rng = jax.random.split(rng)
+            round_step = _stochastic_round_fn((target, draft), k,
+                                              *warp_key)
+            t_cache, d_cache, committed_dev, n_acc = round_step(
+                params, draft_params, t_cache, d_cache, last, base,
+                round_rng)
         else:
-            # --- Draft k greedy proposals, one cheap step at a time
-            drafts = []
-            tok = seq[-1]
-            for _ in range(k):
-                d_cache, out = draft_chunk(
-                    draft_params, d_cache,
-                    jnp.asarray([[tok]], jnp.int32))
-                tok = int(np.asarray(out)[0, -1])
-                drafts.append(tok)
-
-            # --- Verify all k in ONE target forward over k+1 tokens
-            verify_in = jnp.asarray([[seq[-1]] + drafts], jnp.int32)
-            t_cache, greedy = target_chunk(params, t_cache, verify_in)
-            greedy = np.asarray(greedy)[0]  # g[i] = token after d_i
-
-            accepted = 0
-            while (accepted < k
-                   and drafts[accepted] == int(greedy[accepted])):
-                accepted += 1
-            committed = drafts[:accepted] + [int(greedy[accepted])]
+            round_step = _greedy_round_fn(target, draft, k)
+            t_cache, d_cache, committed_dev, n_acc = round_step(
+                params, draft_params, t_cache, d_cache, last, base)
+        committed_h, accepted = jax.device_get((committed_dev, n_acc))
+        accepted = int(accepted)
+        committed = [int(t) for t in committed_h[:accepted + 1]]
 
         stats["rounds"] += 1
         stats["proposed"] += k
         stats["accepted_drafts"] += accepted
-
-        # --- Restore the invariant ---
-        # Both caches must end holding entries for seq[:-1] after the
-        # commit, i.e. len(seq) + accepted committed entries.
-        kept = len(seq) + accepted
-        # Target wrote k+1 entries (seq[-1], d1..dk); keep accepted+1.
-        t_cache = _rewind_cache(t_cache, k - accepted, kept)
-        # Draft wrote k entries (seq[-1], d1..d_{k-1}); its cache must
-        # end holding (seq[-1], d1..d_accepted). Rejections rewind for
-        # free; only full acceptance needs the one missing d_k entry.
-        if accepted < k:
-            d_cache = _rewind_cache(d_cache, k - accepted - 1, kept)
-        else:
-            d_cache, _ = draft_chunk(
-                draft_params, d_cache,
-                jnp.asarray([[drafts[-1]]], jnp.int32))
 
         seq.extend(committed)
         if eos_token is not None and eos_token in committed:
