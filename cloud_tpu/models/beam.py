@@ -40,7 +40,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cloud_tpu.models.decoding import empty_cache, validate_prompt_mask
+from cloud_tpu.models.decoding import (best_effort_donation,
+                                       empty_cache,
+                                       validate_prompt_mask)
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
 
 
@@ -60,11 +62,13 @@ def _step_logp(decoder, params, cache, tokens, mask=None):
 def _logprob_fn(decoder):
     """Jitted chunk feed returning (new_cache, log-probs [rows, V])."""
 
-    @jax.jit
+    # donate_argnums=1: prefill consumes the fresh empty cache; no
+    # caller reuses it, so the KV buffers update in place.
+    @functools.partial(jax.jit, donate_argnums=1)
     def step(params, cache, tokens, mask=None):
         return _step_logp(decoder, params, cache, tokens, mask)
 
-    return step
+    return best_effort_donation(step)
 
 
 @functools.lru_cache(maxsize=64)
@@ -81,7 +85,9 @@ def _beam_scan_fn(decoder, width, eos_token):
     executable: distinct max_new_tokens values compile their own
     specializations, as they must under static shapes."""
 
-    @jax.jit
+    # Donate the cache and token buffer: generate_beam passes both in
+    # exactly once, so the scan's carries reuse their storage.
+    @functools.partial(jax.jit, donate_argnums=(1, 4))
     def run(params, cache, scores, finished, buf, feed, step_ids):
         batch = scores.shape[0]
 
@@ -138,7 +144,7 @@ def _beam_scan_fn(decoder, width, eos_token):
             body, (cache, scores, finished, buf, feed), step_ids)
         return scores, finished, buf
 
-    return run
+    return best_effort_donation(run)
 
 
 def _reorder(cache, order):

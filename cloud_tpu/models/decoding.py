@@ -15,6 +15,9 @@ Cache variables created on the calling module ("cache" collection):
   token_count  [B]      number of real tokens seen per example
 """
 
+import functools
+import warnings
+
 import jax
 import jax.lax as lax
 import jax.numpy as jnp
@@ -68,6 +71,34 @@ def decode_slot_update(module, mask, batch, seq, cache_len):
                & (key_slots[None, None, :]
                   <= idx + jnp.arange(seq)[None, :, None]))
     return idx, positions, allowed
+
+
+def best_effort_donation(fn):
+    """Wrap a jitted decode executable whose cache arguments are
+    donated: donation is an optimization, not a contract — under a
+    mesh the caller's (e.g. replicated) cache layout may not alias the
+    GSPMD-partitioned layout the executable compiled to, and JAX warns
+    'Some donated buffers were not usable' on every call. The callers
+    never reuse the passed-in cache either way, so scope-suppress
+    exactly that warning around our own call.
+
+    Per-call `catch_warnings` is deliberate despite touching the
+    (thread-global) filter list on the hot path: a one-time global
+    filter would silence the same message from USER jits process-wide
+    and is wiped by pytest's per-test filter resets, and a
+    first-call-only scope misses later executables (new shapes/mesh)
+    of the same wrapper. The remaining caveat — concurrent decode
+    threads could interleave filter save/restore — trades a narrow
+    race on warning visibility for correctness everywhere else.
+    """
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore",
+                message="Some donated buffers were not usable")
+            return fn(*args, **kwargs)
+    return wrapped
 
 
 def validate_prompt_mask(prompt_mask, batch, prompt_len, reader):
@@ -141,5 +172,5 @@ def empty_cache(decoder, batch):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
-__all__ = ["decode_slot_update", "empty_cache", "validate_prompt_mask",
-           "warp_logits"]
+__all__ = ["best_effort_donation", "decode_slot_update", "empty_cache",
+           "validate_prompt_mask", "warp_logits"]

@@ -55,7 +55,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cloud_tpu.models.decoding import empty_cache, warp_logits
+from cloud_tpu.models.decoding import (best_effort_donation,
+                                       empty_cache, warp_logits)
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
 
 _BOOKKEEPING = ("cache_index", "token_count", "pos_count")
@@ -86,7 +87,9 @@ def _rewind_cache(cache, n, new_idx):
 def _chunk_fn(decoder):
     """Jitted chunk feed: returns (new_cache, greedy tokens [B, S])."""
 
-    @jax.jit
+    # donate_argnums=1: callers always rebind the cache they pass in,
+    # so the KV buffers update in place.
+    @functools.partial(jax.jit, donate_argnums=1)
     def chunk(params, cache, tokens):
         logits, vars_ = decoder.apply(
             {"params": params, "cache": cache}, tokens,
@@ -94,7 +97,7 @@ def _chunk_fn(decoder):
         return vars_["cache"], jnp.argmax(
             logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
 
-    return chunk
+    return best_effort_donation(chunk)
 
 
 def _fixup_caches(target_cache, draft, draft_params, d_cache, drafts,
@@ -133,7 +136,8 @@ def _greedy_round_fn(target, draft, k):
     the argmax token, plus the verify — ~66ms of tunnel latency per
     dispatch, PERF.md)."""
 
-    @jax.jit
+    # Donate both caches: the round loop rebinds them every iteration.
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
     def round_step(params, draft_params, t_cache, d_cache, last_tok,
                    base_len):
         def draft_body(carry, _):
@@ -164,7 +168,7 @@ def _greedy_round_fn(target, draft, k):
             n_acc, k, base_len)
         return t_cache, d_cache, committed, n_acc
 
-    return round_step
+    return best_effort_donation(round_step)
 
 
 def _accept_and_residual(p, q, d_tokens, uniforms):
@@ -209,16 +213,16 @@ def _accept_and_residual(p, q, d_tokens, uniforms):
 
 
 @functools.lru_cache(maxsize=128)
-def _stochastic_round_fn(decoder_pair, k, temperature, top_k, top_p):
+def _stochastic_round_fn(target, draft, k, temperature, top_k, top_p):
     """One FUSED stochastic speculative round: the k-step sampling
     draft scan (each step's warped logits captured as the
     q-distribution its token was drawn from), the target verification
     forward, the Leviathan accept/reject + replacement/bonus sample,
     and both cache fix-ups — a single dispatch, one [k+1]-token fetch
     per round."""
-    target, draft = decoder_pair
 
-    @jax.jit
+    # Donate both caches: the round loop rebinds them every iteration.
+    @functools.partial(jax.jit, donate_argnums=(2, 3))
     def round_step(params, draft_params, t_cache, d_cache, last_tok,
                    base_len, rng):
         rngs = jax.random.split(rng, k + 2)
@@ -257,7 +261,7 @@ def _stochastic_round_fn(decoder_pair, k, temperature, top_k, top_p):
             n_acc, k, base_len)
         return t_cache, d_cache, committed, n_acc
 
-    return round_step
+    return best_effort_donation(round_step)
 
 
 def generate_speculative(model, params, draft_model, draft_params,
@@ -384,7 +388,7 @@ def generate_speculative(model, params, draft_model, draft_params,
         base = jnp.asarray(len(seq), jnp.int32)
         if stochastic:
             rng, round_rng = jax.random.split(rng)
-            round_step = _stochastic_round_fn((target, draft), k,
+            round_step = _stochastic_round_fn(target, draft, k,
                                               *warp_key)
             t_cache, d_cache, committed_dev, n_acc = round_step(
                 params, draft_params, t_cache, d_cache, last, base,

@@ -416,14 +416,18 @@ def _decode_fns(decoder, temperature, top_k, top_p, eos_token):
         return jax.random.categorical(rng, warped,
                                       axis=-1).astype(jnp.int32)
 
-    @jax.jit
+    # donate_argnums=1: the caller never reuses the passed-in cache
+    # (prefill gets the fresh empty cache; decode_steps consumes
+    # prefill's), so XLA can update the KV buffers in place instead of
+    # holding two cache-sized allocations across the call.
+    @functools.partial(jax.jit, donate_argnums=1)
     def prefill(params, cache, prompt, rng, prompt_mask=None):
         logits, vars_ = decoder.apply({"params": params, "cache": cache},
                                       prompt, prompt_mask,
                                       mutable=["cache"])
         return vars_["cache"], sample(logits[:, -1], rng)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=1)
     def decode_steps(params, cache, first_token, step_rngs):
         def step(carry, step_rng):
             cache, tok, done = carry
@@ -442,7 +446,9 @@ def _decode_fns(decoder, temperature, top_k, top_p, eos_token):
             step, (cache, first_token, done), step_rngs)
         return toks  # [T-1, B]
 
-    return prefill, decode_steps
+    from cloud_tpu.models.decoding import best_effort_donation
+    return best_effort_donation(prefill), best_effort_donation(
+        decode_steps)
 
 
 def tensor_parallel_rules(tp_axis: str = "tp"):
