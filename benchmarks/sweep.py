@@ -7,8 +7,13 @@ point runs in its own bounded subprocess (the tunneled backend can hang
 the sweep ends with a summary line naming the best config and how to
 pin it (BENCH_BATCH / BENCH_S2D / BENCH_SPE env for bench.py).
 
-Usage: python benchmarks/sweep.py [--batches 128,256,512] [--s2d 0,1]
-       [--spe 1,5] [--bf16-input 0,1]
+Axis VALUE ORDER is execution order: the defaults run the
+highest-expected-value points first (spe=5 at the flagship batch), so
+a tunnel window that closes mid-sweep still leaves the best-point pin
+measurable.
+
+Usage: python benchmarks/sweep.py [--batches 256,512,128] [--s2d 0,1]
+       [--spe 5,10,1] [--bf16-input 0,1]
 """
 
 import argparse

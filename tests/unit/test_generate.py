@@ -614,3 +614,28 @@ def test_beam_all_frozen_cond_path_matches_greedy():
                            eos_token=eos)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     assert (np.asarray(got)[0, prompt.shape[1]:] == eos).all()
+
+
+def test_speculative_matches_replicated_under_tp_mesh():
+    """The fused speculative round (draft scan + verify + traced-n
+    cache rewinds under lax.cond) with Megatron tp-sharded target AND
+    draft params: GSPMD propagates the shardings through the single
+    round executable — tokens identical to replicated decode. Reuses
+    TestTensorParallelDecode's mesh/sharding helpers."""
+    from cloud_tpu.models import generate_speculative
+
+    helper = TestTensorParallelDecode()
+    target = _model(num_heads=4)
+    draft = _model(num_heads=4, num_layers=1)
+    prompt = _prompt(b=1)
+    t_params = _params(target, prompt)
+    d_params = _params(draft, prompt)
+    ref = generate_speculative(target, t_params, draft, d_params,
+                               prompt, 10, num_draft=3)
+    mesh = helper._mesh()
+    with mesh:
+        out = generate_speculative(
+            target, helper._sharded(target, t_params, mesh), draft,
+            helper._sharded(draft, d_params, mesh), prompt, 10,
+            num_draft=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
