@@ -1,0 +1,47 @@
+"""Fast-tier decode smoke: the three entry points on a tiny LM.
+
+The full decode suites (test_generate.py, test_speculative_stochastic
+.py) are slow-marked; this keeps a minimal generate / beam /
+speculative path in the fast tier so a regression there fails in the
+quick loop, not 30 minutes into the nightly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cloud_tpu.models import (TransformerLM, generate, generate_beam,
+                              generate_speculative)
+
+_VOCAB = 17
+
+
+def _setup():
+    model = TransformerLM(vocab_size=_VOCAB, num_layers=1, num_heads=2,
+                          d_model=16, d_ff=32, max_seq_len=16,
+                          compute_dtype=jnp.float32)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, _VOCAB, (1, 4)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    return model, params, prompt
+
+
+def test_generate_beam_speculative_smoke():
+    model, params, prompt = _setup()
+    greedy = generate(model, params, prompt, 5, temperature=0.0)
+    assert greedy.shape == (1, 9)
+    assert int(jnp.max(greedy)) < _VOCAB
+
+    beam, score = generate_beam(model, params, prompt, 5, beam_width=2)
+    assert beam.shape == (1, 9)
+    assert np.isfinite(score)
+
+    spec = generate_speculative(model, params, model, params, prompt,
+                                5, num_draft=2)
+    # Self-draft greedy speculation is token-identical to greedy.
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(greedy))
+
+    sampled = generate(model, params, prompt, 5,
+                       rng=jax.random.PRNGKey(1), temperature=0.9,
+                       top_k=8, top_p=0.9)
+    assert sampled.shape == (1, 9)
