@@ -321,8 +321,12 @@ def import_hf_llama(model=None, state_dict=None, config=None,
     elif window and str(cfg("model_type", "llama")).startswith("qwen"):
         # Qwen2 without explicit layer_types: HF bands only layers
         # i >= max_window_layers (configuration_qwen2.py layer_types
-        # derivation); the early layers stay full attention.
-        mwl = int(cfg("max_window_layers", layers))
+        # derivation); the early layers stay full attention. The
+        # fallback is HF's own default (configuration_qwen2.py:
+        # max_window_layers=28), NOT num layers — a deep raw-dict
+        # config omitting the key must band layers 28+ exactly as the
+        # HF config object would.
+        mwl = int(cfg("max_window_layers", 28))
         if mwl > 0:
             attn_kinds = tuple("global" if i < mwl else "local"
                                for i in range(layers))

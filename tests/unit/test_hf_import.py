@@ -632,17 +632,18 @@ class TestSlidingWindowGate:
     nulls sliding_window unless the gate is on, default OFF); families
     without the gate (mistral) keep the window."""
 
-    def _qwen_dict_import(self, transformers, torch, **cfg_overrides):
+    def _qwen_dict_import(self, transformers, torch, num_layers=4,
+                          **cfg_overrides):
         config = transformers.Qwen2Config(
             vocab_size=64, hidden_size=32, intermediate_size=64,
-            num_hidden_layers=4, num_attention_heads=4,
+            num_hidden_layers=num_layers, num_attention_heads=4,
             num_key_value_heads=2, max_position_embeddings=64,
             tie_word_embeddings=False, attn_implementation="eager")
         torch.manual_seed(0)
         hf = transformers.Qwen2ForCausalLM(config).eval()
         raw = {
             "model_type": "qwen2", "vocab_size": 64, "hidden_size": 32,
-            "intermediate_size": 64, "num_hidden_layers": 4,
+            "intermediate_size": 64, "num_hidden_layers": num_layers,
             "num_attention_heads": 4, "num_key_value_heads": 2,
             "max_position_embeddings": 64, "rope_theta": 10000.0,
             "rms_norm_eps": 1e-6, "tie_word_embeddings": False,
@@ -671,6 +672,17 @@ class TestSlidingWindowGate:
                                        max_window_layers=2)
         assert lm.sliding_window == 4
         assert lm.attn_kinds == ("global", "global", "local", "local")
+
+    def test_gate_true_missing_mwl_uses_hf_default_28(
+            self, transformers, torch):
+        """A deep raw-dict config omitting max_window_layers must band
+        layers 28+ exactly as the HF config object's default would —
+        NOT fall back to num layers (which would drop the band)."""
+        lm, _ = self._qwen_dict_import(transformers, torch,
+                                       num_layers=30, sliding_window=4,
+                                       use_sliding_window=True)
+        assert lm.sliding_window == 4
+        assert lm.attn_kinds == ("global",) * 28 + ("local",) * 2
 
     def test_ungated_family_dict_keeps_window(self, transformers,
                                               torch):
