@@ -100,7 +100,15 @@ def _beam_scan_fn(decoder, width, eos_token):
             if eos_token is not None:
                 # A frozen row contributes exactly one continuation
                 # (eos, score unchanged) so it survives ranking
-                # without forking.
+                # without forking. Invariant exception: with
+                # width > vocab the pool of finite candidates
+                # (≤ width·vocab minus the frozen rows' -inf entries)
+                # can run short of width, so top_k backfills with -inf
+                # candidates and a frozen row may re-enter the beam as
+                # -inf duplicates — degenerate hypotheses a caller
+                # ranking by score discards anyway, so no behavioral
+                # guard; beams wider than the vocabulary are already
+                # meaningless.
                 frozen = jnp.full((vocab,), -jnp.inf,
                                   jnp.float32).at[eos_token].set(0.0)
                 cand = jnp.where(

@@ -73,31 +73,52 @@ def decode_slot_update(module, mask, batch, seq, cache_len):
     return idx, positions, allowed
 
 
+# The exact text jax emits when donated buffers can't alias (a plain
+# `warnings.warn`, so category UserWarning) — PINNED against jax
+# 0.4.37, jax/_src/interpreters/mlir.py. A jax upgrade that rewords
+# the message downgrades the suppression below to a no-op: the
+# warning becomes visible again (fail open), never wrongly silenced.
+_DONATION_MSG = "Some donated buffers were not usable"
+
+
+def _arm_donation_filter():
+    """Ensure ONE ignore entry for jax's donation warning is in the
+    warnings filter list; re-installs after pytest's per-test filter
+    resets wipe it. The scan compares the compiled pattern the
+    installed entry carries (filterwarnings compiled it once, at
+    install — never per dispatch) so repeated arming is an O(filters)
+    string compare, not a filter-list mutation."""
+    for entry in warnings.filters:
+        if (entry[0] == "ignore"
+                and getattr(entry[1], "pattern", None) == _DONATION_MSG
+                and entry[2] is UserWarning):
+            return
+    warnings.filterwarnings("ignore", message=_DONATION_MSG,
+                            category=UserWarning)
+
+
 def best_effort_donation(fn):
     """Wrap a jitted decode executable whose cache arguments are
     donated: donation is an optimization, not a contract — under a
     mesh the caller's (e.g. replicated) cache layout may not alias the
     GSPMD-partitioned layout the executable compiled to, and JAX warns
     'Some donated buffers were not usable' on every call. The callers
-    never reuse the passed-in cache either way, so scope-suppress
-    exactly that warning around our own call.
+    never reuse the passed-in cache either way, so suppress exactly
+    that message (category + compiled-once regex match).
 
-    Per-call `catch_warnings` is deliberate despite touching the
-    (thread-global) filter list on the hot path: a one-time global
-    filter would silence the same message from USER jits process-wide
-    and is wiped by pytest's per-test filter resets, and a
-    first-call-only scope misses later executables (new shapes/mesh)
-    of the same wrapper. The remaining caveat — concurrent decode
-    threads could interleave filter save/restore — trades a narrow
-    race on warning visibility for correctness everywhere else.
+    The filter is installed AT MOST ONCE per process and only
+    re-checked (not re-installed) per dispatch — the previous per-call
+    `catch_warnings` save/restore mutated the thread-GLOBAL filter
+    list on every decode step, which races with concurrent decode
+    threads and thrashes the warning registry. The accepted trade:
+    the ignore is process-wide, so a USER jit emitting the identical
+    donation message is silenced too; that message is advisory (an
+    optimization that didn't apply), never a correctness signal.
     """
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        with warnings.catch_warnings():
-            warnings.filterwarnings(
-                "ignore",
-                message="Some donated buffers were not usable")
-            return fn(*args, **kwargs)
+        _arm_donation_filter()
+        return fn(*args, **kwargs)
     return wrapped
 
 

@@ -338,3 +338,57 @@ def reset():
     """Clears the ambient context (test isolation)."""
     global _context
     _context = None
+
+
+# --------------------------------------------------------------------------
+# Host->device transfer observability.
+#
+# Every feed-path entry point (sharding.shard_batch / make_global_batch,
+# Trainer's no-mesh device_put branches, prefetch_to_device's default feed,
+# and the DeviceResidentDataset one-time upload) records what it is about
+# to move. Tests and bench.py assert transfer behavior from these counters
+# instead of inferring it from wall clock — in particular that the
+# device-resident pipeline does ZERO per-step H2D data transfers after its
+# one-time upload, and that input_cast="bfloat16" halves the bytes on the
+# wire.
+
+_transfer_stats = {"h2d_transfers": 0, "h2d_bytes": 0}
+
+
+def record_h2d(batch):
+    """Counts the host->device bytes about to be transferred for `batch`.
+
+    Only host-resident leaves count: a leaf that is already a `jax.Array`
+    costs nothing to "transfer" again (device_put is a no-op or a
+    device-to-device move), so it is skipped. Python scalars and lists are
+    measured through `np.asarray`. Returns the byte count recorded, so the
+    one-time resident upload can report its own size.
+    """
+    import jax
+    import numpy as np
+
+    transfers = 0
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        if isinstance(leaf, jax.Array):
+            continue
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            nbytes = np.asarray(leaf).nbytes
+        transfers += 1
+        total += int(nbytes)
+    if transfers:
+        _transfer_stats["h2d_transfers"] += transfers
+        _transfer_stats["h2d_bytes"] += total
+    return total
+
+
+def transfer_stats():
+    """A snapshot of the process-wide H2D feed counters."""
+    return dict(_transfer_stats)
+
+
+def reset_transfer_stats():
+    """Zeroes the H2D counters (test isolation / bench warmup barrier)."""
+    _transfer_stats["h2d_transfers"] = 0
+    _transfer_stats["h2d_bytes"] = 0

@@ -90,6 +90,7 @@ def shard_batch(batch, mesh=None, axis=DATA_AXIS):
     over the data axis. Works for single-process use; multi-host feeding
     goes through `make_global_batch`."""
     sharding = batch_sharding(mesh, axis)
+    runtime.record_h2d(batch)
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(x, sharding), batch)
 
@@ -108,6 +109,7 @@ def make_global_batch(local_batch, mesh=None, axis=DATA_AXIS,
     if sharding is None:
         mesh = _resolve_mesh(mesh)
         sharding = batch_sharding(mesh, axis)
+    runtime.record_h2d(local_batch)
     return jax.tree_util.tree_map(
         lambda x: jax.make_array_from_process_local_data(sharding, x),
         local_batch)

@@ -3,8 +3,10 @@ from cloud_tpu.training.callbacks import (Callback, EarlyStopping,
                                           ModelCheckpoint,
                                           PreemptionCheckpoint,
                                           TensorBoard, read_metrics_log)
-from cloud_tpu.training.data import (ArrayDataset, GeneratorDataset,
+from cloud_tpu.training.data import (ArrayDataset, DeviceResidentDataset,
+                                     GeneratorDataset, InputCast,
                                      NpzShardDataset, ThreadedDataset,
+                                     epoch_permutation, make_input_cast,
                                      prefetch_to_device)
 from cloud_tpu.training import schedules
 from cloud_tpu.training.trainer import (Trainer, TrainState,

@@ -10,9 +10,40 @@ from JAX async dispatch: the Trainer never blocks on device values inside
 the step loop, so batch i+1 is prepared while step i runs.
 """
 
+import logging
+import os
+
 import numpy as np
 
 import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger("cloud_tpu")
+
+
+def epoch_permutation(num_examples, seed, epoch):
+    """The canonical per-epoch shuffle order, shared host/device.
+
+    Both the host path (`ArrayDataset._epoch_order`) and the
+    device-resident executable (`Trainer._make_resident_run`) draw their
+    order from the same jax threefry stream:
+    `permutation(fold_in(PRNGKey(seed), epoch), num_examples)`. threefry
+    is bit-deterministic across backends, so `cache="device"` reproduces
+    the host path's batches exactly at a fixed seed (pinned by
+    tests/unit/test_resident_data.py). Computed on the CPU backend when
+    one is available so host-side epoch prep never dispatches through
+    the accelerator tunnel.
+    """
+    def _draw():
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), epoch)
+        return np.asarray(jax.random.permutation(key, num_examples))
+
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except (RuntimeError, ValueError):
+        return _draw()
+    with jax.default_device(cpu):
+        return _draw()
 
 
 class ArrayDataset:
@@ -72,11 +103,13 @@ class ArrayDataset:
         return -(-self.num_examples // self.batch_size)
 
     def _epoch_order(self):
-        order = np.arange(self.num_examples)
         if self.shuffle:
-            rng = np.random.default_rng(self.seed + self._epoch)
-            rng.shuffle(order)
-        return order
+            # Shared doctrine with the device-resident path: same seed,
+            # same epoch -> same permutation on every process and on
+            # either side of the wire (see epoch_permutation).
+            return epoch_permutation(self.num_examples, self.seed,
+                                     self._epoch)
+        return np.arange(self.num_examples)
 
     def __iter__(self):
         """Yields global (x, y) numpy batches for one epoch."""
@@ -122,6 +155,317 @@ class ArrayDataset:
             for batch in self:
                 yield jax.tree_util.tree_map(lambda a: a[lo:hi], batch)
         return _slices()
+
+
+class _LeafCast:
+    """Per-leaf transfer decision. A plain object (not a registered
+    pytree node) so a specs tree stays congruent with the feature tree
+    under tree_map."""
+
+    __slots__ = ("mode", "lo", "scale")
+
+    def __init__(self, mode, lo=None, scale=None):
+        self.mode = mode  # "keep" | "bf16" | "uint8"
+        self.lo = lo
+        self.scale = scale
+
+
+class InputCast:
+    """A narrow-on-the-wire transfer policy for feature batches.
+
+    The host narrows features before the H2D copy (`host_cast`); the
+    jitted train step widens them back to float32 as its first op
+    (`widen`), so the model always computes in its own dtype and only
+    the wire pays the narrow format:
+
+    - "bfloat16": float leaves cross as bf16 — 2x fewer bytes, ~3
+      decimal digits of mantissa, parameterless (works on streams).
+    - "uint8": float leaves cross as affine-quantized uint8 — 4x fewer
+      bytes; lo/scale are computed once from the full arrays, so this
+      policy needs an `ArrayDataset`. Data already on a 255-point grid
+      (images) round-trips exactly.
+
+    Integer/bool leaves are never touched. Build instances through
+    `make_input_cast`.
+    """
+
+    def __init__(self, name, specs):
+        self.name = name
+        self._specs = specs
+
+    @property
+    def cache_key(self):
+        """Hashable identity for jit-closure caches: `widen` is baked
+        into the compiled step, so steps must be cached per-policy."""
+        return (self.name,) + tuple(
+            (s.mode, s.lo, s.scale)
+            for s in jax.tree_util.tree_leaves(self._specs))
+
+    def host_cast(self, x):
+        """Narrows a host feature batch for the wire (numpy in/out)."""
+        def leaf(a, spec):
+            if spec.mode == "bf16":
+                return np.asarray(a).astype(jnp.bfloat16)
+            if spec.mode == "uint8":
+                q = np.round(
+                    (np.asarray(a, np.float32) - spec.lo) / spec.scale)
+                return np.clip(q, 0, 255).astype(np.uint8)
+            return a
+        return jax.tree_util.tree_map(leaf, x, self._specs)
+
+    def widen(self, x):
+        """Inverse of `host_cast`, traceable inside the jitted step."""
+        def leaf(a, spec):
+            if spec.mode == "bf16":
+                return a.astype(jnp.float32)
+            if spec.mode == "uint8":
+                return a.astype(jnp.float32) * spec.scale + spec.lo
+            return a
+        return jax.tree_util.tree_map(leaf, x, self._specs)
+
+    def cast_nbytes(self, x):
+        """Post-cast byte count of `x` (no materialization)."""
+        def leaf(a, spec):
+            if spec.mode == "bf16":
+                return a.size * 2
+            if spec.mode == "uint8":
+                return int(a.size)
+            return int(np.asarray(a).nbytes)
+        return sum(jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(leaf, x, self._specs)))
+
+
+def make_input_cast(policy, x):
+    """Builds an `InputCast` for feature tree `x`.
+
+    Args:
+        policy: None/"none" (returns None), "bfloat16"/"bf16", "uint8",
+            or an existing `InputCast` (passed through).
+        x: The feature tree the policy will apply to — the full arrays
+            for "uint8" (range calibration), any representative sample
+            for "bfloat16".
+    """
+    if policy is None or policy == "none":
+        return None
+    if isinstance(policy, InputCast):
+        return policy
+
+    def _is_float(a):
+        return np.issubdtype(np.asarray(a).dtype, np.floating)
+
+    if policy in ("bfloat16", "bf16"):
+        specs = jax.tree_util.tree_map(
+            lambda a: _LeafCast("bf16" if _is_float(a)
+                                and np.asarray(a).dtype.itemsize > 2
+                                else "keep"), x)
+        return InputCast("bfloat16", specs)
+    if policy == "uint8":
+        def spec(a):
+            if not _is_float(a):
+                return _LeafCast("keep")
+            a = np.asarray(a)
+            lo = float(a.min())
+            hi = float(a.max())
+            scale = (hi - lo) / 255.0 or 1.0
+            return _LeafCast("uint8", lo=lo, scale=scale)
+        return InputCast("uint8", jax.tree_util.tree_map(spec, x))
+    raise ValueError(
+        "Unknown input_cast {!r}; expected None, 'bfloat16' or "
+        "'uint8'.".format(policy))
+
+
+def _resident_hbm_budget():
+    """Per-device byte budget for the resident upload.
+
+    CLOUD_TPU_RESIDENT_HBM_BUDGET (bytes) overrides; otherwise 60% of
+    the device's reported bytes_limit (leaving room for params, grads,
+    moments and activations); None (no check) when the backend reports
+    nothing, as the virtual-CPU test backend doesn't.
+    """
+    env = os.environ.get("CLOUD_TPU_RESIDENT_HBM_BUDGET")
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            logger.warning("Ignoring malformed "
+                           "CLOUD_TPU_RESIDENT_HBM_BUDGET=%r", env)
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:  # backend without memory introspection
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit * 0.6) if limit else None
+
+
+class DeviceResidentDataset:
+    """An `ArrayDataset` uploaded to device HBM once.
+
+    Steady-state training then does ZERO host->device data transfers:
+    the Trainer's resident executable draws every batch in-graph from
+    the uploaded arrays with a device-side per-epoch permutation
+    (`epoch_permutation` doctrine) and `jnp.take` /
+    `lax.dynamic_slice`. Construct through `build()`, which applies the
+    HBM budget check and falls back (returns None, one-line warning)
+    instead of raising; `__init__` raises on structural problems.
+
+    Attributes:
+        data: Device-resident feature tree shaped like the dataset's
+            per-batch yields ((x, y, w), (x, y) or bare x) but with the
+            full example dimension.
+        sharding: Congruent tree of NamedShardings (None off-mesh):
+            leaves divisible by the dp axis are sharded on examples,
+            the rest replicated.
+        policy: The `InputCast` applied on upload (features stay narrow
+            in HBM; the resident step widens per batch), or None.
+        upload_bytes: Host bytes moved by the one-time upload.
+    """
+
+    def __init__(self, dataset, input_cast=None, mesh=None):
+        from cloud_tpu.parallel import runtime as runtime_lib
+
+        if not isinstance(dataset, ArrayDataset):
+            raise TypeError(
+                "DeviceResidentDataset needs an ArrayDataset (in-memory "
+                "arrays); got {!r}.".format(type(dataset).__name__))
+        if dataset.steps_per_epoch < 1:
+            raise ValueError(
+                "Dataset yields no full batch (num_examples={}, "
+                "batch_size={}).".format(dataset.num_examples,
+                                         dataset.batch_size))
+        if (not dataset.drop_remainder
+                and dataset.num_examples % dataset.batch_size):
+            raise ValueError(
+                "drop_remainder=False with a ragged tail pads batches on "
+                "the host; the resident path cannot reproduce that "
+                "in-graph.")
+        # The live dataset, not a copy: the resident fit loop reads and
+        # advances its `_epoch` counter so shuffled order stays in
+        # lockstep with (and resumable by) the host path.
+        self.source = dataset
+        self.num_examples = dataset.num_examples
+        self.batch_size = dataset.batch_size
+        self.steps_per_epoch = dataset.steps_per_epoch
+        self.shuffle = dataset.shuffle
+        self.seed = dataset.seed
+        self.policy = (input_cast if isinstance(input_cast, InputCast)
+                       or input_cast is None
+                       else make_input_cast(input_cast, dataset.x))
+
+        x = dataset.x if self.policy is None else self.policy.host_cast(
+            dataset.x)
+        if dataset.sample_weight is not None:
+            host = (x, dataset.y, dataset.sample_weight)
+            self.kind = "xyw"
+        elif dataset.y is None:
+            host = x
+            self.kind = "x"
+        else:
+            host = (x, dataset.y)
+            self.kind = "xy"
+
+        self.sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from cloud_tpu.parallel import sharding as sharding_lib
+
+            dp = dict(mesh.shape).get(sharding_lib.DATA_AXIS, 1)
+
+            def leaf_sharding(a):
+                if dp > 1 and a.shape[0] % dp == 0:
+                    return NamedSharding(mesh, P(sharding_lib.DATA_AXIS))
+                return NamedSharding(mesh, P())
+
+            self.sharding = jax.tree_util.tree_map(leaf_sharding, host)
+
+        self.upload_bytes = runtime_lib.record_h2d(host)
+        if self.sharding is None:
+            self.data = jax.tree_util.tree_map(jax.device_put, host)
+        elif jax.process_count() > 1:
+            # Every process holds the full arrays (the ArrayDataset
+            # multi-host contract: same global order everywhere), so
+            # each can serve any addressable shard by plain indexing.
+            self.data = jax.tree_util.tree_map(
+                lambda a, s: jax.make_array_from_callback(
+                    a.shape, s, lambda idx, a=a: a[idx]),
+                host, self.sharding)
+        else:
+            self.data = jax.tree_util.tree_map(
+                jax.device_put, host, self.sharding)
+
+    @classmethod
+    def build(cls, dataset, input_cast=None, mesh=None,
+              budget_bytes=None):
+        """Residency with graceful fallback.
+
+        Returns a `DeviceResidentDataset`, or None after ONE warning
+        line when the dataset can't live on device (not in-memory
+        arrays, no full batch, host-padded ragged tail, or over the
+        HBM budget) — the caller then streams from the host as usual.
+        """
+        def _fallback(why):
+            logger.warning(
+                "cache='device' unavailable (%s); streaming from "
+                "host instead.", why)
+            return None
+
+        if not isinstance(dataset, ArrayDataset):
+            return _fallback("needs in-memory arrays, got {}".format(
+                type(dataset).__name__))
+        if dataset.steps_per_epoch < 1:
+            return _fallback("dataset smaller than one batch")
+        if (not dataset.drop_remainder
+                and dataset.num_examples % dataset.batch_size):
+            return _fallback("ragged tail is host-padded")
+
+        policy = (input_cast if isinstance(input_cast, InputCast)
+                  or input_cast is None
+                  else make_input_cast(input_cast, dataset.x))
+        budget = (_resident_hbm_budget() if budget_bytes is None
+                  else budget_bytes)
+        if budget is not None:
+            need = cls._per_device_bytes(dataset, policy, mesh)
+            if need > budget:
+                return _fallback(
+                    "dataset needs {} bytes/device, budget {}".format(
+                        need, budget))
+        return cls(dataset, input_cast=policy, mesh=mesh)
+
+    @staticmethod
+    def _per_device_bytes(dataset, policy, mesh):
+        """Worst-device resident footprint after the input cast."""
+        dp = 1
+        if mesh is not None:
+            from cloud_tpu.parallel import sharding as sharding_lib
+
+            dp = dict(mesh.shape).get(sharding_lib.DATA_AXIS, 1) or 1
+
+        def nbytes(a, cast_bytes):
+            a = np.asarray(a)
+            per = cast_bytes if cast_bytes is not None else a.nbytes
+            return per // dp if dp > 1 and a.shape[0] % dp == 0 else per
+
+        total = 0
+        if policy is not None:
+            specs = policy._specs
+            flat_x = jax.tree_util.tree_leaves(dataset.x)
+            flat_s = jax.tree_util.tree_leaves(specs)
+            for a, s in zip(flat_x, flat_s):
+                a = np.asarray(a)
+                if s.mode == "bf16":
+                    per = a.size * 2
+                elif s.mode == "uint8":
+                    per = int(a.size)
+                else:
+                    per = None
+                total += nbytes(a, per)
+        else:
+            for a in jax.tree_util.tree_leaves(dataset.x):
+                total += nbytes(a, None)
+        for extra in (dataset.y, dataset.sample_weight):
+            if extra is not None:
+                total += nbytes(extra, None)
+        return total
 
 
 def as_dataset(data, y=None, batch_size=32, **kwargs):
@@ -349,6 +693,9 @@ def prefetch_to_device(iterator, size=2, sharding=None, feed=None,
 
     if feed is None:
         def feed(batch):
+            from cloud_tpu.parallel import runtime as runtime_lib
+
+            runtime_lib.record_h2d(batch)
             if sharding is None:
                 return jax.device_put(batch)
             return jax.tree_util.tree_map(

@@ -631,15 +631,37 @@ class Trainer:
 
     # -- jitted steps ---------------------------------------------------
 
-    def _make_train_step_body(self, weighted=False):
+    @staticmethod
+    def _batch_widener(policy, weighted):
+        """In-graph inverse of the `input_cast` host narrowing: widens
+        the features slot of a train batch back to float32 as the
+        step's first op, so the model computes in its own dtype and
+        only the wire (or resident HBM storage) pays the narrow
+        format. None when no policy is active."""
+        if policy is None:
+            return None
+        if weighted:
+            def widen(batch):
+                x, y, w = batch
+                return (policy.widen(x), y, w)
+        else:
+            def widen(batch):
+                x, y = batch
+                return (policy.widen(x), y)
+        return widen
+
+    def _make_train_step_body(self, weighted=False, widen=None):
         """The raw (unjitted) train step closure — the single source of
-        truth shared by the jitted single-step path and the
-        steps_per_execution scan.
+        truth shared by the jitted single-step path, the
+        steps_per_execution scan and the device-resident executable.
 
         weighted: batches are (x, y, sample_weight) triples — the
         loss is the weighted batch mean (Keras sum-over-batch-size
         semantics: mean(per_example * w)) and per-example metrics are
-        weighted means (sum(v*w)/sum(w))."""
+        weighted means (sum(v*w)/sum(w)).
+
+        widen: optional in-graph batch transform (`_batch_widener`)
+        restoring input_cast-narrowed features to float32."""
         metric_fns = self.metric_fns
         loss_fn = self.loss_fn
         optimizer = self.optimizer
@@ -655,6 +677,8 @@ class Trainer:
         train_scalar_unmasked = self._train_scalar_unmasked = set()
 
         def train_step(state, batch):
+            if widen is not None:
+                batch = widen(batch)
             if weighted:
                 x, y, w = batch
                 w = w.astype(jnp.float32)
@@ -764,8 +788,9 @@ class Trainer:
 
         return train_step
 
-    def _make_train_step(self, weighted=False):
-        train_step = self._make_train_step_body(weighted=weighted)
+    def _make_train_step(self, weighted=False, widen=None):
+        train_step = self._make_train_step_body(weighted=weighted,
+                                                widen=widen)
         if self._mesh is None:
             return jax.jit(train_step, donate_argnums=0)
         batch_sharding = sharding_lib.batch_sharding(self._mesh)
@@ -777,7 +802,30 @@ class Trainer:
             out_shardings=(self._state_sharding, None),
             donate_argnums=0)
 
-    def _make_multi_train_step(self, num_steps, weighted=False):
+    @staticmethod
+    def _reduce_scan_logs(logs_seq):
+        """Group-level aggregation of scanned per-step logs ([num_steps]
+        leaves) — shared by the steps_per_execution executable and the
+        device-resident executable.
+
+        Weighted groups: each step's metric is a weighted mean over
+        that step's batch; the group value re-weights by the per-step
+        weight sums (same identity the epoch aggregation uses). Loss
+        keeps sum-over-batch-size semantics (plain mean)."""
+        if "_batch_weight" in logs_seq:
+            ws = logs_seq["_batch_weight"]
+            logs = {}
+            for k, v in logs_seq.items():
+                if k == "_batch_weight":
+                    continue
+                logs[k] = (jnp.mean(v) if k == "loss"
+                           else _weighted_mean(v, ws))
+            logs["_batch_weight"] = jnp.sum(ws)
+            return logs
+        return {k: jnp.mean(v) for k, v in logs_seq.items()}
+
+    def _make_multi_train_step(self, num_steps, weighted=False,
+                               widen=None):
         """ONE XLA executable running `num_steps` optimizer steps via
         `lax.scan` over a leading step axis of stacked batches
         ([num_steps, B, ...] leaves) — Keras `steps_per_execution`,
@@ -790,7 +838,8 @@ class Trainer:
         aggregation stays exact).
         """
         del num_steps  # shape comes from the stacked batch leaves
-        inner = self._make_train_step_body(weighted=weighted)
+        inner = self._make_train_step_body(weighted=weighted,
+                                           widen=widen)
 
         def multi_step(state, batches):
             def body(s, batch):
@@ -798,23 +847,7 @@ class Trainer:
                 return s, logs
 
             state, logs_seq = jax.lax.scan(body, state, batches)
-            if "_batch_weight" in logs_seq:
-                # Weighted group: each step's metric is a weighted mean
-                # over that step's batch; the group value re-weights by
-                # the per-step weight sums (same identity the epoch
-                # aggregation uses). Loss keeps sum-over-batch-size
-                # semantics (plain mean).
-                ws = logs_seq["_batch_weight"]
-                logs = {}
-                for k, v in logs_seq.items():
-                    if k == "_batch_weight":
-                        continue
-                    logs[k] = (jnp.mean(v) if k == "loss"
-                               else _weighted_mean(v, ws))
-                logs["_batch_weight"] = jnp.sum(ws)
-            else:
-                logs = {k: jnp.mean(v) for k, v in logs_seq.items()}
-            return state, logs
+            return state, self._reduce_scan_logs(logs_seq)
 
         if self._mesh is None:
             return jax.jit(multi_step, donate_argnums=0)
@@ -826,6 +859,74 @@ class Trainer:
         return jax.jit(
             multi_step,
             in_shardings=(self._state_sharding, batch_in),
+            out_shardings=(self._state_sharding, None),
+            donate_argnums=0)
+
+    def _make_resident_run(self, num_steps, steps_per_epoch, resident,
+                           weighted):
+        """ONE XLA executable advancing `num_steps` optimizer steps
+        with ALL data already in HBM (`DeviceResidentDataset`).
+
+        The within-epoch position is derived in-graph from
+        `state.step` relative to `base_step` (the step counter at
+        epoch entry); the epoch index arrives as `epoch_idx`. Both are
+        device scalars, so a call never syncs the host. `epoch_idx` is
+        kept in lockstep with the source dataset's `_epoch` counter by
+        the fit loop — the host path's shape-inference peek consumes
+        one epoch of that counter, and matching it here is what makes
+        shuffled resident batches bit-identical to the host path's.
+        Shuffled runs rebuild the epoch's permutation with the exact
+        `epoch_permutation` doctrine the host path uses (threefry is
+        bit-deterministic across backends), then draw each batch with
+        `dynamic_slice` of the permutation + `jnp.take`; unshuffled
+        runs are a contiguous `dynamic_slice` of the data. The fit
+        loop guarantees a call never straddles an epoch boundary (the
+        permutation is computed once per call).
+        """
+        inner = self._make_train_step_body(
+            weighted=weighted,
+            widen=self._batch_widener(resident.policy, weighted))
+        batch_size = resident.batch_size
+        num_examples = resident.num_examples
+        shuffle = resident.shuffle
+        seed = resident.seed
+
+        def run(state, data, base_step, epoch_idx):
+            if shuffle:
+                key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                         epoch_idx)
+                perm = jax.random.permutation(key, num_examples)
+            else:
+                perm = None
+
+            def one_step(s):
+                pos = (s.step - base_step) % steps_per_epoch
+                start = pos * batch_size
+                if perm is not None:
+                    idx = jax.lax.dynamic_slice_in_dim(perm, start,
+                                                       batch_size)
+                    batch = jax.tree_util.tree_map(
+                        lambda a: jnp.take(a, idx, axis=0), data)
+                else:
+                    batch = jax.tree_util.tree_map(
+                        lambda a: jax.lax.dynamic_slice_in_dim(
+                            a, start, batch_size), data)
+                return inner(s, batch)
+
+            if num_steps == 1:
+                return one_step(state)
+            state, logs_seq = jax.lax.scan(
+                lambda s, _: one_step(s), state, None,
+                length=num_steps)
+            return state, self._reduce_scan_logs(logs_seq)
+
+        if self._mesh is None:
+            return jax.jit(run, donate_argnums=0)
+        return jax.jit(
+            run,
+            in_shardings=(self._state_sharding, resident.sharding,
+                          sharding_lib.replicated(self._mesh),
+                          sharding_lib.replicated(self._mesh)),
             out_shardings=(self._state_sharding, None),
             donate_argnums=0)
 
@@ -923,6 +1024,7 @@ class Trainer:
             # fp32 batch re-sent every step costs seconds, measured 20x
             # the whole train step), and (b) keeps feeding semantics
             # uniform with the mesh path below.
+            runtime.record_h2d(batch)
             return jax.device_put(batch)
         if jax.process_count() > 1:
             return sharding_lib.make_global_batch(batch, self._mesh)
@@ -939,6 +1041,27 @@ class Trainer:
                 and hasattr(dataset, "process_local_view")):
             return dataset.process_local_view()
         return iter(dataset)
+
+    def _host_batches(self, dataset, cast):
+        """One epoch of host batches with the `input_cast` narrowing
+        applied to the features slot — bytes on the wire drop 2x
+        (bfloat16) or 4x (uint8); the jitted step's widener restores
+        float32 in-graph."""
+        batches = self._epoch_batches(dataset)
+        if cast is None:
+            return batches
+
+        def narrowed():
+            for batch in batches:
+                if isinstance(batch, tuple) and len(batch) == 3:
+                    x, y, w = batch
+                    yield (cast.host_cast(x), y, w)
+                elif isinstance(batch, tuple) and len(batch) == 2:
+                    x, y = batch
+                    yield (cast.host_cast(x), y)
+                else:
+                    yield cast.host_cast(batch)
+        return narrowed()
 
     def _grouped_host_batches(self, batches, limit, spe):
         """Yields ("multi", n, stacked_group) for each full group of
@@ -981,12 +1104,14 @@ class Trainer:
         if kind == "single":
             return self._feed(batch)
         if self._mesh is None:
+            runtime.record_h2d(batch)
             return jax.device_put(batch)
         bs = sharding_lib.batch_sharding(self._mesh)
         stacked = NamedSharding(self._mesh, P(None, *bs.spec))
         if jax.process_count() > 1:
             return sharding_lib.make_global_batch(batch,
                                                   sharding=stacked)
+        runtime.record_h2d(batch)
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(a, stacked), batch)
 
@@ -1021,8 +1146,28 @@ class Trainer:
             resume_from=None,
             prefetch=2,
             sample_weight=None,
-            class_weight=None):
+            class_weight=None,
+            cache=None,
+            input_cast=None):
         """Trains the model; returns a history dict of per-epoch logs.
+
+        cache: "device" uploads the whole dataset to device HBM ONCE
+        and draws every batch in-graph (device-side per-epoch
+        permutation + dynamic_slice/take): steady-state training does
+        zero host->device data transfers while keeping `shuffle=True`
+        semantics (same threefry permutation as the host path) and
+        composing with steps_per_execution and gradient accumulation.
+        Array inputs that fit the HBM budget only — anything else
+        falls back to host streaming with one warning line (see
+        data.DeviceResidentDataset.build).
+
+        input_cast: Transfer policy narrowing features on the wire —
+        "bfloat16" (2x fewer bytes, works on any input) or "uint8"
+        (4x fewer bytes, affine-quantized; array inputs only, since
+        lo/scale calibrate on the full arrays). The jitted step widens
+        back to float32 in-graph, so the model's compute dtype is
+        unchanged. Composes with cache="device" (the resident copy
+        stays narrow in HBM).
 
         prefetch: Device read-ahead depth — `prefetch` batches are kept
         in flight ahead of the one being consumed (up to prefetch+1
@@ -1147,30 +1292,64 @@ class Trainer:
                                                     self.state)
                 logger.info("Resumed training from %s at step %d.",
                             resume_from, int(self.state.step))
-        # Two-slot cache: alternating weighted/unweighted fits reuse
-        # each compiled variant instead of re-tracing on every flip.
-        # Each slot carries its scalar-unmasked set (written by that
+
+        policy = None
+        if input_cast not in (None, "none"):
+            if isinstance(dataset, data_lib.ArrayDataset):
+                policy = data_lib.make_input_cast(input_cast, dataset.x)
+            elif (input_cast in ("bfloat16", "bf16")
+                  or isinstance(input_cast, data_lib.InputCast)):
+                # Parameterless policies calibrate from the sample.
+                policy = data_lib.make_input_cast(input_cast, sample_x)
+            else:
+                raise ValueError(
+                    "input_cast='uint8' calibrates lo/scale from the "
+                    "full arrays and needs array inputs; streaming "
+                    "datasets support input_cast='bfloat16'.")
+
+        resident = None
+        if cache not in (None, "none", False):
+            if cache != "device":
+                raise ValueError(
+                    "Unknown cache={!r}; expected 'device'.".format(
+                        cache))
+            resident = data_lib.DeviceResidentDataset.build(
+                dataset, input_cast=policy, mesh=self._mesh)
+
+        # Step cache: alternating weighted/unweighted fits reuse each
+        # compiled variant instead of re-tracing on every flip (bare
+        # bool keys; input_cast fits get (weighted, policy) tuple keys
+        # because the widener is baked into the compiled step). Each
+        # slot carries its scalar-unmasked set (written by that
         # variant's trace), so switching variants re-points the guard
         # _fit_epochs reads rather than leaking the other slot's names.
-        cache = getattr(self, "_train_step_cache", None)
-        if cache is None:
-            cache = self._train_step_cache = {}
-        if weighted not in cache:
-            step = self._make_train_step(weighted=weighted)
-            cache[weighted] = (step, self._train_scalar_unmasked)
-        self._jit_train_step, scalar_set = cache[weighted]
-        self._train_scalar_unmasked = scalar_set if weighted else set()
+        # Resident fits build their own executables per fit (the
+        # permutation geometry is baked in) and skip these caches.
+        if resident is None:
+            key = (weighted if policy is None
+                   else (weighted, policy.cache_key))
+            widen = self._batch_widener(policy, weighted)
+            step_cache = getattr(self, "_train_step_cache", None)
+            if step_cache is None:
+                step_cache = self._train_step_cache = {}
+            if key not in step_cache:
+                step = self._make_train_step(weighted=weighted,
+                                             widen=widen)
+                step_cache[key] = (step, self._train_scalar_unmasked)
+            self._jit_train_step, scalar_set = step_cache[key]
+            self._train_scalar_unmasked = (scalar_set if weighted
+                                           else set())
 
-        spe = self.steps_per_execution
-        self._jit_multi_step = None
-        if spe > 1:
-            mcache = getattr(self, "_multi_step_cache", None)
-            if mcache is None:
-                mcache = self._multi_step_cache = {}
-            if weighted not in mcache:
-                mcache[weighted] = self._make_multi_train_step(
-                    spe, weighted=weighted)
-            self._jit_multi_step = mcache[weighted]
+            spe = self.steps_per_execution
+            self._jit_multi_step = None
+            if spe > 1:
+                mcache = getattr(self, "_multi_step_cache", None)
+                if mcache is None:
+                    mcache = self._multi_step_cache = {}
+                if key not in mcache:
+                    mcache[key] = self._make_multi_train_step(
+                        spe, weighted=weighted, widen=widen)
+                self._jit_multi_step = mcache[key]
 
         history = {}
         self.stop_training = False
@@ -1185,10 +1364,17 @@ class Trainer:
             cb.on_train_begin()
 
         try:
-            self._fit_epochs(dataset, epochs, steps_per_epoch,
-                             validation_data, batch_size, callbacks,
-                             history, verbose, prefetch,
-                             initial_epoch=initial_epoch)
+            if resident is not None:
+                self._fit_epochs_resident(
+                    resident, epochs, steps_per_epoch, validation_data,
+                    batch_size, callbacks, history, verbose, prefetch,
+                    initial_epoch=initial_epoch)
+            else:
+                self._fit_epochs(dataset, epochs, steps_per_epoch,
+                                 validation_data, batch_size, callbacks,
+                                 history, verbose, prefetch,
+                                 initial_epoch=initial_epoch,
+                                 cast=policy)
         finally:
             # Guaranteed even when a train step raises (OOM, interrupt):
             # callbacks holding external resources (profiler traces,
@@ -1226,7 +1412,7 @@ class Trainer:
 
     def _fit_epochs(self, dataset, epochs, steps_per_epoch,
                     validation_data, batch_size, callbacks, history,
-                    verbose, prefetch=2, initial_epoch=0):
+                    verbose, prefetch=2, initial_epoch=0, cast=None):
         for epoch in range(initial_epoch, epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
@@ -1239,8 +1425,8 @@ class Trainer:
             if spe > 1 and multi_step is not None:
                 feeder = data_lib.prefetch_to_device(
                     self._grouped_host_batches(
-                        self._epoch_batches(dataset), steps_per_epoch,
-                        spe),
+                        self._host_batches(dataset, cast),
+                        steps_per_epoch, spe),
                     size=prefetch,
                     feed=lambda item: (item[0], item[1],
                                        self._feed_grouped(item)))
@@ -1299,7 +1485,7 @@ class Trainer:
                     break
                 continue
             feeder = self._prefetch_batches(
-                self._epoch_batches(dataset), limit=steps_per_epoch,
+                self._host_batches(dataset, cast), limit=steps_per_epoch,
                 size=prefetch)
             for batch_examples, batch in feeder:
                 if self._abort_epoch:
@@ -1326,6 +1512,110 @@ class Trainer:
             if not (self._abort_epoch and count == 0):
                 # Same zero-step-abort guard as the multi-step path.
                 self._post_epoch_logs(step_logs, count, examples, t0,
+                                      epoch, validation_data,
+                                      batch_size, callbacks, history,
+                                      verbose, prefetch)
+            if self.stop_training:
+                break
+
+    def _fit_epochs_resident(self, resident, epochs, steps_per_epoch,
+                             validation_data, batch_size, callbacks,
+                             history, verbose, prefetch=2,
+                             initial_epoch=0):
+        """The device-resident fit loop: every batch is drawn in-graph
+        from `resident.data`, so the epoch loop issues executable calls
+        only — ZERO per-step host->device data transfers (pinned by
+        tests/unit/test_resident_data.py via runtime.transfer_stats).
+
+        steps_per_execution composes: full groups of `spe` steps run in
+        one dispatch; a ragged tail (steps_per_epoch % spe) runs
+        through a second executable with its own baked scan length, so
+        a call never straddles an epoch boundary (the in-graph
+        permutation is derived once per call).
+        """
+        weighted = resident.kind == "xyw"
+        steps = resident.steps_per_epoch
+        if steps_per_epoch is not None:
+            steps = min(steps, int(steps_per_epoch))
+        spe = min(self.steps_per_execution, steps)
+        n_groups, leftover = divmod(steps, spe)
+        # Each executable build re-points self._train_scalar_unmasked
+        # at a fresh set (populated at trace time); keep a reference to
+        # every build's set so the first-step guard below sees whichever
+        # executable traced first.
+        scalar_sets = []
+        run_group = run_tail = None
+        if n_groups:
+            run_group = self._make_resident_run(spe, steps, resident,
+                                                weighted)
+            scalar_sets.append(self._train_scalar_unmasked)
+        if leftover:
+            run_tail = self._make_resident_run(leftover, steps,
+                                               resident, weighted)
+            scalar_sets.append(self._train_scalar_unmasked)
+        # The epoch index lives on device and is advanced there (one
+        # tiny add per epoch, no transfer); it starts from the source
+        # dataset's `_epoch` counter so shuffled order matches the
+        # host path exactly (fit's shape-inference peek has already
+        # consumed one epoch of that counter) and keeps advancing it,
+        # so a later host-path fit on the same dataset resumes the
+        # shuffle stream where this one left off.
+        src = resident.source
+        ep_idx = jnp.asarray(getattr(src, "_epoch", 0), dtype=jnp.int32)
+        if self._mesh is not None:
+            ep_idx = jax.device_put(ep_idx,
+                                    sharding_lib.replicated(self._mesh))
+        data = resident.data
+        first_epoch = True
+
+        for epoch in range(initial_epoch, epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            if not first_epoch:
+                ep_idx = ep_idx + 1
+            first_epoch = False
+            if hasattr(src, "_epoch"):
+                src._epoch += 1
+            # Position arithmetic is relative to the step counter at
+            # EPOCH entry (a mid-epoch abort leaves step partially
+            # advanced; re-basing keeps the next epoch's positions at
+            # 0..steps-1). A REAL copy: each call donates the state
+            # (and with it the live step buffer).
+            base = jnp.array(self.state.step, copy=True)
+            if self._mesh is not None:
+                base = jax.device_put(
+                    base, sharding_lib.replicated(self._mesh))
+            step_logs = []
+            count = 0
+            t0 = time.time()
+            calls = [(run_group, spe)] * n_groups
+            if leftover:
+                calls.append((run_tail, leftover))
+            for run, n_steps in calls:
+                if self._abort_epoch:
+                    break
+                self.state, logs = run(self.state, data, base, ep_idx)
+                if "_batch_weight" in logs:
+                    if n_steps > 1:
+                        # Same group-entry semantics as the
+                        # steps_per_execution path (_fit_epochs).
+                        logs = dict(logs)
+                        logs["_steps"] = n_steps
+                    step_logs.append(logs)
+                else:
+                    step_logs.extend([logs] * n_steps)
+                if (count == 0 and epoch == initial_epoch
+                        and any(scalar_sets)):
+                    raise ValueError(
+                        "Custom metrics {} return a scalar and cannot "
+                        "apply sample_weight. Give them a mask-aware "
+                        "signature fn(outputs, y, mask=...) or return "
+                        "per-example values.".format(
+                            sorted(set().union(*scalar_sets))))
+                count += n_steps
+            if not (self._abort_epoch and count == 0):
+                self._post_epoch_logs(step_logs, count,
+                                      count * resident.batch_size, t0,
                                       epoch, validation_data,
                                       batch_size, callbacks, history,
                                       verbose, prefetch)

@@ -11,6 +11,7 @@ must keep annotations attached to anything it replays.
 import importlib.util
 import json
 import os
+import sys
 import types
 
 import pytest
@@ -165,15 +166,33 @@ def test_worker_inherits_pin_provenance(monkeypatch):
     """The worker subprocess sees pin-applied keys as explicitly-set
     env; BENCH_PIN_APPLIED (exported by the parent's pin loop) must
     carry the provenance across so worker-captured records still list
-    `pinned` honestly."""
+    `pinned` honestly. Only worker mode (--worker in argv) may trust
+    the inherited marker — simulate it."""
     monkeypatch.setenv("BENCH_IGNORE_PIN", "1")
     monkeypatch.setenv("BENCH_PIN_APPLIED", "BENCH_SPE,BENCH_BATCH")
+    monkeypatch.setattr(sys, "argv", [sys.argv[0], "--worker"])
     spec = importlib.util.spec_from_file_location(
         "bench_pin_inherit", os.path.abspath(_BENCH_PATH))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod._requested_config()["pinned"] == [
         "BENCH_SPE", "BENCH_BATCH"]
+
+
+def test_parent_clears_inherited_pin_provenance(monkeypatch):
+    """BENCH_PIN_APPLIED is a parent->worker handoff, not user
+    configuration: a PARENT invocation that inherits a stale marker
+    from an outer shell or driver must clear it at startup instead of
+    mislabeling explicitly-set knobs as pinned."""
+    monkeypatch.setenv("BENCH_IGNORE_PIN", "1")
+    monkeypatch.setenv("BENCH_PIN_APPLIED", "BENCH_SPE,BENCH_BATCH")
+    monkeypatch.setattr(sys, "argv", [sys.argv[0]])
+    spec = importlib.util.spec_from_file_location(
+        "bench_pin_parent", os.path.abspath(_BENCH_PATH))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "pinned" not in mod._requested_config()
+    assert "BENCH_PIN_APPLIED" not in os.environ
 
 
 class TestCrashedWorker:
