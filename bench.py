@@ -193,6 +193,13 @@ def _metric_name():
         name += "_bf16in"
     if os.environ.get("BENCH_RESIDENT", "0") == "1":
         name += "_res"
+    if os.environ.get("BENCH_ASYNC_LOG", "0") == "1":
+        # Async-host-loop contrast series: the timed loop hands its
+        # per-chunk loss to the background metric reader instead of
+        # sync-fetching it, so the sync-elimination win is its own
+        # metric. Never pinned (like _res: a different host-loop
+        # regime, not a fair-game knob of the flagship series).
+        name += "_async"
     return name
 
 
@@ -404,6 +411,8 @@ def _requested_config():
     # a base-series stale re-serve.
     if os.environ.get("BENCH_RESIDENT", "0") == "1":
         cfg["resident"] = True
+    if os.environ.get("BENCH_ASYNC_LOG", "0") == "1":
+        cfg["async_log"] = True
     for key in ("CLOUD_TPU_FLASH_BLOCK_Q", "CLOUD_TPU_FLASH_BLOCK_K"):
         if os.environ.get(key):
             cfg[key.lower()] = _env_int(key, 0)
@@ -480,6 +489,16 @@ def _emit_fallback(last_err, extra=None):
         "error": last_err,
         "requested_config": requested,
     }
+    # Counter fields ride every emission (worker records carry the
+    # timed loop's real census; this error path reports the driver's
+    # own — honestly zero, nothing was fetched in this process).
+    try:
+        from cloud_tpu.parallel import runtime as _runtime
+        stats = _runtime.transfer_stats()
+        record["d2h_fetches"] = stats["d2h_fetches"]
+        record["d2h_bytes"] = stats["d2h_bytes"]
+    except Exception:  # partial checkout must not sink the fallback
+        pass
     record.update(extra or {})
     _print_record(record)
 
@@ -697,8 +716,9 @@ def worker():
     # not the tunnel. BENCH_SPE=1 preserves the round-2 methodology.
     spe = max(_env_int("BENCH_SPE", 1), 1)
     resident_mode = os.environ.get("BENCH_RESIDENT", "0") == "1"
+    async_log = os.environ.get("BENCH_ASYNC_LOG", "0") == "1"
     resident = None
-    runtime_lib = None
+    from cloud_tpu.parallel import runtime as runtime_lib
     if resident_mode:
         # _res series: measure the Trainer's actual device-resident
         # executable — per-epoch threefry permutation + in-graph
@@ -708,7 +728,6 @@ def worker():
         # steady-state host->device bytes.
         import jax.numpy as jnp
 
-        from cloud_tpu.parallel import runtime as runtime_lib
         from cloud_tpu.training.data import (ArrayDataset,
                                              DeviceResidentDataset)
         n_examples = max(
@@ -772,29 +791,62 @@ def worker():
         before execution finishes (measured: an 8192^3 matmul "completes"
         in 36us = 30 PFLOP/s), so only a device->host value fetch is an
         honest sync point. Costs one ~66ms tunnel round-trip per call —
-        paid once per chunk, amortized over CHUNK steps.
+        paid once per chunk, amortized over CHUNK steps. Routed through
+        runtime.device_fetch so the record's d2h counters census every
+        fetch the timed loop performs.
         """
-        return float(jax.device_get(logs["loss"]))
+        return float(runtime_lib.device_fetch(logs["loss"]))
 
     for _ in range(WARMUP_STEPS):
         state, logs = step_fn(state, *step_inputs)
     if WARMUP_STEPS:
         sync(logs)
 
-    # Median contiguous chunk: robust to one-off stalls of the shared
-    # chip tunnel (which measure the tunnel, not the step) while still
-    # reporting sustained — not peak — throughput, comparable with the
-    # sustained-average baseline.
-    chunk_times = []
-    for _ in range(max(TIMED_STEPS // CHUNK, 1)):
-        t0 = time.perf_counter()
-        for _ in range(CHUNK):
-            state, logs = step_fn(state, *step_inputs)
-        sync(logs)
-        chunk_times.append(time.perf_counter() - t0)
-    median_elapsed = sorted(chunk_times)[len(chunk_times) // 2]
+    # Steady-state d2h census covers the timed loop only: delta against
+    # this snapshot, NOT a reset — the _res series' h2d fields need the
+    # counters running since their pre-upload reset.
+    _d2h_before = runtime_lib.transfer_stats()
+    n_chunks = max(TIMED_STEPS // CHUNK, 1)
+    if async_log:
+        # _async series: the chunk loop never sync-fetches — each
+        # chunk's loss goes to the background metric reader
+        # (one coalesced off-thread fetch per chunk, the Trainer's
+        # async_logging regime) and the loop runs on. Timing the WHOLE
+        # loop through drain() is honest despite the early-acking
+        # tunnel: the last chunk's fetched VALUE depends on the entire
+        # donated-state chain, so the clock can't stop before every
+        # step has truly executed. Median-chunk doesn't apply (there is
+        # no per-chunk barrier to time against) — method says so.
+        from cloud_tpu.training.async_logs import AsyncMetricReader
 
-    images_per_sec = BATCH * CHUNK * spe / median_elapsed
+        reader = AsyncMetricReader()
+        futures = []
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            for _ in range(CHUNK):
+                state, logs = step_fn(state, *step_inputs)
+            futures.append(reader.submit({"loss": logs["loss"]}))
+        reader.drain()
+        futures[-1].result()
+        total_elapsed = time.perf_counter() - t0
+        reader.close()
+        method = "async_total"
+        images_per_sec = BATCH * CHUNK * n_chunks * spe / total_elapsed
+    else:
+        # Median contiguous chunk: robust to one-off stalls of the
+        # shared chip tunnel (which measure the tunnel, not the step)
+        # while still reporting sustained — not peak — throughput,
+        # comparable with the sustained-average baseline.
+        chunk_times = []
+        for _ in range(n_chunks):
+            t0 = time.perf_counter()
+            for _ in range(CHUNK):
+                state, logs = step_fn(state, *step_inputs)
+            sync(logs)
+            chunk_times.append(time.perf_counter() - t0)
+        median_elapsed = sorted(chunk_times)[len(chunk_times) // 2]
+        method = "median_chunk"
+        images_per_sec = BATCH * CHUNK * spe / median_elapsed
     tflops = images_per_sec * RESNET50_GFLOPS_PER_IMAGE / 1000.0
     if xla_flops is not None:
         # cost_analysis counts a lax.scan/while body ONCE (verified on
@@ -804,14 +856,21 @@ def worker():
         # needed. dispatches/sec * per-dispatch flops = honest rate.
         dispatches_per_sec = images_per_sec / (BATCH * spe)
         tflops = dispatches_per_sec * (xla_flops * spe) / 1e12
+    _d2h_after = runtime_lib.transfer_stats()
     record = {
         "metric": _metric_name(),
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 3),
-        "method": "median_chunk",
+        "method": method,
         "chunk": CHUNK,
-        "steps": max(TIMED_STEPS // CHUNK, 1) * CHUNK * spe,
+        "steps": n_chunks * CHUNK * spe,
+        # The async-host-loop claim as numbers: device->host round
+        # trips the timed loop performed (one coalesced fetch per
+        # chunk in both regimes; _async just takes them off-thread).
+        "d2h_fetches": (_d2h_after["d2h_fetches"]
+                        - _d2h_before["d2h_fetches"]),
+        "d2h_bytes": _d2h_after["d2h_bytes"] - _d2h_before["d2h_bytes"],
         "batch": BATCH,
         "image": IMAGE,
         "platform": jax.default_backend(),
@@ -827,6 +886,8 @@ def worker():
         record["xla_flops_per_dispatch"] = xla_flops
     if spe > 1:
         record["steps_per_execution"] = spe
+    if async_log:
+        record["async_log"] = True
     if s2d:
         record["stem"] = "space_to_depth"
     if bf16_input:

@@ -14,6 +14,7 @@ measurable.
 
 Usage: python benchmarks/sweep.py [--batches 256,512,128] [--s2d 0,1]
        [--spe 5,10,1] [--bf16-input 0,1] [--resident 0,1]
+       [--async-log 0,1]
 """
 
 import argparse
@@ -28,7 +29,8 @@ BENCH = os.path.join(_REPO_ROOT, "bench.py")
 from _subproc import point_lock, run_json_point
 
 
-def run_point(batch, s2d, spe, timeout, bf16_input=0, resident=0):
+def run_point(batch, s2d, spe, timeout, bf16_input=0, resident=0,
+              async_log=0):
     env = dict(
         os.environ,
         BENCH_BATCH=str(batch),
@@ -36,6 +38,7 @@ def run_point(batch, s2d, spe, timeout, bf16_input=0, resident=0):
         BENCH_SPE=str(spe),
         BENCH_BF16_INPUT=str(bf16_input),
         BENCH_RESIDENT=str(resident),
+        BENCH_ASYNC_LOG=str(async_log),
         # The parity smoke belongs to the flagship bench.py run, not to
         # every sweep point (~30s apiece); the worker's persistent
         # compilation cache (benchmarks/.jax_cache) still makes repeat
@@ -43,7 +46,7 @@ def run_point(batch, s2d, spe, timeout, bf16_input=0, resident=0):
         BENCH_SKIP_KERNEL_PARITY="1",
     )
     point = {"batch": batch, "s2d": s2d, "spe": spe,
-             "resident": resident}
+             "resident": resident, "async_log": async_log}
     # Per-POINT chip lock: between points the flock is free, so a
     # concurrent flagship bench.py grabs the chip within one point's
     # duration instead of waiting out the whole sweep.
@@ -82,6 +85,13 @@ def main(argv=None):
     # never pinned (--write-pin) — it measures a different feeding
     # regime, not a fair-game knob of the flagship series.
     parser.add_argument("--resident", default="0,1")
+    # Async host loop (bench.py _async series): the timed loop hands
+    # per-chunk losses to the background metric reader instead of
+    # sync-fetching them. Default OFF in the sweep grid (it measures
+    # the host-loop regime, not a chip knob; the flagship bench.py run
+    # records the contrast) — pass --async-log 0,1 to sweep it. Never
+    # pinned, like --resident.
+    parser.add_argument("--async-log", default="0")
     parser.add_argument("--timeout", type=float, default=480.0)
     parser.add_argument("--write-pin", action="store_true",
                         help="write benchmarks/best_pin.json with the "
@@ -102,17 +112,20 @@ def main(argv=None):
                 for bf16 in [int(v) for v in args.bf16_input.split(",")]:
                     for res in [int(v)
                                 for v in args.resident.split(",")]:
-                        record = run_point(batch, s2d, spe,
-                                           args.timeout,
-                                           bf16_input=bf16,
-                                           resident=res)
-                        record.setdefault("bf16_input", bf16)
-                        print(json.dumps(record), flush=True)
-                        records.append(record)
-                        if "error" not in record and (
-                                best is None
-                                or record["value"] > best["value"]):
-                            best = record
+                        for al in [int(v)
+                                   for v in args.async_log.split(",")]:
+                            record = run_point(batch, s2d, spe,
+                                               args.timeout,
+                                               bf16_input=bf16,
+                                               resident=res,
+                                               async_log=al)
+                            record.setdefault("bf16_input", bf16)
+                            print(json.dumps(record), flush=True)
+                            records.append(record)
+                            if "error" not in record and (
+                                    best is None
+                                    or record["value"] > best["value"]):
+                                best = record
     if best is None:
         print(json.dumps({"sweep": "failed",
                           "hint": "backend unreachable for every point"}))
@@ -135,11 +148,12 @@ def main(argv=None):
         # nothing about the flagship and could even OOM it.
         flagship = [r for r in records
                     if "error" not in r and not r.get("s2d")
-                    and not r.get("resident")]
+                    and not r.get("resident")
+                    and not r.get("async_log")]
         if not flagship:
             print(json.dumps({"pin_written": None,
                               "hint": "no green s2d=0 resident=0 "
-                                      "point"}))
+                                      "async_log=0 point"}))
             return 0
         fbest = max(flagship, key=lambda r: r["value"])
         fair = {"BENCH_BATCH": fbest["batch"],

@@ -341,18 +341,29 @@ def reset():
 
 
 # --------------------------------------------------------------------------
-# Host->device transfer observability.
+# Host<->device transfer observability.
 #
-# Every feed-path entry point (sharding.shard_batch / make_global_batch,
-# Trainer's no-mesh device_put branches, prefetch_to_device's default feed,
-# and the DeviceResidentDataset one-time upload) records what it is about
-# to move. Tests and bench.py assert transfer behavior from these counters
+# H2D: every feed-path entry point (sharding.shard_batch /
+# make_global_batch, Trainer's no-mesh device_put branches,
+# prefetch_to_device's default feed, and the DeviceResidentDataset
+# one-time upload) records what it is about to move.
+#
+# D2H: every device->host readback goes through `device_fetch` (or calls
+# `record_d2h` right before its own jax.device_get), so "the async host
+# loop issues at most ONE fetch per logging interval" is a counted
+# invariant, not a wall-clock inference. One `device_fetch` CALL counts
+# as one fetch no matter how many leaves the tree has — coalescing N
+# metric reads into one call is exactly the round-trip win the counter
+# exists to pin (~66ms per round trip on the tunneled chip, PERF.md).
+#
+# Tests and bench.py assert transfer behavior from these counters
 # instead of inferring it from wall clock — in particular that the
-# device-resident pipeline does ZERO per-step H2D data transfers after its
-# one-time upload, and that input_cast="bfloat16" halves the bytes on the
-# wire.
+# device-resident pipeline does ZERO per-step H2D data transfers after
+# its one-time upload, and that input_cast="bfloat16" halves the bytes
+# on the wire.
 
-_transfer_stats = {"h2d_transfers": 0, "h2d_bytes": 0}
+_transfer_stats = {"h2d_transfers": 0, "h2d_bytes": 0,
+                   "d2h_fetches": 0, "d2h_bytes": 0}
 
 
 def record_h2d(batch):
@@ -383,12 +394,51 @@ def record_h2d(batch):
     return total
 
 
+def record_d2h(tree):
+    """Counts one device->host fetch about to be issued for `tree`.
+
+    The unit is the ROUND TRIP, not the leaf: a coalesced
+    `jax.device_get` of a whole metric pytree is one tunnel round trip
+    regardless of leaf count, so one call here increments
+    `d2h_fetches` by exactly one. Bytes sum over the `jax.Array`
+    leaves (host-resident leaves ride along for free — they are not
+    fetched). A tree with no device leaves records nothing: there is
+    no round trip to count. Returns the byte count recorded.
+    """
+    import jax
+
+    total = 0
+    device_leaves = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            device_leaves += 1
+            total += int(leaf.nbytes)
+    if device_leaves:
+        _transfer_stats["d2h_fetches"] += 1
+        _transfer_stats["d2h_bytes"] += total
+    return total
+
+
+def device_fetch(tree):
+    """The sanctioned instrumented readback: record, then device_get.
+
+    All Trainer/bench device->host reads route through here so the
+    d2h counters stay an exhaustive census of fetch sites. Returns
+    `jax.device_get(tree)` (host numpy leaves, same structure).
+    """
+    import jax
+
+    record_d2h(tree)
+    return jax.device_get(tree)
+
+
 def transfer_stats():
-    """A snapshot of the process-wide H2D feed counters."""
+    """A snapshot of the process-wide transfer counters (H2D + D2H)."""
     return dict(_transfer_stats)
 
 
 def reset_transfer_stats():
-    """Zeroes the H2D counters (test isolation / bench warmup barrier)."""
-    _transfer_stats["h2d_transfers"] = 0
-    _transfer_stats["h2d_bytes"] = 0
+    """Zeroes all transfer counters (test isolation / bench warmup
+    barrier)."""
+    for key in _transfer_stats:
+        _transfer_stats[key] = 0

@@ -1,8 +1,11 @@
+from cloud_tpu.training.async_logs import (AsyncMetricReader, LazyLogs,
+                                           MetricFuture)
 from cloud_tpu.training.callbacks import (Callback, EarlyStopping,
                                           LambdaCallback, MetricsLogger,
                                           ModelCheckpoint,
                                           PreemptionCheckpoint,
-                                          TensorBoard, read_metrics_log)
+                                          TensorBoard, TerminateOnNaN,
+                                          read_metrics_log)
 from cloud_tpu.training.data import (ArrayDataset, DeviceResidentDataset,
                                      GeneratorDataset, InputCast,
                                      NpzShardDataset, ThreadedDataset,

@@ -153,6 +153,37 @@ class EarlyStopping(Callback):
             self._best_state = None
 
 
+class TerminateOnNaN(Callback):
+    """Stops training when the epoch loss goes non-finite (Keras
+    `TerminateOnNaN` parity, at epoch granularity — per-step host
+    checks would reintroduce the device->host sync the async host loop
+    exists to remove).
+
+    This is the canonical "callback that actually needs the value":
+    under `fit(async_logging=True)` reading `logs["loss"]` here
+    resolves the epoch's one coalesced background fetch — the NaN
+    check costs that single round trip per epoch and nothing more.
+    """
+
+    def __init__(self, monitor="loss"):
+        import math
+
+        self.monitor = monitor
+        self._isfinite = math.isfinite
+
+    def on_epoch_end(self, epoch, logs):
+        value = logs.get(self.monitor)
+        if value is None:
+            return
+        if not self._isfinite(float(value)):
+            import logging
+
+            logging.getLogger("cloud_tpu").warning(
+                "epoch %d: %s is %r — terminating training.",
+                epoch, self.monitor, value)
+            self.trainer.stop_training = True
+
+
 class ModelCheckpoint(Callback):
     """Saves the train state each epoch (reference tuner/tuner.py:576-579:
     per-trial Keras ModelCheckpoint with save_freq='epoch').

@@ -9,6 +9,7 @@ layout (`<dir>/<step>`) keeps the tuner's per-trial checkpoint convention
 """
 
 import os
+import threading
 
 import jax
 import orbax.checkpoint as ocp
@@ -21,6 +22,16 @@ def _checkpointer():
 
 
 _async_checkpointer = None
+# In-flight async save bookkeeping: orbax already serializes saves
+# through the single AsyncCheckpointer, but it does NOT guard two
+# logical saves racing to the SAME <dir>/<step> path (a preemption
+# re-save, a callback firing twice) — the second would start committing
+# over the first's partially-written directory. The guard makes that a
+# wait-then-write, and gives tests/Trainer an introspection point
+# (`pending_saves()`), so a crash window can never leave a torn
+# checkpoint that a later `latest_step` would pick up.
+_pending_lock = threading.Lock()
+_pending_paths = set()
 
 
 def _get_async_checkpointer():
@@ -37,9 +48,44 @@ def _get_async_checkpointer():
 def wait_until_finished():
     """Blocks until every async save has committed. No-op when none are
     pending. Call before reading a checkpoint written with
-    `save(..., use_async=True)` or at end of training."""
+    `save(..., use_async=True)` or at end of training. (Trainer.fit
+    calls this on every exit path — normal return, EarlyStopping abort,
+    or a raising train step — so fit never returns with a write still
+    in flight.)"""
     if _async_checkpointer is not None:
         _async_checkpointer.wait_until_finished()
+    with _pending_lock:
+        _pending_paths.clear()
+
+
+def pending_saves():
+    """Snapshot of `<dir>/<step>` paths with an async save in flight
+    (empty after wait_until_finished)."""
+    with _pending_lock:
+        return frozenset(_pending_paths)
+
+
+def _host_snapshot(state):
+    """Donation-safe copy of `state` for a background write.
+
+    The train step donates its state buffers (`donate_argnums=0`):
+    letting orbax serialize the LIVE device arrays while the next step
+    runs would race the donation — the step could rewrite (or
+    invalidate) the very buffers the writer thread is reading, tearing
+    the checkpoint. One instrumented coalesced device_get pins the
+    bytes on the host first; the write then proceeds from memory no
+    future step can touch. Only fully-addressable trees snapshot —
+    multi-host shardings keep the device arrays so orbax's distributed
+    serialization protocol (which coordinates its own copy) still
+    applies.
+    """
+    from cloud_tpu.parallel import runtime
+
+    leaves = [l for l in jax.tree_util.tree_leaves(state)
+              if isinstance(l, jax.Array)]
+    if leaves and all(l.is_fully_addressable for l in leaves):
+        return runtime.device_fetch(state)
+    return state
 
 
 def _normalize(directory):
@@ -62,7 +108,21 @@ def save(directory, state, step=0, force=True, use_async=False):
     """
     path = storage.join(_normalize(directory), str(step))
     if use_async:
-        _get_async_checkpointer().save(path, state, force=force)
+        checkpointer = _get_async_checkpointer()
+        with _pending_lock:
+            same_path_pending = path in _pending_paths
+        if same_path_pending:
+            # Two async saves racing to one path would interleave
+            # writes in the same directory; draining first turns the
+            # race into last-writer-wins (and `force=True` then
+            # overwrites a COMPLETE checkpoint, not a torn one).
+            checkpointer.wait_until_finished()
+            with _pending_lock:
+                _pending_paths.clear()
+        snapshot = _host_snapshot(state)
+        with _pending_lock:
+            _pending_paths.add(path)
+        checkpointer.save(path, snapshot, force=force)
         return path
     with _checkpointer() as checkpointer:
         checkpointer.save(path, state, force=force)

@@ -18,6 +18,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from cloud_tpu.parallel import runtime as runtime_lib
+
 logger = logging.getLogger("cloud_tpu")
 
 
@@ -321,8 +323,6 @@ class DeviceResidentDataset:
     """
 
     def __init__(self, dataset, input_cast=None, mesh=None):
-        from cloud_tpu.parallel import runtime as runtime_lib
-
         if not isinstance(dataset, ArrayDataset):
             raise TypeError(
                 "DeviceResidentDataset needs an ArrayDataset (in-memory "
@@ -677,6 +677,12 @@ def prefetch_to_device(iterator, size=2, sharding=None, feed=None,
     of batch i+1 with step i, which matters when batches are large
     (images) relative to step time.
 
+    Composes with the async host loop (trainer async_logging): this
+    side keeps the H2D wire full while the background metric reader
+    drains D2H — neither direction ever blocks the step dispatch, and
+    both are counted in `runtime.transfer_stats()` (record_h2d here,
+    record_d2h at every fetch site).
+
     Args:
         iterator: Host batch iterable.
         size: Read-ahead depth — `size` batches are queued on device
@@ -693,8 +699,6 @@ def prefetch_to_device(iterator, size=2, sharding=None, feed=None,
 
     if feed is None:
         def feed(batch):
-            from cloud_tpu.parallel import runtime as runtime_lib
-
             runtime_lib.record_h2d(batch)
             if sharding is None:
                 return jax.device_put(batch)

@@ -82,9 +82,12 @@ class TestTransferCounter:
 
     def test_reset(self):
         runtime.record_h2d(np.zeros(4, np.float32))
+        runtime.record_d2h(jnp.zeros(4, jnp.float32))
         runtime.reset_transfer_stats()
         assert runtime.transfer_stats() == {"h2d_transfers": 0,
-                                            "h2d_bytes": 0}
+                                            "h2d_bytes": 0,
+                                            "d2h_fetches": 0,
+                                            "d2h_bytes": 0}
 
     def test_host_fit_records_per_step_feeds(self):
         """The baseline the resident path is measured against: the
