@@ -14,7 +14,7 @@ measurable.
 
 Usage: python benchmarks/sweep.py [--batches 256,512,128] [--s2d 0,1]
        [--spe 5,10,1] [--bf16-input 0,1] [--resident 0,1]
-       [--async-log 0,1]
+       [--async-log 0,1] [--warm 0,1]
 """
 
 import argparse
@@ -30,7 +30,7 @@ from _subproc import point_lock, run_json_point
 
 
 def run_point(batch, s2d, spe, timeout, bf16_input=0, resident=0,
-              async_log=0):
+              async_log=0, warm=0):
     env = dict(
         os.environ,
         BENCH_BATCH=str(batch),
@@ -39,6 +39,7 @@ def run_point(batch, s2d, spe, timeout, bf16_input=0, resident=0,
         BENCH_BF16_INPUT=str(bf16_input),
         BENCH_RESIDENT=str(resident),
         BENCH_ASYNC_LOG=str(async_log),
+        BENCH_WARM=str(warm),
         # The parity smoke belongs to the flagship bench.py run, not to
         # every sweep point (~30s apiece); the worker's persistent
         # compilation cache (benchmarks/.jax_cache) still makes repeat
@@ -46,7 +47,7 @@ def run_point(batch, s2d, spe, timeout, bf16_input=0, resident=0,
         BENCH_SKIP_KERNEL_PARITY="1",
     )
     point = {"batch": batch, "s2d": s2d, "spe": spe,
-             "resident": resident, "async_log": async_log}
+             "resident": resident, "async_log": async_log, "warm": warm}
     # Per-POINT chip lock: between points the flock is free, so a
     # concurrent flagship bench.py grabs the chip within one point's
     # duration instead of waiting out the whole sweep.
@@ -92,6 +93,13 @@ def main(argv=None):
     # records the contrast) — pass --async-log 0,1 to sweep it. Never
     # pinned, like --resident.
     parser.add_argument("--async-log", default="0")
+    # Warm-start contrast (bench.py _warm series): same measurement,
+    # separate metric name, compile-census fields tracked against
+    # other warm runs (the second warm point in a sweep proves the
+    # persistent cache: compile_seconds collapses). Default OFF in the
+    # grid — pass --warm 0,1 to sweep it. Never pinned, like
+    # --async-log: it names a cold-start regime, not a chip knob.
+    parser.add_argument("--warm", default="0")
     parser.add_argument("--timeout", type=float, default=480.0)
     parser.add_argument("--write-pin", action="store_true",
                         help="write benchmarks/best_pin.json with the "
@@ -114,18 +122,22 @@ def main(argv=None):
                                 for v in args.resident.split(",")]:
                         for al in [int(v)
                                    for v in args.async_log.split(",")]:
-                            record = run_point(batch, s2d, spe,
-                                               args.timeout,
-                                               bf16_input=bf16,
-                                               resident=res,
-                                               async_log=al)
-                            record.setdefault("bf16_input", bf16)
-                            print(json.dumps(record), flush=True)
-                            records.append(record)
-                            if "error" not in record and (
-                                    best is None
-                                    or record["value"] > best["value"]):
-                                best = record
+                            for wm in [int(v)
+                                       for v in args.warm.split(",")]:
+                                record = run_point(batch, s2d, spe,
+                                                   args.timeout,
+                                                   bf16_input=bf16,
+                                                   resident=res,
+                                                   async_log=al,
+                                                   warm=wm)
+                                record.setdefault("bf16_input", bf16)
+                                print(json.dumps(record), flush=True)
+                                records.append(record)
+                                if "error" not in record and (
+                                        best is None
+                                        or record["value"]
+                                        > best["value"]):
+                                    best = record
     if best is None:
         print(json.dumps({"sweep": "failed",
                           "hint": "backend unreachable for every point"}))
@@ -149,11 +161,12 @@ def main(argv=None):
         flagship = [r for r in records
                     if "error" not in r and not r.get("s2d")
                     and not r.get("resident")
-                    and not r.get("async_log")]
+                    and not r.get("async_log")
+                    and not r.get("warm")]
         if not flagship:
             print(json.dumps({"pin_written": None,
                               "hint": "no green s2d=0 resident=0 "
-                                      "async_log=0 point"}))
+                                      "async_log=0 warm=0 point"}))
             return 0
         fbest = max(flagship, key=lambda r: r["value"])
         fair = {"BENCH_BATCH": fbest["batch"],

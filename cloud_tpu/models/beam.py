@@ -44,6 +44,7 @@ from cloud_tpu.models.decoding import (best_effort_donation,
                                        empty_cache,
                                        validate_prompt_mask)
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
+from cloud_tpu.parallel import runtime
 
 
 def _step_logp(decoder, params, cache, tokens, mask=None):
@@ -64,7 +65,7 @@ def _logprob_fn(decoder):
 
     # donate_argnums=1: prefill consumes the fresh empty cache; no
     # caller reuses it, so the KV buffers update in place.
-    @functools.partial(jax.jit, donate_argnums=1)
+    @functools.partial(runtime.instrumented_jit, donate_argnums=1)
     def step(params, cache, tokens, mask=None):
         return _step_logp(decoder, params, cache, tokens, mask)
 
@@ -87,7 +88,7 @@ def _beam_scan_fn(decoder, width, eos_token):
 
     # Donate the cache and token buffer: generate_beam passes both in
     # exactly once, so the scan's carries reuse their storage.
-    @functools.partial(jax.jit, donate_argnums=(1, 4))
+    @functools.partial(runtime.instrumented_jit, donate_argnums=(1, 4))
     def run(params, cache, scores, finished, buf, feed, step_ids):
         batch = scores.shape[0]
 

@@ -122,6 +122,32 @@ def best_effort_donation(fn):
     return wrapped
 
 
+def bucket_length(n, cap=None):
+    """The decode prefill bucket for a prompt of length `n`: the next
+    power of two >= n, clipped to `cap` (the caller's token budget,
+    typically `max_seq_len - max_new_tokens`).
+
+    Under static shapes every distinct prompt length mints its own
+    prefill executable; padding to power-of-two buckets bounds the
+    executable census at ~log2(max_seq_len) per sampling config. The
+    clip keeps the padded prompt inside the cache budget: lengths in
+    (previous_power_of_two, cap] share the cap-width bucket. When `n`
+    already exceeds `cap` the length is returned unchanged — bucketing
+    pads, never truncates (overflow is the caller's validation error).
+    """
+    if n < 1:
+        raise ValueError(
+            "bucket_length needs a positive length; got {}.".format(n))
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    if cap is not None:
+        if cap < n:
+            return n
+        bucket = min(bucket, cap)
+    return bucket
+
+
 def validate_prompt_mask(prompt_mask, batch, prompt_len, reader):
     """The left-padded variable-length prompt contract, checked ONCE
     for every decode entry point (`generate`, `generate_beam`):
@@ -193,5 +219,5 @@ def empty_cache(decoder, batch):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
-__all__ = ["best_effort_donation", "decode_slot_update", "empty_cache",
-           "validate_prompt_mask", "warp_logits"]
+__all__ = ["best_effort_donation", "bucket_length", "decode_slot_update",
+           "empty_cache", "validate_prompt_mask", "warp_logits"]

@@ -58,6 +58,7 @@ import numpy as np
 from cloud_tpu.models.decoding import (best_effort_donation,
                                        empty_cache, warp_logits)
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
+from cloud_tpu.parallel import runtime
 
 _BOOKKEEPING = ("cache_index", "token_count", "pos_count")
 
@@ -89,7 +90,7 @@ def _chunk_fn(decoder):
 
     # donate_argnums=1: callers always rebind the cache they pass in,
     # so the KV buffers update in place.
-    @functools.partial(jax.jit, donate_argnums=1)
+    @functools.partial(runtime.instrumented_jit, donate_argnums=1)
     def chunk(params, cache, tokens):
         logits, vars_ = decoder.apply(
             {"params": params, "cache": cache}, tokens,
@@ -137,7 +138,7 @@ def _greedy_round_fn(target, draft, k):
     dispatch, PERF.md)."""
 
     # Donate both caches: the round loop rebinds them every iteration.
-    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    @functools.partial(runtime.instrumented_jit, donate_argnums=(2, 3))
     def round_step(params, draft_params, t_cache, d_cache, last_tok,
                    base_len):
         def draft_body(carry, _):
@@ -222,7 +223,7 @@ def _stochastic_round_fn(target, draft, k, temperature, top_k, top_p):
     per round."""
 
     # Donate both caches: the round loop rebinds them every iteration.
-    @functools.partial(jax.jit, donate_argnums=(2, 3))
+    @functools.partial(runtime.instrumented_jit, donate_argnums=(2, 3))
     def round_step(params, draft_params, t_cache, d_cache, last_tok,
                    base_len, rng):
         rngs = jax.random.split(rng, k + 2)

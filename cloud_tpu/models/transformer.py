@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from cloud_tpu.parallel import SEQUENCE_PARALLEL_IMPLS
+from cloud_tpu.parallel import runtime
 
 
 class CausalSelfAttention(nn.Module):
@@ -299,7 +300,8 @@ def generate(model,
              top_k=None,
              top_p=None,
              eos_token=None,
-             prompt_mask=None):
+             prompt_mask=None,
+             bucket_prompts=True):
     """Autoregressive sampling with a KV cache.
 
     The inference counterpart of Trainer.fit for `TransformerLM` (no
@@ -330,6 +332,13 @@ def generate(model,
             slots are never attended, and positions (learned table or
             RoPE) count only real tokens, so each row generates
             exactly as its unpadded equivalent would.
+        bucket_prompts: Pad the prompt LEFT to the next power-of-two
+            bucket (capped at `max_seq_len - max_new_tokens`) before
+            prefill, so varied prompt lengths share executables
+            instead of minting one per length. The left-padded-mask
+            contract makes the padding output-invisible; the returned
+            array keeps the ORIGINAL prompt width. False = compile at
+            the exact prompt length.
 
     Returns:
         [B, S + max_new_tokens] int32: prompt + generated continuation
@@ -367,7 +376,7 @@ def generate(model,
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    from cloud_tpu.models.decoding import empty_cache
+    from cloud_tpu.models.decoding import bucket_length, empty_cache
 
     decoder = model.clone(decode=True, dropout_rate=0.0)
     cache = empty_cache(decoder, batch)
@@ -381,7 +390,22 @@ def generate(model,
     rng, prefill_rng = jax.random.split(rng)
     mask_arg = (None if prompt_mask is None
                 else jnp.asarray(prompt_mask, bool))
-    cache, first = prefill(params, cache, prompt, prefill_rng, mask_arg)
+    prefill_tokens = prompt
+    if bucket_prompts:
+        # Left-pad to the bucket; the mask keeps padded slots out of
+        # attention and position counting, so outputs match the
+        # unbucketed call exactly. The final concatenate below uses the
+        # ORIGINAL prompt, so the extra columns never escape.
+        bucket = bucket_length(prompt_len,
+                               model.max_seq_len - max_new_tokens)
+        if bucket > prompt_len:
+            pad = bucket - prompt_len
+            prefill_tokens = jnp.pad(prompt, ((0, 0), (pad, 0)))
+            real = (jnp.ones((batch, prompt_len), bool)
+                    if mask_arg is None else mask_arg)
+            mask_arg = jnp.pad(real, ((0, 0), (pad, 0)))
+    cache, first = prefill(params, cache, prefill_tokens, prefill_rng,
+                           mask_arg)
     out = [first[:, None]]
     if max_new_tokens > 1:
         toks = decode_steps(params, cache, first,
@@ -420,14 +444,14 @@ def _decode_fns(decoder, temperature, top_k, top_p, eos_token):
     # (prefill gets the fresh empty cache; decode_steps consumes
     # prefill's), so XLA can update the KV buffers in place instead of
     # holding two cache-sized allocations across the call.
-    @functools.partial(jax.jit, donate_argnums=1)
+    @functools.partial(runtime.instrumented_jit, donate_argnums=1)
     def prefill(params, cache, prompt, rng, prompt_mask=None):
         logits, vars_ = decoder.apply({"params": params, "cache": cache},
                                       prompt, prompt_mask,
                                       mutable=["cache"])
         return vars_["cache"], sample(logits[:, -1], rng)
 
-    @functools.partial(jax.jit, donate_argnums=1)
+    @functools.partial(runtime.instrumented_jit, donate_argnums=1)
     def decode_steps(params, cache, first_token, step_rngs):
         def step(carry, step_rng):
             cache, tok, done = carry

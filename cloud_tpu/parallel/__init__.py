@@ -7,6 +7,7 @@ ICI/DCN, plus ring attention for long-context sequence parallelism
 (absent from the reference; first-class here).
 """
 
+from cloud_tpu.parallel import compile_cache
 from cloud_tpu.parallel import runtime
 from cloud_tpu.parallel import sharding
 # NOTE: the schedule-level `pipeline` function stays in its submodule
@@ -43,7 +44,7 @@ def sp_attention(impl, q, k, v, causal=True, mask=None):
             impl, SEQUENCE_PARALLEL_IMPLS))
 
 
-__all__ = ["runtime", "sharding", "pipeline_apply",
+__all__ = ["compile_cache", "runtime", "sharding", "pipeline_apply",
            "ring_attention", "sequence_parallel_attention",
            "ulysses_attention", "ulysses_local",
            "SEQUENCE_PARALLEL_IMPLS", "sp_attention"]

@@ -442,3 +442,206 @@ def reset_transfer_stats():
     barrier)."""
     for key in _transfer_stats:
         _transfer_stats[key] = 0
+
+
+# --------------------------------------------------------------------------
+# Compilation observability.
+#
+# The same doctrine as the transfer counters above, applied to the other
+# uncounted wall-clock sink: trace + XLA compile. Every framework
+# `jax.jit` site (Trainer steps, decode prefill/step, speculative round
+# functions) goes through `instrumented_jit`, so "a steady-state epoch
+# performs ZERO new traces/compiles" is a counted invariant a test can
+# pin, not a wall-clock inference.
+#
+# n_traces  — times a wrapped function body was re-traced (bumped from
+#             inside the traced body, so it fires exactly when jax
+#             actually retraces: dispatch-cache misses and .lower()).
+# n_compiles — executables built (dispatch-path misses + explicit AOT
+#             `.compile()` calls).
+# compile_seconds — wall seconds spent in calls that traced. On the
+#             dispatch path this includes the first execution (jax
+#             offers no clean split there); AOT `.compile()` timings are
+#             pure compile.
+# cache_hits — persistent-compile-cache hits (fed by the
+#             `compile_cache` module's jax.monitoring listener).
+
+_compile_stats = {"n_traces": 0, "n_compiles": 0,
+                  "compile_seconds": 0.0, "cache_hits": 0}
+
+
+class RetraceWarning(UserWarning):
+    """A steady-state epoch compiled something new.
+
+    Raised as a warning (opt-in: an exception) by the Trainer's retrace
+    sentinel when `compile_stats()` moved during an epoch that should
+    have been fully warm — the usual culprits are a ragged tail batch,
+    a dtype drift in the input pipeline, or a new decode prompt length.
+    """
+
+
+def record_compile(n_traces=0, n_compiles=0, compile_seconds=0.0,
+                   cache_hits=0):
+    """Adds to the process-wide compile counters."""
+    _compile_stats["n_traces"] += n_traces
+    _compile_stats["n_compiles"] += n_compiles
+    _compile_stats["compile_seconds"] += compile_seconds
+    _compile_stats["cache_hits"] += cache_hits
+
+
+def compile_stats():
+    """A snapshot of the process-wide compile counters."""
+    return dict(_compile_stats)
+
+
+def reset_compile_stats():
+    """Zeroes all compile counters (test isolation / bench warmup
+    barrier). Does NOT clear jax's own caches — an executable compiled
+    before the reset stays warm, which is exactly what a steady-state
+    invariant wants."""
+    _compile_stats["n_traces"] = 0
+    _compile_stats["n_compiles"] = 0
+    _compile_stats["compile_seconds"] = 0.0
+    _compile_stats["cache_hits"] = 0
+
+
+def _aval_signature(args):
+    """A hashable (treedef, leaf-aval) key for the warm-executable table.
+
+    Returns None when any leaf lacks shape/dtype (python scalars,
+    strings) — those calls fall back to the ordinary jit dispatch path
+    rather than risking a wrong executable match.
+    """
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            return None
+        sig.append((tuple(shape),
+                    jax.dtypes.canonicalize_dtype(np.dtype(dtype))))
+    return (treedef, tuple(sig))
+
+
+class _InstrumentedLowered:
+    """Proxy over `jax.stages.Lowered` that counts `.compile()`."""
+
+    def __init__(self, lowered):
+        self._lowered = lowered
+
+    def compile(self, *args, **kwargs):
+        t0 = time.perf_counter()
+        compiled = self._lowered.compile(*args, **kwargs)
+        record_compile(n_compiles=1,
+                       compile_seconds=time.perf_counter() - t0)
+        return compiled
+
+    def __getattr__(self, name):
+        return getattr(self._lowered, name)
+
+
+class InstrumentedJit:
+    """`jax.jit` with compile counting and an AOT warm-start table.
+
+    Drop-in at call sites: `__call__` and `.lower()` mirror the jitted
+    function. Tracing is detected from inside the traced body (a
+    counter bump that only runs when jax actually retraces), so cached
+    dispatches cost one integer compare and no counter traffic.
+
+    `.warm(*specs)` AOT-compiles for the given `ShapeDtypeStruct`s (or
+    example arrays) and installs the executable in a signature-keyed
+    table that `__call__` consults first — a warmed call never enters
+    jit dispatch at all, so step 1 after `Trainer.warmup()` runs
+    trace-free. Signature mismatches (and executables whose sharding
+    check rejects the actual args) fall back to the jit path; the warm
+    table is an accelerator, never a correctness gate.
+    """
+
+    def __init__(self, fun, **jit_kwargs):
+        import functools
+        import jax
+
+        self._fun = fun
+        self._trace_count = 0
+        self._warm = {}
+        # The warm table matches on positional avals only; static or
+        # keyword-routed arguments would make the signature ambiguous.
+        self._warmable = not any(
+            jit_kwargs.get(k) for k in ("static_argnums", "static_argnames"))
+
+        def _shim(*args, **kwargs):
+            # Runs at TRACE time only: jax executes the python body
+            # exactly when (re)tracing, which is the event we count.
+            self._trace_count += 1
+            record_compile(n_traces=1)
+            return fun(*args, **kwargs)
+
+        try:
+            functools.update_wrapper(_shim, fun)
+        except AttributeError:  # functools.partial etc.
+            pass
+        self._jitted = jax.jit(_shim, **jit_kwargs)
+
+    @property
+    def n_traces(self):
+        """Times THIS wrapper's body was traced (per-site counter)."""
+        return self._trace_count
+
+    def __call__(self, *args, **kwargs):
+        if self._warm and not kwargs:
+            sig = _aval_signature(args)
+            compiled = self._warm.get(sig) if sig is not None else None
+            if compiled is not None:
+                try:
+                    return compiled(*args)
+                except Exception:
+                    # Aval match but sharding/layout rejection: evict
+                    # and let jit dispatch handle it from now on.
+                    self._warm.pop(sig, None)
+        before = self._trace_count
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        if self._trace_count != before:
+            record_compile(n_compiles=1,
+                           compile_seconds=time.perf_counter() - t0)
+        return out
+
+    def lower(self, *args, **kwargs):
+        return _InstrumentedLowered(self._jitted.lower(*args, **kwargs))
+
+    def warm(self, *specs):
+        """AOT-compiles for `specs` (ShapeDtypeStructs or example
+        arrays) and installs the executable in the warm table. Returns
+        the `jax.stages.Compiled`. Idempotent per signature: a spec
+        already warm returns its executable without re-lowering, so
+        `warmup()` followed by `fit(warm_start=True)` compiles once."""
+        sig = _aval_signature(specs) if self._warmable else None
+        if sig is not None and sig in self._warm:
+            return self._warm[sig]
+        compiled = self.lower(*specs).compile()
+        if sig is not None:
+            self._warm[sig] = compiled
+        return compiled
+
+    def warm_signatures(self):
+        """The aval signatures currently warm (introspection/tests)."""
+        return tuple(self._warm)
+
+    def clear_warm(self):
+        self._warm.clear()
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+
+def instrumented_jit(fun, **jit_kwargs):
+    """`jax.jit` replacement that feeds `compile_stats()`.
+
+    Usage matches jit: `instrumented_jit(f, donate_argnums=0)` or
+    `@functools.partial(instrumented_jit, donate_argnums=1)`.
+    """
+    return InstrumentedJit(fun, **jit_kwargs)

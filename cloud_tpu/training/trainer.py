@@ -19,8 +19,10 @@ Example:
 
 import inspect
 import logging
+import os
 import sys
 import time
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -157,6 +159,14 @@ def _weighted_mean(v, weights):
     weight is below one. All-zero weights give 0, not nan.
     """
     return jnp.sum(v * weights) / jnp.maximum(jnp.sum(weights), 1e-9)
+
+
+def _lead_count(batch):
+    """The batch's leading (example) dimension, from its first shaped
+    leaf — the host-side example count feeding and grouping key on."""
+    lead = next((l for l in jax.tree_util.tree_leaves(batch)
+                 if getattr(l, "shape", ())), None)
+    return int(lead.shape[0]) if lead is not None else 0
 
 
 def _emit_runtime_metrics(steps, examples, elapsed_secs):
@@ -600,8 +610,8 @@ class Trainer:
             opt_sharding = jax.tree_util.tree_map(
                 _subtree_sharding, abstract_opt,
                 is_leaf=_is_params_shaped)
-            opt_state = jax.jit(self.optimizer.init,
-                                out_shardings=opt_sharding)(params)
+            opt_state = runtime.instrumented_jit(
+                self.optimizer.init, out_shardings=opt_sharding)(params)
             replicate_all = lambda tree: jax.tree_util.tree_map(
                 lambda _: sharding_lib.replicated(self._mesh), tree)
             extra_vars = jax.tree_util.tree_map(
@@ -793,11 +803,11 @@ class Trainer:
         train_step = self._make_train_step_body(weighted=weighted,
                                                 widen=widen)
         if self._mesh is None:
-            return jax.jit(train_step, donate_argnums=0)
+            return runtime.instrumented_jit(train_step, donate_argnums=0)
         batch_sharding = sharding_lib.batch_sharding(self._mesh)
         batch_in = ((batch_sharding,) * 3 if weighted
                     else (batch_sharding, batch_sharding))
-        return jax.jit(
+        return runtime.instrumented_jit(
             train_step,
             in_shardings=(self._state_sharding, batch_in),
             out_shardings=(self._state_sharding, None),
@@ -851,13 +861,13 @@ class Trainer:
             return state, self._reduce_scan_logs(logs_seq)
 
         if self._mesh is None:
-            return jax.jit(multi_step, donate_argnums=0)
+            return runtime.instrumented_jit(multi_step, donate_argnums=0)
         batch_sharding = sharding_lib.batch_sharding(self._mesh)
         stacked = NamedSharding(
             self._mesh, P(None, *batch_sharding.spec))
         batch_in = ((stacked,) * 3 if weighted
                     else (stacked, stacked))
-        return jax.jit(
+        return runtime.instrumented_jit(
             multi_step,
             in_shardings=(self._state_sharding, batch_in),
             out_shardings=(self._state_sharding, None),
@@ -922,8 +932,8 @@ class Trainer:
             return state, self._reduce_scan_logs(logs_seq)
 
         if self._mesh is None:
-            return jax.jit(run, donate_argnums=0)
-        return jax.jit(
+            return runtime.instrumented_jit(run, donate_argnums=0)
+        return runtime.instrumented_jit(
             run,
             in_shardings=(self._state_sharding, resident.sharding,
                           sharding_lib.replicated(self._mesh),
@@ -999,9 +1009,9 @@ class Trainer:
             return logs
 
         if self._mesh is None:
-            return jax.jit(eval_step)
+            return runtime.instrumented_jit(eval_step)
         batch_sharding = sharding_lib.batch_sharding(self._mesh)
-        return jax.jit(
+        return runtime.instrumented_jit(
             eval_step,
             in_shardings=(self._state_sharding,
                           (batch_sharding, batch_sharding,
@@ -1064,35 +1074,157 @@ class Trainer:
                     yield cast.host_cast(batch)
         return narrowed()
 
-    def _grouped_host_batches(self, batches, limit, spe):
+    def _pad_tail(self, batch, steady, weighted):
+        """Host-side ragged-tail padding: reshapes an n-row tail batch
+        to the steady B-row geometry so it dispatches through the
+        ALREADY-COMPILED full-shape weighted executable instead of
+        minting a one-off ragged variant (a fresh trace + XLA compile
+        per distinct tail size — the cost `runtime.compile_stats()`
+        exists to pin at zero in steady state).
+
+        Rows wrap (real data, NaN-safe) and the weight vector makes the
+        math exact: real rows carry weight * (B/n), wrapped pads carry
+        0, so the weighted loss mean(per_ex * w) over B rows equals the
+        ragged mean over n rows EXACTLY — gradients included — and
+        weighted-mean metrics reduce to means over the real rows (the
+        B/n scale cancels).
+
+        Returns ((x, y, w'), real_weight_sum), or None when the
+        contract can't hold and the caller must fall back to ragged
+        dispatch: multi-process feeding (the scale needs the global
+        real count), models that sow losses (the aux-loss mean has no
+        weight slot, so wrapped rows would shift gradients), models
+        with extra_vars (BatchNorm-style batch statistics would fold
+        the wrapped rows in), and unlabeled batches (no (x, y) slots
+        to carry a weight alongside).
+        """
+        if jax.process_count() > 1:
+            return None
+        if getattr(self, "_sows_losses", False):
+            return None
+        if (self.state is not None
+                and jax.tree_util.tree_leaves(self.state.extra_vars)):
+            return None
+        if weighted:
+            if not (isinstance(batch, tuple) and len(batch) == 3):
+                return None
+            x, y, w = batch
+        elif isinstance(batch, tuple) and len(batch) == 2:
+            x, y = batch
+            w = None
+        else:
+            return None
+        n = _lead_count(batch)
+        if n <= 0 or n >= steady:
+            return None
+        idx = np.arange(steady) % n
+        real = (np.arange(steady) < n).astype(np.float32)
+        scale = steady / float(n)
+        take = lambda a: np.asarray(a)[idx]
+        x_p = jax.tree_util.tree_map(take, x)
+        y_p = jax.tree_util.tree_map(take, y)
+        if w is None:
+            w_p = real * scale
+            real_w_sum = float(n)
+        else:
+            w_np = np.asarray(w, np.float32)
+            w_p = w_np[idx] * real * scale
+            real_w_sum = float(w_np.sum())
+        return (x_p, y_p, w_p), real_w_sum
+
+    def _tail_step_fn(self, weighted, cast):
+        """The executable a padded tail dispatches through.
+
+        Weighted fits reuse the fit's own step (the padded triple has
+        the steady aval signature — no new trace at all). Unweighted
+        fits need the WEIGHTED variant (the pad mask rides in the
+        weight slot); it is built once, cached in the ordinary step
+        cache (so alternating fits reuse it), and compiles only on the
+        first tail of the run — warm for every later epoch.
+        """
+        if weighted:
+            return self._jit_train_step
+        key = (True if cast is None else (True, cast.cache_key))
+        step_cache = getattr(self, "_train_step_cache", None)
+        if step_cache is None:
+            step_cache = self._train_step_cache = {}
+        if key not in step_cache:
+            # _make_train_step_body re-points _train_scalar_unmasked at
+            # the new variant's set; restore the fit's own pointer so
+            # the first-step guard keeps reading the right slot.
+            prev = getattr(self, "_train_scalar_unmasked", set())
+            step = self._make_train_step(
+                weighted=True, widen=self._batch_widener(cast, True))
+            step_cache[key] = (step, self._train_scalar_unmasked)
+            self._train_scalar_unmasked = prev
+        step, scalar_set = step_cache[key]
+        if scalar_set and not getattr(self, "_warned_tail_scalar", False):
+            self._warned_tail_scalar = True
+            warnings.warn(
+                "Custom metrics {} return scalars that cannot be "
+                "masked; their logged values for padded tail batches "
+                "include the wrapped pad rows (loss, gradients and "
+                "per-example metrics stay exact).".format(
+                    sorted(scalar_set)))
+        return step
+
+    def _fix_tail_logs(self, logs, weighted, real_w_sum):
+        """Host-side epoch-aggregation fixup for a padded tail's logs.
+
+        The executable's in-graph `_batch_weight` is sum(w') =
+        scale * sum(w) — right for the in-step math, wrong for epoch
+        re-weighting, so weighted fits restore the REAL weight sum.
+        Unweighted fits strip the key entirely: their epoch aggregation
+        is a plain per-step mean and a lone `_batch_weight` entry would
+        flip it into the weighted branch.
+        """
+        logs = dict(logs)
+        if weighted:
+            logs["_batch_weight"] = jnp.asarray(real_w_sum, jnp.float32)
+        else:
+            logs.pop("_batch_weight", None)
+        return logs
+
+    def _grouped_host_batches(self, batches, limit, spe, pad_tail=None):
         """Yields ("multi", n, stacked_group) for each full group of
         `spe` host batches and ("single", n, batch) for the leftovers —
-        the steps_per_execution input shape."""
-
-        def count(batch):
-            lead = next((l for l in jax.tree_util.tree_leaves(batch)
-                         if getattr(l, "shape", ())), None)
-            return int(lead.shape[0]) if lead is not None else 0
-
+        the steps_per_execution input shape. With `pad_tail` (a
+        callable (batch, steady) -> ((x, y, w'), w_sum) or None),
+        ragged leftovers smaller than the steady batch yield
+        ("padded", n, padded) so they reuse the full-shape executable
+        instead of tracing a one-off ragged variant."""
+        steady = None
         group = []
+
+        def emit_single(b):
+            n = _lead_count(b)
+            if pad_tail is not None and steady is not None and n < steady:
+                padded = pad_tail(b, steady)
+                if padded is not None:
+                    return "padded", n, padded
+            return "single", n, b
+
         for i, batch in enumerate(batches):
             if limit is not None and i >= limit:
                 break
-            if group and count(batch) != count(group[0]):
+            if steady is None:
+                steady = _lead_count(batch)
+            if group and _lead_count(batch) != _lead_count(group[0]):
                 # Ragged batch (e.g. drop_remainder=False tails):
                 # np.stack can't group it — flush what we have as
                 # singles and keep going.
                 for b in group:
-                    yield "single", count(b), b
+                    yield emit_single(b)
                 group = []
             group.append(batch)
             if len(group) == spe:
                 stacked = jax.tree_util.tree_map(
                     lambda *xs: np.stack(xs), *group)
-                yield "multi", sum(count(b) for b in group), stacked
+                yield ("multi", sum(_lead_count(b) for b in group),
+                       stacked)
                 group = []
         for batch in group:
-            yield "single", count(batch), batch
+            yield emit_single(batch)
 
     def _feed_grouped(self, item):
         """Feed for the steps_per_execution path: stacked groups get
@@ -1102,6 +1234,10 @@ class Trainer:
         assembled across processes like make_global_batch, one stacking
         level up."""
         kind, _, batch = item
+        if kind == "padded":
+            # (padded_triple, real_weight_sum): the triple feeds like
+            # any single batch; the weight sum stays host-side.
+            return self._feed(batch[0])
         if kind == "single":
             return self._feed(batch)
         if self._mesh is None:
@@ -1130,6 +1266,156 @@ class Trainer:
         return data_lib.prefetch_to_device(batches, size=size, feed=feed,
                                            limit=limit)
 
+    # -- AOT warm start -------------------------------------------------
+
+    def _ensure_host_steps(self, weighted, policy):
+        """Installs the host-path step executables for this fit's
+        variant, through the step cache: alternating
+        weighted/unweighted fits reuse each compiled variant instead of
+        re-tracing on every flip (bare bool keys; input_cast fits get
+        (weighted, policy) tuple keys because the widener is baked into
+        the compiled step). Each slot carries its scalar-unmasked set
+        (written by that variant's trace), so switching variants
+        re-points the guard _fit_epochs reads rather than leaking the
+        other slot's names."""
+        key = (weighted if policy is None
+               else (weighted, policy.cache_key))
+        widen = self._batch_widener(policy, weighted)
+        step_cache = getattr(self, "_train_step_cache", None)
+        if step_cache is None:
+            step_cache = self._train_step_cache = {}
+        if key not in step_cache:
+            step = self._make_train_step(weighted=weighted,
+                                         widen=widen)
+            step_cache[key] = (step, self._train_scalar_unmasked)
+        self._jit_train_step, scalar_set = step_cache[key]
+        self._train_scalar_unmasked = (scalar_set if weighted
+                                       else set())
+
+        spe = self.steps_per_execution
+        self._jit_multi_step = None
+        if spe > 1:
+            mcache = getattr(self, "_multi_step_cache", None)
+            if mcache is None:
+                mcache = self._multi_step_cache = {}
+            if key not in mcache:
+                mcache[key] = self._make_multi_train_step(
+                    spe, weighted=weighted, widen=widen)
+            self._jit_multi_step = mcache[key]
+
+    def _state_struct(self):
+        """ShapeDtypeStructs mirroring the live train state (the AOT
+        lowering input; jit's explicit in_shardings supply layouts)."""
+        return jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+            self.state)
+
+    @staticmethod
+    def _batch_struct(batch):
+        """ShapeDtypeStructs for a HOST batch, with dtypes
+        canonicalized exactly as jit dispatch would (float64 ->
+        float32 under the default x64-off), so the AOT executable's
+        aval signature matches the real calls."""
+        return jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(
+                np.shape(l),
+                jax.dtypes.canonicalize_dtype(np.asarray(l).dtype)),
+            batch)
+
+    @staticmethod
+    def _cast_sample(sample, policy):
+        """Applies the input_cast host narrowing to a peeked sample so
+        warm-start structs see the on-the-wire dtypes."""
+        if policy is None:
+            return sample
+        if isinstance(sample, tuple) and len(sample) == 3:
+            x, y, w = sample
+            return (policy.host_cast(x), y, w)
+        if isinstance(sample, tuple) and len(sample) == 2:
+            x, y = sample
+            return (policy.host_cast(x), y)
+        return policy.host_cast(sample)
+
+    def _warm_fit_steps(self, sample, weighted, policy):
+        """AOT-compiles (`lower().compile()`) the installed fit
+        executables for this fit's data geometry. The compiled
+        executables land in each wrapper's warm table, so the epoch
+        loop's first dispatch runs them directly — no trace, no
+        compile, `runtime.compile_stats()` unmoved by step 1."""
+        del weighted  # geometry comes from the sample itself
+        state_struct = self._state_struct()
+        batch_struct = self._batch_struct(
+            self._cast_sample(sample, policy))
+        self._jit_train_step.warm(state_struct, batch_struct)
+        if getattr(self, "_jit_multi_step", None) is not None:
+            spe = self.steps_per_execution
+            stacked = jax.tree_util.tree_map(
+                lambda s: jax.ShapeDtypeStruct(
+                    (spe,) + tuple(s.shape), s.dtype), batch_struct)
+            self._jit_multi_step.warm(state_struct, stacked)
+
+    def warmup(self, x, y=None, batch_size=32, sample_weight=None,
+               input_cast=None, include_eval=False,
+               include_predict=False):
+        """AOT-compiles the step executables for a data geometry,
+        ahead of (and without) running any training.
+
+        The standalone form of `fit(warm_start=True)`: builds the model
+        from a sample batch, installs the train-step executables for
+        the (batch_size, weighted, input_cast) variant, and
+        `lower().compile()`s them from ShapeDtypeStructs. A subsequent
+        `fit()` over the same geometry starts trace-free, and with the
+        persistent compile cache enabled
+        (`parallel.compile_cache.enable`) a restarted process pays
+        deserialization, not XLA, here.
+
+        include_eval / include_predict additionally warm the
+        evaluate() / predict() executables for the same batch geometry
+        (include_eval needs labels `y`).
+
+        Returns `runtime.compile_stats()` after warming (the warm-up's
+        own compiles are visible there; steady-state assertions should
+        snapshot AFTER warmup returns).
+        """
+        ds_kwargs = {}
+        if sample_weight is not None:
+            ds_kwargs["sample_weight"] = np.asarray(sample_weight,
+                                                    np.float32)
+        dataset = data_lib.as_dataset(x, y, batch_size=batch_size,
+                                      shuffle=False, **ds_kwargs)
+        weighted = (isinstance(dataset, data_lib.ArrayDataset)
+                    and dataset.sample_weight is not None)
+        sample = next(iter(dataset))
+        sample_x = sample[0] if isinstance(sample, tuple) else sample
+        self.build(sample_x)
+        policy = None
+        if input_cast not in (None, "none"):
+            if isinstance(dataset, data_lib.ArrayDataset):
+                policy = data_lib.make_input_cast(input_cast, dataset.x)
+            else:
+                policy = data_lib.make_input_cast(input_cast, sample_x)
+        self._ensure_host_steps(weighted, policy)
+        self._warm_fit_steps(sample, weighted, policy)
+        state_struct = self._state_struct()
+        if include_eval:
+            if not (isinstance(sample, tuple) and len(sample) >= 2):
+                raise ValueError(
+                    "warmup(include_eval=True) needs labels y.")
+            if self._jit_eval_step is None:
+                self._jit_eval_step = self._make_eval_step()
+            xb, yb = sample[0], sample[1]
+            mask = jax.ShapeDtypeStruct((_lead_count(sample),),
+                                        jnp.float32)
+            self._jit_eval_step.warm(
+                state_struct, (self._batch_struct(xb),
+                               self._batch_struct(yb), mask))
+        if include_predict:
+            if self._jit_predict_step is None:
+                self._jit_predict_step = self._make_predict_step()
+            self._jit_predict_step.warm(
+                state_struct, self._batch_struct(sample_x))
+        return runtime.compile_stats()
+
     # -- public API -----------------------------------------------------
 
     def fit(self,
@@ -1150,8 +1436,28 @@ class Trainer:
             class_weight=None,
             cache=None,
             input_cast=None,
-            async_logging=True):
+            async_logging=True,
+            warm_start=False,
+            on_retrace=None):
         """Trains the model; returns a history dict of per-epoch logs.
+
+        warm_start: AOT-compile the fit executables (train step, and
+        the steps_per_execution / device-resident variants) from
+        `ShapeDtypeStruct`s BEFORE the epoch loop — step 1 dispatches a
+        finished executable without tracing anything
+        (`runtime.compile_stats()` does not move on the first step).
+        The same executables are also eligible for the persistent
+        compile cache (`parallel.compile_cache.enable`), making the
+        warm-up near-free on a restart.
+
+        on_retrace: The retrace sentinel's policy — "warn" (default;
+        also via the CLOUD_TPU_ON_RETRACE env var), "raise", or
+        "ignore". After the first completed epoch (whose compiles are
+        legitimate: the step executables, validation, callbacks), a
+        steady-state epoch that traces or compiles ANYTHING raises/
+        warns `runtime.RetraceWarning` — the counted invariant is zero
+        new compiles after epoch 1, and the usual culprits (ragged
+        tails, input dtype drift) are bugs worth hearing about.
 
         async_logging: The async host loop (default on). Epoch metrics
         stay device scalars, coalesce into ONE pytree, and are fetched
@@ -1331,44 +1637,31 @@ class Trainer:
             resident = data_lib.DeviceResidentDataset.build(
                 dataset, input_cast=policy, mesh=self._mesh)
 
-        # Step cache: alternating weighted/unweighted fits reuse each
-        # compiled variant instead of re-tracing on every flip (bare
-        # bool keys; input_cast fits get (weighted, policy) tuple keys
-        # because the widener is baked into the compiled step). Each
-        # slot carries its scalar-unmasked set (written by that
-        # variant's trace), so switching variants re-points the guard
-        # _fit_epochs reads rather than leaking the other slot's names.
         # Resident fits build their own executables per fit (the
-        # permutation geometry is baked in) and skip these caches.
+        # permutation geometry is baked in) and skip the step caches.
         if resident is None:
-            key = (weighted if policy is None
-                   else (weighted, policy.cache_key))
-            widen = self._batch_widener(policy, weighted)
-            step_cache = getattr(self, "_train_step_cache", None)
-            if step_cache is None:
-                step_cache = self._train_step_cache = {}
-            if key not in step_cache:
-                step = self._make_train_step(weighted=weighted,
-                                             widen=widen)
-                step_cache[key] = (step, self._train_scalar_unmasked)
-            self._jit_train_step, scalar_set = step_cache[key]
-            self._train_scalar_unmasked = (scalar_set if weighted
-                                           else set())
-
-            spe = self.steps_per_execution
-            self._jit_multi_step = None
-            if spe > 1:
-                mcache = getattr(self, "_multi_step_cache", None)
-                if mcache is None:
-                    mcache = self._multi_step_cache = {}
-                if key not in mcache:
-                    mcache[key] = self._make_multi_train_step(
-                        spe, weighted=weighted, widen=widen)
-                self._jit_multi_step = mcache[key]
+            self._ensure_host_steps(weighted, policy)
+            if warm_start:
+                self._warm_fit_steps(sample, weighted, policy)
 
         history = {}
         self.stop_training = False
         self._abort_epoch = False
+        # Retrace sentinel state (see on_retrace above): the baseline
+        # is snapshotted at the end of the first COMPLETED epoch; the
+        # counters are process-wide, so a second Trainer compiling
+        # mid-fit also trips it (that, too, is compile traffic the
+        # steady state shouldn't have).
+        self._retrace_baseline = None
+        self._warned_tail_scalar = False
+        on_retrace = (on_retrace
+                      or os.environ.get("CLOUD_TPU_ON_RETRACE")
+                      or "warn")
+        if on_retrace not in ("warn", "raise", "ignore"):
+            raise ValueError(
+                "on_retrace must be 'warn', 'raise' or 'ignore'; got "
+                "{!r}.".format(on_retrace))
+        self._on_retrace = on_retrace
         # Async host loop state: one reader thread per Trainer (reused
         # across fits — the thread is lazy and survives idle), one
         # pending-history list per fit (drained at the exit barrier).
@@ -1390,13 +1683,13 @@ class Trainer:
                 self._fit_epochs_resident(
                     resident, epochs, steps_per_epoch, validation_data,
                     batch_size, callbacks, history, verbose, prefetch,
-                    initial_epoch=initial_epoch)
+                    initial_epoch=initial_epoch, warm_start=warm_start)
             else:
                 self._fit_epochs(dataset, epochs, steps_per_epoch,
                                  validation_data, batch_size, callbacks,
                                  history, verbose, prefetch,
                                  initial_epoch=initial_epoch,
-                                 cast=policy)
+                                 cast=policy, weighted=weighted)
         finally:
             # Guaranteed even when a train step raises (OOM, interrupt):
             # callbacks holding external resources (profiler traces,
@@ -1488,7 +1781,16 @@ class Trainer:
 
     def _fit_epochs(self, dataset, epochs, steps_per_epoch,
                     validation_data, batch_size, callbacks, history,
-                    verbose, prefetch=2, initial_epoch=0, cast=None):
+                    verbose, prefetch=2, initial_epoch=0, cast=None,
+                    weighted=False):
+        pad_tail = lambda b, steady: self._pad_tail(b, steady, weighted)
+        # Feeder items are (kind, examples, tail_weight_sum, batch):
+        # the weight sum is only meaningful for "padded" tails (the
+        # host-side value _fix_tail_logs restores into the epoch
+        # aggregation); everything else carries None.
+        unpack = lambda item: (
+            item[0], item[1],
+            item[2][1] if item[0] == "padded" else None)
         for epoch in range(initial_epoch, epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
@@ -1502,12 +1804,12 @@ class Trainer:
                 feeder = data_lib.prefetch_to_device(
                     self._grouped_host_batches(
                         self._host_batches(dataset, cast),
-                        steps_per_epoch, spe),
+                        steps_per_epoch, spe, pad_tail=pad_tail),
                     size=prefetch,
-                    feed=lambda item: (item[0], item[1],
-                                       self._feed_grouped(item)))
+                    feed=lambda item: unpack(item) + (
+                        self._feed_grouped(item),))
                 first = True
-                for kind, batch_examples, fed in feeder:
+                for kind, batch_examples, w_sum, fed in feeder:
                     if self._abort_epoch:
                         break
                     examples += batch_examples
@@ -1531,6 +1833,12 @@ class Trainer:
                             # the group mean stands for `spe` steps.
                             step_logs.extend([logs] * spe)
                         count += spe
+                    elif kind == "padded":
+                        tail_step = self._tail_step_fn(weighted, cast)
+                        self.state, logs = tail_step(self.state, fed)
+                        step_logs.append(self._fix_tail_logs(
+                            logs, weighted, w_sum))
+                        count += 1
                     else:
                         self.state, logs = self._jit_train_step(
                             self.state, fed)
@@ -1560,14 +1868,45 @@ class Trainer:
                 if self.stop_training:
                     break
                 continue
-            feeder = self._prefetch_batches(
-                self._host_batches(dataset, cast), limit=steps_per_epoch,
-                size=prefetch)
-            for batch_examples, batch in feeder:
+            def singles():
+                # The limit check precedes the pull: a bounded stream
+                # (steps_per_epoch over an expensive generator) must
+                # never be drawn past the bound.
+                steady = None
+                it = iter(self._host_batches(dataset, cast))
+                i = 0
+                while steps_per_epoch is None or i < steps_per_epoch:
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        break
+                    i += 1
+                    n = _lead_count(b)
+                    if steady is None:
+                        steady = n
+                    if n < steady:
+                        padded = pad_tail(b, steady)
+                        if padded is not None:
+                            yield "padded", n, padded
+                            continue
+                    yield "single", n, b
+
+            feeder = data_lib.prefetch_to_device(
+                singles(), size=prefetch,
+                feed=lambda item: unpack(item) + (
+                    self._feed(item[2][0] if item[0] == "padded"
+                               else item[2]),))
+            for kind, batch_examples, w_sum, batch in feeder:
                 if self._abort_epoch:
                     break
                 examples += batch_examples
-                self.state, logs = self._jit_train_step(self.state, batch)
+                if kind == "padded":
+                    tail_step = self._tail_step_fn(weighted, cast)
+                    self.state, logs = tail_step(self.state, batch)
+                    logs = self._fix_tail_logs(logs, weighted, w_sum)
+                else:
+                    self.state, logs = self._jit_train_step(self.state,
+                                                            batch)
                 if (count == 0 and epoch == initial_epoch
                         and getattr(self, "_train_scalar_unmasked", None)):
                     # Populated during the trace that just ran: a
@@ -1597,7 +1936,7 @@ class Trainer:
     def _fit_epochs_resident(self, resident, epochs, steps_per_epoch,
                              validation_data, batch_size, callbacks,
                              history, verbose, prefetch=2,
-                             initial_epoch=0):
+                             initial_epoch=0, warm_start=False):
         """The device-resident fit loop: every batch is drawn in-graph
         from `resident.data`, so the epoch loop issues executable calls
         only — ZERO per-step host->device data transfers (pinned by
@@ -1629,6 +1968,17 @@ class Trainer:
             run_tail = self._make_resident_run(leftover, steps,
                                                resident, weighted)
             scalar_sets.append(self._train_scalar_unmasked)
+        if warm_start:
+            # AOT-compile both executables before the loop: structs
+            # mirror (state, data, base_step, epoch_idx), so the first
+            # epoch's first dispatch is the finished executable.
+            struct = lambda tree: jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tree)
+            scalar_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+            for run in (run_group, run_tail):
+                if run is not None:
+                    run.warm(struct(self.state), struct(resident.data),
+                             scalar_i32, scalar_i32)
         # The epoch index lives on device and is advanced there (one
         # tiny add per epoch, no transfer); it starts from the source
         # dataset's `_epoch` counter so shuffled order matches the
@@ -1795,6 +2145,37 @@ class Trainer:
                 k: round(v, 4) for k, v in logs.items()})
         for cb in callbacks:
             cb.on_epoch_end(epoch, logs)
+
+        # Retrace sentinel: the baseline snapshots at the end of the
+        # FIRST completed epoch (its compiles are legitimate — step
+        # executables, validation's eval step, callback one-offs);
+        # any later epoch that moved the trace/compile counters is the
+        # regression the counted invariant exists to catch (ragged
+        # tails, input dtype drift, a new decode shape). Checked after
+        # the callbacks so epoch-scoped callback compiles are counted
+        # against the epoch that ran them.
+        stats = runtime.compile_stats()
+        snapshot = (stats["n_traces"], stats["n_compiles"])
+        baseline = getattr(self, "_retrace_baseline", None)
+        if baseline is None:
+            self._retrace_baseline = snapshot
+        elif snapshot != baseline:
+            # Re-base first: one event, one report (and a "raise" that
+            # gets caught shouldn't re-raise every later epoch).
+            self._retrace_baseline = snapshot
+            msg = ("Steady-state retrace: epoch {} performed {} new "
+                   "trace(s) / {} new compile(s) after the first "
+                   "epoch's warm-up. Ragged tail batches, input dtype "
+                   "drift and per-epoch callback compiles are the "
+                   "usual causes; runtime.compile_stats() has the "
+                   "running census.".format(
+                       epoch, snapshot[0] - baseline[0],
+                       snapshot[1] - baseline[1]))
+            policy = getattr(self, "_on_retrace", "warn")
+            if policy == "raise":
+                raise runtime.RetraceWarning(msg)
+            if policy == "warn":
+                warnings.warn(runtime.RetraceWarning(msg))
 
     def summary(self, print_fn=None):
         """Keras `model.summary()` parity: per-top-level-module
@@ -2045,8 +2426,8 @@ class Trainer:
                                extra_vars=state.extra_vars, **eval_kwargs)
 
         if self._mesh is None:
-            return jax.jit(predict_step)
-        return jax.jit(
+            return runtime.instrumented_jit(predict_step)
+        return runtime.instrumented_jit(
             predict_step,
             in_shardings=(self._state_sharding,
                           sharding_lib.batch_sharding(self._mesh)))
