@@ -1,0 +1,28 @@
+"""graftlint: AST-based static analysis for JAX/TPU training code.
+
+The runtime made transfers and compiles *counted* resources
+(`runtime.transfer_stats()` / `runtime.compile_stats()`); this package
+is the static complement — each rule predicts the runtime counter that
+would regress if the pattern shipped, so a pitfall is caught before the
+job is containerized instead of as a wall-clock pathology on the slice.
+
+Three entry points share one engine:
+
+- CLI:       python -m cloud_tpu.analysis.lint <paths> [--strict] [--format json]
+- Preflight: `run(entry_point=..., lint="warn"|"strict"|"off")` lints the
+             entry point before containerize (analysis/preflight.py).
+- Self-run:  CI runs the linter over this repository itself; the tree
+             stays graftlint-clean.
+
+Pure `ast` + `tokenize` — the target is parsed, never imported.
+"""
+
+from cloud_tpu.analysis.engine import Finding
+from cloud_tpu.analysis.engine import RULES
+from cloud_tpu.analysis.engine import check_paths
+from cloud_tpu.analysis.engine import check_source
+from cloud_tpu.analysis.preflight import GraftlintError
+from cloud_tpu.analysis.preflight import preflight_lint
+
+__all__ = ["Finding", "RULES", "check_paths", "check_source",
+           "GraftlintError", "preflight_lint"]

@@ -1,0 +1,212 @@
+"""The graftlint engine: parse, build shared context, dispatch rules.
+
+The engine never imports the code under analysis — everything is a pure
+`ast` walk plus a `tokenize` pass for suppression comments. That keeps
+the linter runnable on broken trees, on files with unavailable
+dependencies, and inside the preflight path of `run()` where importing
+user training code would execute it.
+
+Suppression syntax (comment-level, mirrored on pylint's):
+
+    x = float(loss)          # graftlint: disable=GL001
+    key2 = reuse(key)        # graftlint: disable=GL004,GL001
+    anything = hazard()      # graftlint: disable=all
+
+    # graftlint: disable-file=GL005      <- anywhere in the file
+
+`disable=` applies to findings reported on the comment's own line;
+`disable-file=` disables the rule(s) for the whole file.
+"""
+
+import ast
+import io
+import os
+import re
+import tokenize
+
+#: Rule id reserved for files the engine cannot parse at all. A syntax
+#: error is a finding (not a crash) so `--strict` still gates on it.
+PARSE_ERROR = "GL000"
+
+_SUPPRESS_RE = re.compile(
+    r"graftlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<codes>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+class Finding:
+    """One lint finding, stable across text and JSON output."""
+
+    __slots__ = ("path", "line", "col", "rule", "message")
+
+    def __init__(self, path, line, col, rule, message):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.rule = rule
+        self.message = message
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self):
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message}
+
+    def format(self):
+        return "{}:{}:{}: {} {}".format(
+            self.path, self.line, self.col, self.rule, self.message)
+
+    def __repr__(self):
+        return "Finding({!r})".format(self.format())
+
+
+def _suppressions(source):
+    """-> (line -> set(codes), set(file_codes)); 'all' wildcard kept."""
+    per_line = {}
+    per_file = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = _SUPPRESS_RE.search(tok.string)
+            if not match:
+                continue
+            codes = {c.strip().upper() if c.strip().lower() != "all"
+                     else "all"
+                     for c in match.group("codes").split(",")}
+            if match.group("file"):
+                per_file |= codes
+            else:
+                per_line.setdefault(tok.start[0], set()).update(codes)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # unparseable files are reported as GL000 by check_source
+    return per_line, per_file
+
+
+def _suppressed(finding, per_line, per_file):
+    if "all" in per_file or finding.rule in per_file:
+        return True
+    codes = per_line.get(finding.line, ())
+    return "all" in codes or finding.rule in codes
+
+
+def check_source(source, path="<string>", select=None):
+    """Lints one source string -> sorted [Finding].
+
+    select: optional iterable of rule ids to run (default: all).
+    """
+    # Imported here, not at module top: rules imports engine for the
+    # Finding type, and this lazy edge breaks the cycle.
+    from cloud_tpu.analysis import rules
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 1, exc.offset or 0,
+                        PARSE_ERROR,
+                        "could not parse file: {}".format(exc.msg))]
+    per_line, per_file = _suppressions(source)
+    ctx = rules.FileContext(tree, source, path)
+    findings = []
+    for rule in RULES.values():
+        if select is not None and rule.id not in select:
+            continue
+        for finding in rule.check(ctx):
+            if not _suppressed(finding, per_line, per_file):
+                findings.append(finding)
+    return sorted(findings, key=Finding.sort_key)
+
+
+def iter_python_files(paths):
+    """Expands files/directories into a sorted list of .py files.
+
+    Directories are walked recursively; hidden directories and
+    `__pycache__` are skipped. Non-python files given explicitly raise
+    ValueError (a typo'd path should not silently lint nothing).
+    """
+    out = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        elif os.path.isfile(path):
+            if not path.endswith(".py"):
+                raise ValueError(
+                    "graftlint only checks .py files; got {!r}".format(path))
+            out.append(path)
+        else:
+            raise ValueError("No such file or directory: {!r}".format(path))
+    return out
+
+
+def check_paths(paths, select=None):
+    """Lints files/directories -> (sorted [Finding], files_checked)."""
+    files = iter_python_files(paths)
+    findings = []
+    for filename in files:
+        with open(filename, "r", encoding="utf-8") as handle:
+            source = handle.read()
+        findings.extend(check_source(source, filename, select=select))
+    return sorted(findings, key=Finding.sort_key), len(files)
+
+
+def _build_registry():
+    from cloud_tpu.analysis import rules
+
+    registry = {}
+    for rule in rules.ALL_RULES:
+        if rule.id in registry:
+            raise ValueError("Duplicate rule id: {}".format(rule.id))
+        registry[rule.id] = rule
+    return registry
+
+
+class _LazyRegistry(dict):
+    """id -> rule, materialized on first access (breaks the
+    engine<->rules import cycle without repeating the lazy import at
+    every call site)."""
+
+    _loaded = False
+
+    def _ensure(self):
+        if not self._loaded:
+            self._loaded = True
+            super().update(_build_registry())
+
+    def __getitem__(self, key):
+        self._ensure()
+        return super().__getitem__(key)
+
+    def __iter__(self):
+        self._ensure()
+        return super().__iter__()
+
+    def __len__(self):
+        self._ensure()
+        return super().__len__()
+
+    def keys(self):
+        self._ensure()
+        return super().keys()
+
+    def values(self):
+        self._ensure()
+        return super().values()
+
+    def items(self):
+        self._ensure()
+        return super().items()
+
+    def __contains__(self, key):
+        self._ensure()
+        return super().__contains__(key)
+
+
+#: Rule registry: id -> rule instance, in GL001..GL006 order.
+RULES = _LazyRegistry()

@@ -1,0 +1,110 @@
+"""graftlint CLI: `python -m cloud_tpu.analysis.lint <paths>`.
+
+Exit-code contract (CI gates on it):
+
+    0  clean tree, or findings in default (warn) mode
+    1  findings (or unparseable files) with --strict
+    2  usage errors (argparse) / nonexistent paths
+
+JSON output schema (test-pinned, `--format json`):
+
+    {"version": 1,
+     "files_checked": <int>,
+     "counts": {"GL001": <int>, ...},        # only rules that fired
+     "findings": [{"path": str, "line": int, "col": int,
+                   "rule": str, "message": str}, ...]}    # sorted
+"""
+
+import argparse
+import json
+import sys
+
+from cloud_tpu.analysis import engine
+
+#: Bumped on any backwards-incompatible change to the JSON schema.
+JSON_VERSION = 1
+
+
+def _build_parser():
+    parser = argparse.ArgumentParser(
+        prog="python -m cloud_tpu.analysis.lint",
+        description="graftlint: static analysis for JAX/TPU training "
+                    "code (rules GL001-GL006; see --list-rules).")
+    parser.add_argument("paths", nargs="*",
+                        help=".py files and/or directories to lint")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="output format (default: text)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 when there is any finding "
+                             "(default: report and exit 0)")
+    parser.add_argument("--select", default=None, metavar="IDS",
+                        help="comma-separated rule ids to run, e.g. "
+                             "GL001,GL004 (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    return parser
+
+
+def _list_rules(out):
+    for rule in engine.RULES.values():
+        out.write("{}  {:<24} predicts: {}\n".format(
+            rule.id, rule.title, rule.predicts))
+
+
+def run_lint(paths, select=None):
+    """Library entry: -> (findings, files_checked). `select` is an
+    iterable of rule ids or None for all."""
+    return engine.check_paths(paths, select=select)
+
+
+def main(argv=None, out=None):
+    out = out or sys.stdout
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        _list_rules(out)
+        return 0
+    if not args.paths:
+        _build_parser().print_usage(sys.stderr)
+        return 2
+
+    select = None
+    if args.select:
+        select = {s.strip().upper() for s in args.select.split(",")
+                  if s.strip()}
+        unknown = select - set(engine.RULES.keys()) - {engine.PARSE_ERROR}
+        if unknown:
+            sys.stderr.write("graftlint: unknown rule id(s): {}\n".format(
+                ", ".join(sorted(unknown))))
+            return 2
+        select |= {engine.PARSE_ERROR}  # parse errors always gate
+
+    try:
+        findings, files_checked = run_lint(args.paths, select=select)
+    except ValueError as exc:
+        sys.stderr.write("graftlint: {}\n".format(exc))
+        return 2
+
+    if args.format == "json":
+        counts = {}
+        for finding in findings:
+            counts[finding.rule] = counts.get(finding.rule, 0) + 1
+        doc = {"version": JSON_VERSION,
+               "files_checked": files_checked,
+               "counts": counts,
+               "findings": [f.to_dict() for f in findings]}
+        out.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    else:
+        for finding in findings:
+            out.write(finding.format() + "\n")
+        out.write("graftlint: {} finding(s) in {} file(s)\n".format(
+            len(findings), files_checked))
+
+    if findings and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

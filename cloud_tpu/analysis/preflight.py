@@ -1,0 +1,93 @@
+"""Preflight lint hook for `run()`.
+
+`validate.py` checks the *arguments* of a launch; this checks the
+*training code* being shipped — before the containerize/deploy spend,
+which is the whole point: a GL001 host sync or GL002 retrace hazard
+costs minutes of idle TPU slice once it is only discoverable from the
+job's wall-clock metrics.
+
+Modes (the `lint=` knob on `run()`):
+
+    "warn"    (default) findings go to stderr + the job event log;
+              the launch proceeds.
+    "strict"  findings raise GraftlintError before containerize.
+    "off"     skip entirely.
+
+Findings are also surfaced through `utils.events.log_job_event` (kind
+"graftlint"), so a launcher wrapper pointing CLOUD_TPU_EVENT_LOG at a
+file — local or gs:// — gets a structured JSONL record of what the
+preflight saw, alongside whatever else the job logs.
+"""
+
+import os
+import sys
+
+from cloud_tpu.analysis import engine
+from cloud_tpu.utils import events
+
+LINT_MODES = ("warn", "strict", "off")
+
+
+class GraftlintError(ValueError):
+    """Raised by strict-mode preflight; carries the findings."""
+
+    def __init__(self, message, findings):
+        super().__init__(message)
+        self.findings = findings
+
+
+def resolve_target(entry_point):
+    """The .py file preflight should lint, or None.
+
+    `entry_point=None` is the self-launch case: the calling script
+    itself ships, so lint `sys.argv[0]`. Notebooks are skipped — their
+    code only becomes a .py after preprocess, and linting generated
+    wrapper code would attribute findings to lines the user never
+    wrote.
+    """
+    target = entry_point if entry_point is not None else sys.argv[0]
+    if not isinstance(target, str) or not target.endswith(".py"):
+        return None
+    if not os.path.isfile(target):
+        return None
+    return target
+
+
+def preflight_lint(entry_point, mode="warn"):
+    """Lints the launch's entry point; returns the findings list.
+
+    Raises GraftlintError in strict mode when anything fires, and
+    ValueError on an unknown mode (validate.py rejects that earlier on
+    the `run()` path; this guard covers direct callers).
+    """
+    if mode not in LINT_MODES:
+        raise ValueError(
+            "Invalid `lint` input. Expected one of {}. "
+            "Received {}.".format(LINT_MODES, mode))
+    if mode == "off":
+        return []
+    target = resolve_target(entry_point)
+    if target is None:
+        return []
+
+    findings, _ = engine.check_paths([target])
+    if not findings:
+        return []
+
+    events.log_job_event("graftlint", {
+        "mode": mode,
+        "entry_point": target,
+        "findings": [f.to_dict() for f in findings],
+    })
+    text = "\n".join("  " + f.format() for f in findings)
+    if mode == "strict":
+        raise GraftlintError(
+            "graftlint strict preflight: {} finding(s) in {} — fix or "
+            "suppress (# graftlint: disable=RULE), or pass "
+            "lint=\"warn\":\n{}".format(len(findings), target, text),
+            findings)
+    sys.stderr.write(
+        "graftlint preflight: {} finding(s) in {} (launch proceeds; "
+        "pass lint=\"strict\" to gate, lint=\"off\" to "
+        "silence):\n{}\n".format(len(findings), target, text))
+    return findings

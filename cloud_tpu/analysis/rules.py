@@ -1,0 +1,720 @@
+"""graftlint rules GL001-GL006.
+
+Every rule is keyed to the runtime counter it predicts (PERF.md has the
+table): the linter is the static half of the transfer/compile
+accounting that `runtime.transfer_stats()` / `runtime.compile_stats()`
+do at runtime. Rules are deliberately heuristic — they run on an AST,
+with no types and no tracing — so each one is tuned to fire on the
+unambiguous shape of its pitfall and stay silent otherwise; the escape
+hatch for a deliberate pattern is a `# graftlint: disable=RULE` comment
+on the flagged line.
+
+Shared infrastructure: `FileContext` runs ONE pre-pass over the tree
+collecting everything more than one rule needs — which functions are
+jit-compiled (decorator forms, `functools.partial` forms, and
+`g = jax.jit(f, ...)` assignment forms), their static/donated argument
+positions, module-level mutable literals, mesh axis-name literals, and
+the import aliases under which `PartitionSpec` and `jax.random` travel.
+"""
+
+import ast
+
+from cloud_tpu.analysis.engine import Finding
+
+# Callables that make the wrapped function traced/compiled. `pjit` and
+# `instrumented_jit` (cloud_tpu.parallel.runtime) behave like jax.jit
+# for every rule here.
+_JIT_NAMES = {"jit", "instrumented_jit", "pjit"}
+
+# numpy's conventional import aliases: `np.asarray(x)` on a tracer
+# inside jit is a concretization (host sync) hazard.
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+
+# Test-expression calls whose result is static even on traced args.
+_STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "callable",
+                 "issubclass"}
+
+
+def _terminal_name(node):
+    """`jax.jit` -> 'jit', `runtime.instrumented_jit` ->
+    'instrumented_jit', `jit` -> 'jit'; None for anything else."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _base_name(node):
+    """`np.asarray` -> 'np' (the root Name of an attribute chain)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _literal(node):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return None
+
+
+class JitInfo:
+    """What we know about one jit-compiled callable."""
+
+    __slots__ = ("static_argnums", "static_argnames", "donate_argnums",
+                 "node")
+
+    def __init__(self):
+        self.static_argnums = set()
+        self.static_argnames = set()
+        self.donate_argnums = set()
+        self.node = None  # the FunctionDef, when known
+
+    @property
+    def has_statics(self):
+        return bool(self.static_argnums or self.static_argnames)
+
+    def absorb_kwargs(self, call):
+        """Reads static_argnums/static_argnames/donate_argnums literal
+        keywords off a jit(...) / partial(jit, ...) call node."""
+        for kw in call.keywords:
+            value = _literal(kw.value)
+            if value is None:
+                continue
+            if not isinstance(value, (tuple, list)):
+                value = (value,)
+            if kw.arg == "static_argnums":
+                self.static_argnums |= {v for v in value
+                                        if isinstance(v, int)}
+            elif kw.arg == "static_argnames":
+                self.static_argnames |= {v for v in value
+                                         if isinstance(v, str)}
+            elif kw.arg == "donate_argnums":
+                self.donate_argnums |= {v for v in value
+                                        if isinstance(v, int)}
+
+
+def _jit_call_info(node):
+    """If `node` is a Call that jit-compiles something, return
+    (JitInfo, wrapped) where wrapped is the first positional argument
+    (the function being compiled) or None. Handles `jax.jit(f, ...)`,
+    `instrumented_jit(f, ...)` and `functools.partial(jax.jit, ...)`.
+    """
+    if not isinstance(node, ast.Call):
+        return None, None
+    name = _terminal_name(node.func)
+    if name in _JIT_NAMES:
+        info = JitInfo()
+        info.absorb_kwargs(node)
+        wrapped = node.args[0] if node.args else None
+        return info, wrapped
+    if name == "partial" and node.args:
+        inner = _terminal_name(node.args[0])
+        if inner in _JIT_NAMES:
+            info = JitInfo()
+            info.absorb_kwargs(node)
+            return info, None  # partial(jit, ...) decorates the def below
+    return None, None
+
+
+class FileContext:
+    """One shared pre-pass over the tree; rules read, never re-walk."""
+
+    def __init__(self, tree, source, path):
+        self.tree = tree
+        self.source = source
+        self.path = path
+        self.parents = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        #: FunctionDef/Lambda node -> JitInfo for jit-compiled defs.
+        self.jit_defs = {}
+        #: local callable name -> JitInfo (call sites: `g = jax.jit(f)`
+        #: assignments AND decorated defs, callable by their own name).
+        self.jit_names = {}
+        #: module-level names bound to mutable literals ({} [] set()).
+        self.mutable_globals = set()
+        #: axis-name string literals declared by Mesh(...) in this file.
+        self.mesh_axes = set()
+        self.mesh_lines = []
+        #: names PartitionSpec is importable under in this file.
+        self.pspec_aliases = {"PartitionSpec"}
+        #: names the jax.random module travels under (import aliases).
+        self.random_aliases = {"jrandom", "jran"}
+        #: function names imported directly from jax.random.
+        self.random_funcs = set()
+
+        self._collect_imports(tree)
+        self._collect_jit(tree)
+        self._collect_globals(tree)
+        self._collect_mesh(tree)
+
+    # -- pre-pass collectors ------------------------------------------
+
+    def _collect_imports(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                module = node.module or ""
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if alias.name == "PartitionSpec":
+                        self.pspec_aliases.add(bound)
+                    if alias.name == "random" and module == "jax":
+                        self.random_aliases.add(bound)
+                    if module == "jax.random":
+                        self.random_funcs.add(bound)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax.random" and alias.asname:
+                        self.random_aliases.add(alias.asname)
+
+    def _collect_jit(self, tree):
+        # Decorated defs.
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    info = self._decorator_jit_info(deco)
+                    if info is not None:
+                        info.node = node
+                        self.jit_defs[node] = info
+                        self.jit_names[node.name] = info
+                        break
+        # Call form, wherever it appears: `jax.jit(train_step, ...)` in
+        # an assignment, a return statement, or any expression marks
+        # the wrapped def's body as traced code. Assignment targets
+        # additionally become known-jit call-site names.
+        wrapped_names = {}
+        for node in ast.walk(tree):
+            info, wrapped = _jit_call_info(node)
+            if info is None:
+                continue
+            if isinstance(wrapped, ast.Name):
+                wrapped_names[wrapped.id] = info
+            elif isinstance(wrapped, ast.Lambda):
+                info.node = wrapped
+                self.jit_defs[wrapped] = info
+            parent = self.parents.get(node)
+            if isinstance(parent, ast.Assign):
+                for target in parent.targets:
+                    if isinstance(target, ast.Name):
+                        self.jit_names[target.id] = info
+        # The plain defs that assignment-form jit calls wrapped: their
+        # bodies are traced code too.
+        if wrapped_names:
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.FunctionDef)
+                        and node.name in wrapped_names
+                        and node not in self.jit_defs):
+                    info = wrapped_names[node.name]
+                    if info.node is None:
+                        info.node = node
+                    self.jit_defs[node] = info
+
+    def _decorator_jit_info(self, deco):
+        name = _terminal_name(deco)
+        if name in _JIT_NAMES:
+            return JitInfo()
+        info, _ = _jit_call_info(deco)
+        return info
+
+    def _collect_globals(self, tree):
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
+            if (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Name)
+                    and value.func.id in ("dict", "list", "set",
+                                          "bytearray", "defaultdict")):
+                mutable = True
+            if not mutable:
+                continue
+            for target in targets:
+                if isinstance(target, ast.Name):
+                    self.mutable_globals.add(target.id)
+
+    def _collect_mesh(self, tree):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _terminal_name(node.func) not in ("Mesh", "make_mesh"):
+                continue
+            candidates = list(node.args[1:2])
+            candidates += [kw.value for kw in node.keywords
+                           if kw.arg == "axis_names"]
+            for cand in candidates:
+                value = _literal(cand)
+                if isinstance(value, str):
+                    value = (value,)
+                if isinstance(value, (tuple, list)):
+                    axes = [v for v in value if isinstance(v, str)]
+                    if axes:
+                        self.mesh_axes.update(axes)
+                        self.mesh_lines.append(node.lineno)
+
+    # -- shared queries -----------------------------------------------
+
+    def enclosing_jit(self, node):
+        """The innermost jit-compiled def lexically containing `node`
+        (the def itself excluded), or None. Nested plain defs inside a
+        jit body still count as jit code: they are traced when called.
+        """
+        current = self.parents.get(node)
+        while current is not None:
+            if current in self.jit_defs:
+                return current
+            current = self.parents.get(current)
+        return None
+
+    def traced_params(self, def_node):
+        """Positional/keyword parameter names of a jit def, minus the
+        ones marked static and the instance receiver."""
+        info = self.jit_defs[def_node]
+        args = def_node.args
+        ordered = [a.arg for a in args.posonlyargs + args.args]
+        names = set(ordered + [a.arg for a in args.kwonlyargs])
+        for index in info.static_argnums:
+            if 0 <= index < len(ordered):
+                names.discard(ordered[index])
+        names -= info.static_argnames
+        names.discard("self")
+        names.discard("cls")
+        return names
+
+    def finding(self, node, rule, message):
+        return Finding(self.path, node.lineno, node.col_offset, rule,
+                       message)
+
+
+# -- ordered scope events (GL003 / GL004 share this walker) -----------
+
+
+def _scope_bodies(ctx):
+    """Yields (body_statements,) for every straight-line scope: the
+    module body and each function body. Nested defs are separate
+    scopes (their statements are NOT merged into the parent's order).
+    """
+    yield ctx.tree.body
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.body
+
+
+def _scope_events(body, ctx):
+    """Flattens one scope body into an ordered event stream:
+    ('load'|'store'|'donate'|'keyuse', name, node). Source order is
+    approximated by statement order with assignment values visited
+    before their targets — exactly what `x = step(x)` rebinding needs.
+    """
+    events = []
+
+    def visit(node):
+        if node is None:
+            return
+        if isinstance(node, ast.Name):
+            kind = "store" if isinstance(node.ctx,
+                                         (ast.Store, ast.Del)) else "load"
+            events.append((kind, node.id, node))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            events.append(("store", node.name, node))
+            return  # separate scope
+        if isinstance(node, ast.Lambda):
+            return  # separate scope
+        if isinstance(node, ast.Call):
+            visit(node.func)
+            for arg in node.args:
+                visit(arg)
+            for kw in node.keywords:
+                visit(kw.value)
+            _call_events(node, ctx, events)
+            return
+        if isinstance(node, ast.Assign):
+            visit(node.value)
+            for target in node.targets:
+                visit(target)
+            return
+        if isinstance(node, ast.AnnAssign):
+            visit(node.value)
+            visit(node.target)
+            return
+        if isinstance(node, ast.AugAssign):
+            visit(node.value)
+            # target is read-modify-write: load then store.
+            if isinstance(node.target, ast.Name):
+                events.append(("load", node.target.id, node.target))
+                events.append(("store", node.target.id, node.target))
+            else:
+                visit(node.target)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            visit(node.iter)
+            visit(node.target)
+            for stmt in node.body + node.orelse:
+                visit(stmt)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in body:
+        visit(stmt)
+    return events
+
+
+def _call_events(node, ctx, events):
+    """Appends donate/keyuse events for one Call node (loads of its
+    arguments were already emitted by the caller)."""
+    func = node.func
+    # Donation: a call to a known-jit callable with donate_argnums.
+    if isinstance(func, ast.Name) and func.id in ctx.jit_names:
+        info = ctx.jit_names[func.id]
+        for pos in info.donate_argnums:
+            if 0 <= pos < len(node.args):
+                arg = node.args[pos]
+                if isinstance(arg, ast.Name):
+                    events.append(("donate", arg.id, node))
+    # RNG key consumption: jax.random.<fn>(key, ...).
+    if _is_random_call(func, ctx) and node.args:
+        key = node.args[0]
+        if isinstance(key, ast.Name):
+            events.append(("keyuse", key.id, node))
+
+
+def _is_random_call(func, ctx):
+    if isinstance(func, ast.Attribute):
+        if func.attr == "PRNGKey" or func.attr == "key":
+            return False  # creates keys, consumes nothing
+        value = func.value
+        if isinstance(value, ast.Attribute):  # jax.random.<fn>
+            return (value.attr == "random"
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id == "jax")
+        if isinstance(value, ast.Name):      # random.<fn> / jrandom.<fn>
+            return value.id in ctx.random_aliases
+        return False
+    if isinstance(func, ast.Name):           # from jax.random import fn
+        return (func.id in ctx.random_funcs
+                and func.id not in ("PRNGKey", "key"))
+    return False
+
+
+# -- the rules --------------------------------------------------------
+
+
+class Rule:
+    id = None
+    title = None
+    predicts = None  # the runtime counter this rule is the static half of
+
+    def check(self, ctx):
+        raise NotImplementedError
+
+
+class HostSyncInJit(Rule):
+    id = "GL001"
+    title = "host-sync-in-jit"
+    predicts = "transfer_stats().d2h_fetches"
+
+    _MSG = ("host sync inside a jit-compiled function: {} forces a "
+            "device->host transfer (or a trace-time concretization "
+            "error) on every dispatch; compute on device and fetch "
+            "once outside jit [predicts {} growth]")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.enclosing_jit(node) is None:
+                continue
+            label = self._host_sync_label(node)
+            if label is not None:
+                yield ctx.finding(node, self.id,
+                                  self._MSG.format(label, self.predicts))
+
+    @staticmethod
+    def _host_sync_label(node):
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id == "float" and node.args:
+                return "float(...)"
+            if func.id == "print":
+                return "print(...) (use jax.debug.print)"
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not node.args:
+                return ".item()"
+            if (func.attr in ("asarray", "array")
+                    and _base_name(func) in _NUMPY_ALIASES):
+                return "{}.{}(...)".format(_base_name(func), func.attr)
+            if (func.attr == "device_get"
+                    and _base_name(func) == "jax"):
+                return "jax.device_get(...)"
+        return None
+
+
+class RetraceHazard(Rule):
+    id = "GL002"
+    title = "retrace-hazard"
+    predicts = "compile_stats().n_traces"
+
+    _ARG_MSG = ("{} passed as a traced argument to jit-compiled "
+                "`{}` (no static_argnums/static_argnames): every "
+                "distinct value mints a new trace — mark the argument "
+                "static or move it into the array [predicts {} growth "
+                "the runtime's on_retrace sentinel only catches at "
+                "epoch 2]")
+    _GLOBAL_MSG = ("jit-compiled function closes over mutable module "
+                   "global `{}`: its value is baked in at trace time, "
+                   "and later mutation either goes silently unseen or "
+                   "forces a retrace [predicts {} growth]")
+
+    def check(self, ctx):
+        yield from self._call_site_hazards(ctx)
+        yield from self._mutable_global_closures(ctx)
+
+    def _call_site_hazards(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            info = ctx.jit_names.get(node.func.id)
+            if info is None or info.has_statics:
+                continue
+            loop_vars = self._enclosing_loop_vars(ctx, node)
+            for arg in node.args:
+                label = self._hazard_label(arg, loop_vars)
+                if label is not None:
+                    yield ctx.finding(
+                        arg, self.id,
+                        self._ARG_MSG.format(label, node.func.id,
+                                             self.predicts))
+
+    @staticmethod
+    def _hazard_label(arg, loop_vars):
+        if (isinstance(arg, ast.Call)
+                and isinstance(arg.func, ast.Name)
+                and arg.func.id == "len"):
+            return "`len(...)`-derived Python int"
+        if isinstance(arg, ast.Dict):
+            return "Python dict literal"
+        if isinstance(arg, ast.Name) and arg.id in loop_vars:
+            return "loop variable `{}`".format(arg.id)
+        return None
+
+    @staticmethod
+    def _enclosing_loop_vars(ctx, node):
+        names = set()
+        current = ctx.parents.get(node)
+        while current is not None:
+            if isinstance(current, (ast.For, ast.AsyncFor)):
+                for sub in ast.walk(current.target):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+            if isinstance(current, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                break
+            current = ctx.parents.get(current)
+        return names
+
+    def _mutable_global_closures(self, ctx):
+        if not ctx.mutable_globals:
+            return
+        for def_node, _ in ctx.jit_defs.items():
+            if isinstance(def_node, ast.Lambda):
+                continue
+            local = self._local_bindings(def_node)
+            seen = set()
+            for node in ast.walk(def_node):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in ctx.mutable_globals
+                        and node.id not in local
+                        and node.id not in seen):
+                    seen.add(node.id)
+                    yield ctx.finding(
+                        node, self.id,
+                        self._GLOBAL_MSG.format(node.id, self.predicts))
+
+    @staticmethod
+    def _local_bindings(def_node):
+        args = def_node.args
+        local = {a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)}
+        if args.vararg:
+            local.add(args.vararg.arg)
+        if args.kwarg:
+            local.add(args.kwarg.arg)
+        for node in ast.walk(def_node):
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                local.add(node.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+                local.add(node.name)
+        return local
+
+
+class DonationAfterUse(Rule):
+    id = "GL003"
+    title = "donation-after-use"
+    predicts = "donated-buffer UAF (jax 'donated buffers' warning)"
+
+    _MSG = ("`{}` is read after being donated to jit-compiled `{}` at "
+            "line {}: donate_argnums invalidates the caller's buffer, "
+            "so this read sees freed or aliased memory — rebind the "
+            "result (`{}` = ...) before reuse")
+
+    def check(self, ctx):
+        for body in _scope_bodies(ctx):
+            donated = {}  # name -> (call node, callee name)
+            for kind, name, node in _scope_events(body, ctx):
+                if kind == "donate":
+                    callee = node.func.id
+                    donated[name] = (node, callee)
+                elif kind == "store":
+                    donated.pop(name, None)
+                elif kind == "load" and name in donated:
+                    call, callee = donated.pop(name)
+                    yield ctx.finding(
+                        node, self.id,
+                        self._MSG.format(name, callee, call.lineno,
+                                         name))
+
+
+class RngKeyReuse(Rule):
+    id = "GL004"
+    title = "rng-key-reuse"
+    predicts = "correlated randomness (no counter; silently wrong)"
+
+    _MSG = ("RNG key `{}` flows into a second jax.random call (first "
+            "consumed at line {}) without an intervening split: both "
+            "draws see identical randomness — use "
+            "`jax.random.split` and consume each subkey once")
+
+    def check(self, ctx):
+        for body in _scope_bodies(ctx):
+            consumed = {}  # name -> first-use line
+            for kind, name, node in _scope_events(body, ctx):
+                if kind == "keyuse":
+                    if name in consumed:
+                        yield ctx.finding(
+                            node, self.id,
+                            self._MSG.format(name, consumed[name]))
+                    else:
+                        consumed[name] = node.lineno
+                elif kind == "store":
+                    consumed.pop(name, None)
+
+
+class TracerControlFlow(Rule):
+    id = "GL005"
+    title = "tracer-control-flow"
+    predicts = "compile_stats().n_traces (per-branch) or trace error"
+
+    _MSG = ("`{}` branches on traced argument `{}` inside a "
+            "jit-compiled function: tracing either fails "
+            "(TracerBoolConversionError) or the argument must go "
+            "static and every distinct value retraces — use "
+            "jax.lax.cond / jax.lax.while_loop / jnp.where [predicts "
+            "{}]")
+
+    def check(self, ctx):
+        for def_node in ctx.jit_defs:
+            if isinstance(def_node, ast.Lambda):
+                continue
+            hazard_names = ctx.traced_params(def_node)
+            if not hazard_names:
+                continue
+            for node in ast.walk(def_node):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                offender = self._traced_test_name(node.test,
+                                                  hazard_names)
+                if offender is not None:
+                    keyword = ("if" if isinstance(node, ast.If)
+                               else "while")
+                    yield ctx.finding(
+                        node, self.id,
+                        self._MSG.format(keyword, offender,
+                                         self.predicts))
+
+    def _traced_test_name(self, test, hazard_names):
+        """First hazard parameter whose VALUE the test depends on.
+        Static facts about a traced arg are excluded: `x is None`,
+        `isinstance(x, ...)`, `len(x)`, and attribute access like
+        `x.ndim`/`cfg.remat` (shape/config metadata, known at trace
+        time)."""
+        found = []
+
+        def collect(node):
+            if isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops):
+                return
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in _STATIC_CALLS):
+                return
+            if isinstance(node, ast.Attribute):
+                if isinstance(node.value, ast.Name):
+                    return
+                collect(node.value)
+                return
+            if isinstance(node, ast.Name):
+                if (isinstance(node.ctx, ast.Load)
+                        and node.id in hazard_names):
+                    found.append(node.id)
+                return
+            for child in ast.iter_child_nodes(node):
+                collect(child)
+
+        collect(test)
+        return found[0] if found else None
+
+
+class ShardingAxisMismatch(Rule):
+    id = "GL006"
+    title = "sharding-axis-mismatch"
+    predicts = "mesh-resolution error at dispatch (after compile time)"
+
+    _MSG = ("PartitionSpec axis {!r} is not declared by any mesh "
+            "literal in this file (declared: {}): "
+            "with_sharding_constraint would fail at dispatch, after "
+            "the compile was already paid — fix the axis name or the "
+            "mesh's axis_names")
+
+    def check(self, ctx):
+        if not ctx.mesh_axes:
+            return
+        declared = ", ".join(sorted(ctx.mesh_axes))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            if name not in ctx.pspec_aliases:
+                continue
+            for arg in node.args:
+                value = _literal(arg)
+                axes = []
+                if isinstance(value, str):
+                    axes = [value]
+                elif isinstance(value, (tuple, list)):
+                    axes = [v for v in value if isinstance(v, str)]
+                for axis in axes:
+                    if axis not in ctx.mesh_axes:
+                        yield ctx.finding(
+                            arg, self.id,
+                            self._MSG.format(axis, declared))
+
+
+ALL_RULES = [HostSyncInJit(), RetraceHazard(), DonationAfterUse(),
+             RngKeyReuse(), TracerControlFlow(),
+             ShardingAxisMismatch()]

@@ -14,6 +14,7 @@ Reference parity: core/run.py:31-265, TPU-first:
 import os
 import sys
 
+from cloud_tpu.analysis import preflight
 from cloud_tpu.core import containerize
 from cloud_tpu.core import deploy
 from cloud_tpu.core import gcp
@@ -43,6 +44,7 @@ def run(
     job_labels=None,
     container_builder_cls=None,
     api_client=None,
+    lint="warn",
     **kwargs
 ):
     """Runs your training code on Cloud TPUs (or GPUs) in GCP.
@@ -70,6 +72,10 @@ def run(
             offline use and tests.
         api_client: Optional AI-Platform jobs API client forwarded to
             `deploy.deploy_job` (same seam).
+        lint: graftlint preflight mode for the entry point's code
+            (`cloud_tpu.analysis`): "warn" (default) reports findings
+            and proceeds, "strict" raises before containerize, "off"
+            skips. Notebook entry points are never linted.
         **kwargs: Swallowed-then-rejected for forward compatibility with
             newer clients in older cloud environments (reference
             run.py:137-145).
@@ -116,7 +122,15 @@ def run(
         called_from_notebook,
         job_labels=job_labels or {},
         docker_base_image=docker_base_image,
+        lint=lint,
     )
+
+    # Static analysis of the code being shipped, after argument
+    # validation and before any containerize/deploy spend: a GL001
+    # host sync or GL002 retrace hazard is exactly the class of bug
+    # that otherwise only surfaces as wall-clock pathology on the
+    # slice (runtime.transfer_stats/compile_stats counters at epoch 2).
+    preflight.preflight_lint(entry_point, mode=lint)
 
     # Make the entry point cloud- and distribution-ready (reference
     # run.py:184-200; the None-entry_point crash when strategy is None is

@@ -32,6 +32,7 @@ def validate(
     called_from_notebook,
     job_labels=None,
     docker_base_image=None,
+    lint="warn",
 ):
     """Validates the inputs to `run()`.
 
@@ -56,6 +57,9 @@ def validate(
         called_from_notebook: Boolean, True when invoked from a notebook.
         job_labels: Dict of str: str labels to organize jobs.
         docker_base_image: Optional base docker image name.
+        lint: "warn", "strict" or "off" — the graftlint preflight mode
+            (`cloud_tpu.analysis`); the lint itself runs in `run()`
+            after validation, this only rejects unknown modes.
 
     Raises:
         ValueError: if any of the inputs is invalid.
@@ -65,6 +69,7 @@ def validate(
     _validate_cluster_config(
         chief_config, worker_count, worker_config, docker_base_image)
     gcp.validate_job_labels(job_labels or {})
+    _validate_lint_mode(lint)
     _validate_other_args(
         region,
         entry_point_args,
@@ -178,6 +183,15 @@ def _validate_tpu_base_image(docker_base_image):
             "unset to get one automatically.".format(docker_base_image))
 
 
+def _validate_lint_mode(lint):
+    """The graftlint preflight knob takes exactly three modes."""
+    if lint not in ("warn", "strict", "off"):
+        raise ValueError(
+            "Invalid `lint` input. "
+            'Expected "warn", "strict" or "off". '
+            "Received {}.".format(str(lint)))
+
+
 def _validate_other_args(region, args, stream_logs, docker_image_bucket_name,
                          called_from_notebook):
     """Reference validate.py:184-218."""
@@ -191,6 +205,15 @@ def _validate_other_args(region, args, stream_logs, docker_image_bucket_name,
         raise ValueError(
             "Invalid `entry_point_args` input. "
             "Expected None or a list. "
+            "Received {}.".format(str(args)))
+
+    if args is not None and any(not isinstance(a, str) for a in args):
+        # argv elements must already be strings: subprocess/AI-Platform
+        # would coerce (or crash on) non-strings at deploy time, after
+        # the container build was already paid.
+        raise ValueError(
+            "Invalid `entry_point_args` input. "
+            "Expected every element to be a string. "
             "Received {}.".format(str(args)))
 
     if not isinstance(stream_logs, bool):

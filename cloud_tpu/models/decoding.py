@@ -16,6 +16,7 @@ Cache variables created on the calling module ("cache" collection):
 """
 
 import functools
+import re
 import warnings
 
 import jax
@@ -73,12 +74,20 @@ def decode_slot_update(module, mask, batch, seq, cache_len):
     return idx, positions, allowed
 
 
-# The exact text jax emits when donated buffers can't alias (a plain
-# `warnings.warn`, so category UserWarning) — PINNED against jax
-# 0.4.37, jax/_src/interpreters/mlir.py. A jax upgrade that rewords
-# the message downgrades the suppression below to a no-op: the
-# warning becomes visible again (fail open), never wrongly silenced.
-_DONATION_MSG = "Some donated buffers were not usable"
+# The load-bearing fragment of the warning jax emits when donated
+# buffers can't alias (a plain `warnings.warn`, so category
+# UserWarning; jax/_src/interpreters/mlir.py). Matching a FRAGMENT
+# rather than jax 0.4.37's exact text ("Some donated buffers were not
+# usable: ...") keeps the suppression armed across jax releases that
+# reword the sentence around it — prefix AND suffix are free to
+# change. Only if the core phrase itself disappears does the filter
+# degrade to a no-op: the warning becomes visible again (fail open),
+# never wrongly silenced.
+_DONATION_FRAGMENT = "donated buffers were not usable"
+# `warnings.filterwarnings` anchors its regex at the start of the
+# message, so a leading wildcard makes this a substring match; the
+# escape is future-proofing for fragments with regex metacharacters.
+_DONATION_PATTERN = r".*" + re.escape(_DONATION_FRAGMENT)
 
 
 def _arm_donation_filter():
@@ -90,10 +99,10 @@ def _arm_donation_filter():
     string compare, not a filter-list mutation."""
     for entry in warnings.filters:
         if (entry[0] == "ignore"
-                and getattr(entry[1], "pattern", None) == _DONATION_MSG
+                and getattr(entry[1], "pattern", None) == _DONATION_PATTERN
                 and entry[2] is UserWarning):
             return
-    warnings.filterwarnings("ignore", message=_DONATION_MSG,
+    warnings.filterwarnings("ignore", message=_DONATION_PATTERN,
                             category=UserWarning)
 
 

@@ -41,7 +41,6 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from cloud_tpu.models.llama import (_GATE_ACTIVATIONS, RopeScaling,
                                     SwiGLU, apply_rope)

@@ -22,6 +22,7 @@ the tuner's objective readback need. A matching minimal reader is
 provided for tests and for the tuner-side parsing path.
 """
 
+import json
 import os
 import socket
 import struct
@@ -151,6 +152,39 @@ class EventFileWriter:
         self.flush()
 
 
+# -- Structured job events (JSONL side channel) -------------------------
+
+
+def log_job_event(kind, payload, path=None):
+    """Appends one structured job event as a JSONL line.
+
+    The scalar event files above are the TensorBoard-compat channel;
+    this is the machine-readable side channel for launch-time facts
+    that have no step axis — preflight lint findings, deploy
+    decisions, preemption notices. `path` defaults to the
+    CLOUD_TPU_EVENT_LOG environment variable; when neither is set the
+    call is a no-op (returns None), so library code can log
+    unconditionally. Local and gs:// paths both work (appends ride
+    `storage.append_bytes`).
+
+    Returns the path written to, or None when logging is disabled.
+    """
+    path = path or os.environ.get("CLOUD_TPU_EVENT_LOG")
+    if not path:
+        return None
+    record = {"time": time.time(), "host": socket.gethostname(),
+              "kind": kind, "payload": payload}
+    storage.append_bytes(
+        path, (json.dumps(record, sort_keys=True) + "\n").encode("utf-8"))
+    return path
+
+
+def read_job_events(path):
+    """Parses a JSONL job-event file -> list of dicts (skips blanks)."""
+    data = storage.read_bytes(path).decode("utf-8")
+    return [json.loads(line) for line in data.splitlines() if line.strip()]
+
+
 # -- Reader (tests + tuner-side readback) -------------------------------
 
 
@@ -241,4 +275,4 @@ def read_events(path):
 
 
 __all__ = ["EventFileWriter", "read_events", "crc32c",
-           "encode_scalar_event"]
+           "encode_scalar_event", "log_job_event", "read_job_events"]

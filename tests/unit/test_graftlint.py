@@ -1,0 +1,533 @@
+"""graftlint: per-rule fixtures, suppression, CLI contract, preflight.
+
+Every rule gets at least one fixture that fires and one that stays
+silent (the acceptance bar for heuristic rules: unambiguous pitfalls
+flagged, idiomatic code untouched). The meta-test at the bottom pins
+the self-run: this repository lints clean, and CI enforces that with
+`--strict` from here on.
+"""
+
+import io
+import json
+import os
+from unittest import mock
+
+import pytest
+
+import cloud_tpu
+from cloud_tpu.analysis import engine
+from cloud_tpu.analysis import lint
+from cloud_tpu.analysis import preflight
+from cloud_tpu.core import machine_config
+from cloud_tpu.core import run as run_module
+from cloud_tpu.utils import events
+
+CONFIGS = machine_config.COMMON_MACHINE_CONFIGS
+
+
+def rules_of(source):
+    return [f.rule for f in engine.check_source(source)]
+
+
+# A GL001 pitfall as a complete training script — used by the CLI and
+# preflight tests below, and the shape of the "seeded pitfall" check
+# from the acceptance criteria.
+PITFALL_SCRIPT = """\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def train_step(params, batch):
+    loss = jnp.sum(batch)
+    print("loss", float(loss))
+    return params, loss
+"""
+
+
+class TestGL001HostSyncInJit:
+
+    def test_float_print_item_asarray_fire(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    a = float(x)\n"
+            "    print(x)\n"
+            "    b = x.item()\n"
+            "    c = np.asarray(x)\n"
+            "    return a, b, c\n")
+        assert rules_of(src) == ["GL001"] * 4
+
+    def test_outside_jit_silent(self):
+        src = (
+            "import jax\n"
+            "def f(x):\n"
+            "    return float(x), x.item()\n"
+            "loss = float(jax.numpy.ones(()))\n"
+            "print(loss)\n")
+        assert rules_of(src) == []
+
+    def test_instrumented_jit_return_form_detected(self):
+        # The trainer idiom: a nested def handed to instrumented_jit in
+        # a return statement, no decorator, no assignment.
+        src = (
+            "from cloud_tpu.parallel import runtime\n"
+            "def build():\n"
+            "    def step(state, batch):\n"
+            "        print(batch)\n"
+            "        return state\n"
+            "    return runtime.instrumented_jit(step, donate_argnums=0)\n")
+        assert rules_of(src) == ["GL001"]
+
+    def test_jax_debug_print_silent(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    jax.debug.print('x={x}', x=x)\n"
+            "    return x\n")
+        assert rules_of(src) == []
+
+
+class TestGL002RetraceHazard:
+
+    def test_loop_var_and_len_fire(self):
+        src = (
+            "import jax\n"
+            "step = jax.jit(lambda x, i: x + i)\n"
+            "def drive(x, xs):\n"
+            "    for i in range(3):\n"
+            "        x = step(x, i)\n"
+            "    return step(x, len(xs))\n")
+        assert rules_of(src) == ["GL002", "GL002"]
+
+    def test_static_argnums_silences_call_site(self):
+        src = (
+            "import jax\n"
+            "step = jax.jit(lambda x, i: x + i, static_argnums=1)\n"
+            "def drive(x, xs):\n"
+            "    for i in range(3):\n"
+            "        x = step(x, i)\n"
+            "    return step(x, len(xs))\n")
+        assert rules_of(src) == []
+
+    def test_dict_literal_arg_fires(self):
+        src = (
+            "import jax\n"
+            "step = jax.jit(lambda x, cfg: x)\n"
+            "out = step(1.0, {'lr': 0.1})\n")
+        assert rules_of(src) == ["GL002"]
+
+    def test_mutable_global_closure_fires(self):
+        src = (
+            "import jax\n"
+            "SCALES = {'loss': 2.0}\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * SCALES['loss']\n")
+        assert rules_of(src) == ["GL002"]
+
+    def test_shadowed_or_immutable_global_silent(self):
+        src = (
+            "import jax\n"
+            "SCALES = {'loss': 2.0}\n"
+            "SCALE = 2.0\n"
+            "@jax.jit\n"
+            "def f(x, SCALES=None):\n"
+            "    return x * SCALE if SCALES is None else x\n")
+        assert rules_of(src) == []
+
+
+class TestGL003DonationAfterUse:
+
+    def test_read_after_donation_fires(self):
+        src = (
+            "import jax\n"
+            "step = jax.jit(lambda s, b: s, donate_argnums=0)\n"
+            "def drive(state, batch):\n"
+            "    new_state = step(state, batch)\n"
+            "    return state\n")
+        assert rules_of(src) == ["GL003"]
+
+    def test_rebinding_silences(self):
+        src = (
+            "import jax\n"
+            "step = jax.jit(lambda s, b: s, donate_argnums=0)\n"
+            "def drive(state, batch):\n"
+            "    state = step(state, batch)\n"
+            "    return state\n")
+        assert rules_of(src) == []
+
+    def test_non_donated_position_silent(self):
+        src = (
+            "import jax\n"
+            "step = jax.jit(lambda s, b: s, donate_argnums=0)\n"
+            "def drive(state, batch):\n"
+            "    state = step(state, batch)\n"
+            "    return batch\n")
+        assert rules_of(src) == []
+
+
+class TestGL004RngKeyReuse:
+
+    def test_reuse_fires(self):
+        src = (
+            "import jax\n"
+            "def f(key, shape):\n"
+            "    a = jax.random.normal(key, shape)\n"
+            "    b = jax.random.bernoulli(key, 0.5, shape)\n"
+            "    return a, b\n")
+        assert rules_of(src) == ["GL004"]
+
+    def test_split_and_rebind_silent(self):
+        src = (
+            "import jax\n"
+            "def f(key, shape):\n"
+            "    key, sub = jax.random.split(key)\n"
+            "    a = jax.random.normal(sub, shape)\n"
+            "    key, sub = jax.random.split(key)\n"
+            "    b = jax.random.bernoulli(sub, 0.5, shape)\n"
+            "    return a, b\n")
+        assert rules_of(src) == []
+
+    def test_from_jax_import_random_alias_tracked(self):
+        src = (
+            "from jax import random\n"
+            "def f(key):\n"
+            "    a = random.normal(key, (2,))\n"
+            "    b = random.uniform(key, (2,))\n"
+            "    return a, b\n")
+        assert rules_of(src) == ["GL004"]
+
+    def test_prngkey_creation_not_a_consumption(self):
+        src = (
+            "import jax\n"
+            "def f(seed):\n"
+            "    key = jax.random.PRNGKey(seed)\n"
+            "    return jax.random.normal(key, (2,))\n")
+        assert rules_of(src) == []
+
+
+class TestGL005TracerControlFlow:
+
+    def test_branch_on_traced_param_fires(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, flag):\n"
+            "    if flag:\n"
+            "        x = x + 1\n"
+            "    return x\n")
+        assert rules_of(src) == ["GL005"]
+
+    def test_while_on_traced_param_fires(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    while x > 0:\n"
+            "        x = x - 1\n"
+            "    return x\n")
+        assert rules_of(src) == ["GL005"]
+
+    def test_static_argnames_silences(self):
+        src = (
+            "import jax\n"
+            "import functools\n"
+            "@functools.partial(jax.jit, static_argnames=('flag',))\n"
+            "def f(x, flag):\n"
+            "    if flag:\n"
+            "        x = x + 1\n"
+            "    return x\n")
+        assert rules_of(src) == []
+
+    def test_static_facts_about_traced_args_silent(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, mask=None):\n"
+            "    if mask is None:\n"
+            "        mask = x * 0\n"
+            "    if x.ndim == 2:\n"
+            "        x = x[None]\n"
+            "    if len(x) > 1:\n"
+            "        x = x + 1\n"
+            "    if isinstance(mask, tuple):\n"
+            "        mask = mask[0]\n"
+            "    return x, mask\n")
+        assert rules_of(src) == []
+
+
+class TestGL006ShardingAxisMismatch:
+
+    def test_undeclared_axis_fires(self):
+        src = (
+            "from jax.sharding import Mesh, PartitionSpec as P\n"
+            "mesh = Mesh(devs, ('data', 'model'))\n"
+            "spec = P('data', 'tensor')\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL006"]
+        assert "'tensor'" in findings[0].message
+
+    def test_declared_axes_and_none_silent(self):
+        src = (
+            "from jax.sharding import Mesh, PartitionSpec as P\n"
+            "mesh = Mesh(devs, axis_names=('data', 'model'))\n"
+            "spec = P('data', None)\n"
+            "spec2 = P(('data', 'model'))\n")
+        assert rules_of(src) == []
+
+    def test_no_mesh_literal_no_opinion(self):
+        # Axis names built dynamically: the rule cannot judge, so it
+        # must not guess.
+        src = (
+            "from jax.sharding import PartitionSpec as P\n"
+            "spec = P('anything')\n")
+        assert rules_of(src) == []
+
+
+class TestSuppression:
+
+    def test_same_line_disable(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x)  # graftlint: disable=GL001\n")
+        assert rules_of(src) == []
+
+    def test_disable_wrong_rule_keeps_finding(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x)  # graftlint: disable=GL002\n")
+        assert rules_of(src) == ["GL001"]
+
+    def test_disable_all(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x)  # graftlint: disable=all\n")
+        assert rules_of(src) == []
+
+    def test_disable_file(self):
+        src = (
+            "# graftlint: disable-file=GL001\n"
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    print(x)\n"
+            "    return float(x)\n")
+        assert rules_of(src) == []
+
+    def test_multiple_codes_one_comment(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, flag):\n"
+            "    if flag: x = float(x)  # graftlint: disable=GL001,GL005\n"
+            "    return x\n")
+        assert rules_of(src) == []
+
+
+class TestParseError:
+
+    def test_syntax_error_is_gl000(self):
+        findings = engine.check_source("def broken(:\n", "bad.py")
+        assert [f.rule for f in findings] == [engine.PARSE_ERROR]
+
+
+class TestCli:
+
+    def _run(self, argv):
+        out = io.StringIO()
+        code = lint.main(argv, out=out)
+        return code, out.getvalue()
+
+    def test_text_output_and_warn_exit(self, tmp_path):
+        target = tmp_path / "train.py"
+        target.write_text(PITFALL_SCRIPT)
+        code, output = self._run([str(target)])
+        assert code == 0  # warn mode: report, don't gate
+        assert "GL001" in output
+        assert "finding(s)" in output
+
+    def test_strict_gates(self, tmp_path):
+        target = tmp_path / "train.py"
+        target.write_text(PITFALL_SCRIPT)
+        code, _ = self._run([str(target), "--strict"])
+        assert code == 1
+        target.write_text("x = 1\n")
+        code, _ = self._run([str(target), "--strict"])
+        assert code == 0
+
+    def test_json_schema_stable(self, tmp_path):
+        target = tmp_path / "train.py"
+        target.write_text(PITFALL_SCRIPT)
+        code, output = self._run([str(target), "--format", "json"])
+        doc = json.loads(output)
+        assert set(doc) == {"version", "files_checked", "counts",
+                            "findings"}
+        assert doc["version"] == 1
+        assert doc["files_checked"] == 1
+        assert doc["counts"] == {"GL001": 2}
+        assert [set(f) for f in doc["findings"]] == [
+            {"path", "line", "col", "rule", "message"}] * 2
+        assert all(f["rule"] == "GL001" for f in doc["findings"])
+
+    def test_select_filters_rules(self, tmp_path):
+        target = tmp_path / "train.py"
+        target.write_text(PITFALL_SCRIPT)
+        code, output = self._run([str(target), "--select", "GL004",
+                                  "--format", "json"])
+        assert json.loads(output)["findings"] == []
+
+    def test_unknown_select_is_usage_error(self, tmp_path):
+        target = tmp_path / "train.py"
+        target.write_text("x = 1\n")
+        code, _ = self._run([str(target), "--select", "GL999"])
+        assert code == 2
+
+    def test_missing_path_is_usage_error(self):
+        code, _ = self._run(["/no/such/dir"])
+        assert code == 2
+
+    def test_directory_walk_skips_pycache(self, tmp_path):
+        (tmp_path / "pkg").mkdir()
+        (tmp_path / "pkg" / "good.py").write_text("x = 1\n")
+        (tmp_path / "pkg" / "__pycache__").mkdir()
+        (tmp_path / "pkg" / "__pycache__" / "junk.py").write_text("(((\n")
+        code, output = self._run([str(tmp_path / "pkg"), "--strict"])
+        assert code == 0
+        assert "1 file(s)" in output
+
+
+# -- preflight: the run() hook ----------------------------------------
+
+
+@pytest.fixture
+def project_env(monkeypatch):
+    monkeypatch.setenv("GOOGLE_CLOUD_PROJECT", "my-project")
+    monkeypatch.delenv("CLOUD_TPU_RUNNING_REMOTELY", raising=False)
+    monkeypatch.delenv("TF_KERAS_RUNNING_REMOTELY", raising=False)
+    monkeypatch.delenv("CLOUD_TPU_EVENT_LOG", raising=False)
+
+
+@pytest.fixture
+def pitfall_entry(tmp_path, monkeypatch):
+    (tmp_path / "train.py").write_text(PITFALL_SCRIPT)
+    monkeypatch.chdir(tmp_path)
+    return "train.py"
+
+
+def _mock_cloud(monkeypatch):
+    builder = mock.MagicMock()
+    builder.get_docker_image.return_value = "gcr.io/my-project/img:tag"
+    builder.get_generated_files.return_value = []
+    monkeypatch.setattr(run_module.containerize, "LocalContainerBuilder",
+                        mock.MagicMock(return_value=builder))
+    deploy_job = mock.MagicMock(return_value="job_123")
+    monkeypatch.setattr(run_module.deploy, "deploy_job", deploy_job)
+    return deploy_job
+
+
+class TestPreflight:
+
+    def test_warn_mode_reports_and_proceeds(self, project_env,
+                                            pitfall_entry, monkeypatch,
+                                            capsys):
+        deploy_job = _mock_cloud(monkeypatch)
+        job_id = run_module.run(entry_point=pitfall_entry,
+                                distribution_strategy=None)
+        assert job_id == "job_123"
+        deploy_job.assert_called_once()
+        err = capsys.readouterr().err
+        assert "graftlint preflight" in err
+        assert "GL001" in err
+
+    def test_strict_mode_raises_before_containerize(self, project_env,
+                                                    pitfall_entry,
+                                                    monkeypatch):
+        deploy_job = _mock_cloud(monkeypatch)
+        with pytest.raises(preflight.GraftlintError, match="GL001"):
+            run_module.run(entry_point=pitfall_entry,
+                           distribution_strategy=None, lint="strict")
+        deploy_job.assert_not_called()
+
+    def test_off_mode_skips(self, project_env, pitfall_entry,
+                            monkeypatch, capsys):
+        deploy_job = _mock_cloud(monkeypatch)
+        run_module.run(entry_point=pitfall_entry,
+                       distribution_strategy=None, lint="off")
+        deploy_job.assert_called_once()
+        assert "graftlint" not in capsys.readouterr().err
+
+    def test_clean_entry_point_is_quiet(self, project_env, tmp_path,
+                                        monkeypatch, capsys):
+        deploy_job = _mock_cloud(monkeypatch)
+        (tmp_path / "ok.py").write_text("print('training')\n")
+        monkeypatch.chdir(tmp_path)
+        run_module.run(entry_point="ok.py", distribution_strategy=None,
+                       lint="strict")
+        deploy_job.assert_called_once()
+        assert "graftlint" not in capsys.readouterr().err
+
+    def test_invalid_mode_rejected_by_validate(self, project_env,
+                                               pitfall_entry):
+        with pytest.raises(ValueError, match="Invalid `lint`"):
+            run_module.run(entry_point=pitfall_entry, lint="fix")
+
+    def test_findings_land_in_job_event_log(self, project_env,
+                                            pitfall_entry, monkeypatch,
+                                            tmp_path, capsys):
+        _mock_cloud(monkeypatch)
+        log_path = str(tmp_path / "events.jsonl")
+        monkeypatch.setenv("CLOUD_TPU_EVENT_LOG", log_path)
+        run_module.run(entry_point=pitfall_entry,
+                       distribution_strategy=None)
+        records = events.read_job_events(log_path)
+        assert len(records) == 1
+        assert records[0]["kind"] == "graftlint"
+        payload = records[0]["payload"]
+        assert payload["mode"] == "warn"
+        assert payload["entry_point"] == "train.py"
+        assert {f["rule"] for f in payload["findings"]} == {"GL001"}
+        capsys.readouterr()
+
+    def test_notebook_entry_point_skipped(self, project_env, tmp_path,
+                                          monkeypatch):
+        (tmp_path / "nb.ipynb").write_text("{}")
+        monkeypatch.chdir(tmp_path)
+        assert preflight.resolve_target("nb.ipynb") is None
+        assert preflight.preflight_lint("nb.ipynb", "strict") == []
+
+    def test_direct_preflight_rejects_bad_mode(self):
+        with pytest.raises(ValueError, match="Invalid `lint`"):
+            preflight.preflight_lint("whatever.py", "loud")
+
+
+class TestSelfRun:
+    """The repository lints itself clean — CI enforces this with
+    --strict; a rule change that fires on our own tree must either fix
+    the code or carry an explicit suppression."""
+
+    def test_tree_is_graftlint_clean(self):
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(cloud_tpu.__file__)))
+        targets = [os.path.join(repo_root, "cloud_tpu")]
+        for extra in ("bench.py", "examples"):
+            path = os.path.join(repo_root, extra)
+            if os.path.exists(path):  # absent in installed layouts
+                targets.append(path)
+        findings, files_checked = engine.check_paths(targets)
+        assert files_checked > 50
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_every_rule_has_id_title_and_counter(self):
+        assert list(engine.RULES) == [
+            "GL001", "GL002", "GL003", "GL004", "GL005", "GL006"]
+        for rule in engine.RULES.values():
+            assert rule.title and rule.predicts

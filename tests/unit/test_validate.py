@@ -133,6 +133,17 @@ class TestOtherArgs:
         with pytest.raises(ValueError, match="entry_point_args"):
             _validate(entry_point_args="--epochs 5")
 
+    def test_args_elements_must_be_strings(self):
+        # argv elements reach subprocess/AI-Platform as-is; an int slips
+        # through type coercion only at deploy time, after the container
+        # build — reject it at preflight.
+        with pytest.raises(ValueError, match="element to be a string"):
+            _validate(entry_point_args=["--epochs", 5])
+        _validate(entry_point_args=["--epochs", "5"])
+
+    def test_empty_args_list_ok(self):
+        _validate(entry_point_args=[])
+
     def test_stream_logs_must_be_bool(self):
         with pytest.raises(ValueError, match="stream_logs"):
             _validate(stream_logs="yes")
@@ -146,3 +157,55 @@ class TestOtherArgs:
     def test_bad_job_labels(self):
         with pytest.raises(ValueError, match="lowercase"):
             _validate(job_labels={"Key": "value"})
+
+
+class TestLintMode:
+    """The graftlint preflight knob: mode names validated here, the
+    lint itself runs later on the run() path (test_graftlint.py)."""
+
+    def test_all_modes_accepted(self):
+        for mode in ("warn", "strict", "off"):
+            _validate(lint=mode)
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="Invalid `lint`"):
+            _validate(lint="fix")
+
+    def test_non_string_mode_rejected(self):
+        with pytest.raises(ValueError, match="Invalid `lint`"):
+            _validate(lint=True)
+
+
+class TestTpuBaseImage:
+    """Direct coverage of the docker base-image runtime check branches
+    (_validate_tpu_base_image replaces the reference's TF<=2.1 gate)."""
+
+    def test_none_base_image_skips_check(self):
+        _validate(chief_config=CONFIGS["TPU_V5E_8"],
+                  docker_base_image=None)
+
+    @pytest.mark.parametrize("image", [
+        "tensorflow/tensorflow:2.9.0-gpu",
+        "nvcr.io/nvidia/pytorch:24.01-py3",
+        "myregistry/cuda-jax:latest",
+    ])
+    def test_gpu_flavored_images_rejected(self, image):
+        with pytest.raises(ValueError, match="GPU/CUDA image"):
+            _validate(chief_config=CONFIGS["TPU_V5E_8"],
+                      docker_base_image=image)
+
+    def test_checked_for_tpu_worker_with_cpu_chief(self):
+        # The TPU side can be the WORKER config only; the base-image
+        # check must still gate.
+        with pytest.raises(ValueError, match="GPU/CUDA image"):
+            _validate(chief_config=CONFIGS["CPU"],
+                      worker_config=CONFIGS["TPU"],
+                      worker_count=1,
+                      docker_base_image="tensorflow/tensorflow:2.9.0-gpu")
+
+    def test_not_checked_for_pure_gpu_cluster(self):
+        # A GPU job may of course use a CUDA base image.
+        _validate(chief_config=CONFIGS["T4_1X"],
+                  worker_config=CONFIGS["T4_1X"],
+                  worker_count=1,
+                  docker_base_image="tensorflow/tensorflow:2.9.0-gpu")
