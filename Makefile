@@ -6,7 +6,7 @@ NATIVE_BUILD := $(NATIVE_DIR)/build
 # regression: assert-based tests segfaulted under Release).
 NATIVE_BUILD_REL := $(NATIVE_DIR)/build_rel
 
-.PHONY: native native-release native-test test all clean
+.PHONY: native native-release native-test test lint all clean
 
 all: native
 
@@ -25,6 +25,14 @@ native-test: native native-release
 
 test: native-test
 	python -m pytest tests/ -q
+
+# The same two analysis layers CI's `analysis` job gates on: ruff for
+# generic pyflakes/bugbear classes, graftlint --strict for the domain
+# rules (GL001-GL009). Run before pushing; pre-commit hooks run the
+# identical pair (see .pre-commit-config.yaml).
+lint:
+	ruff check cloud_tpu bench.py examples
+	python -m cloud_tpu.analysis.lint cloud_tpu bench.py examples tests --strict
 
 clean:
 	rm -rf $(NATIVE_BUILD) $(NATIVE_BUILD_REL)

@@ -8,13 +8,23 @@ job is containerized instead of as a wall-clock pathology on the slice.
 
 Three entry points share one engine:
 
-- CLI:       python -m cloud_tpu.analysis.lint <paths> [--strict] [--format json]
+- CLI:       python -m cloud_tpu.analysis.lint <paths> [--strict]
+             [--format json|sarif]
 - Preflight: `run(entry_point=..., lint="warn"|"strict"|"off")` lints the
-             entry point before containerize (analysis/preflight.py).
+             entry point AND its first-level local imports before
+             containerize (analysis/preflight.py).
 - Self-run:  CI runs the linter over this repository itself; the tree
              stays graftlint-clean.
 
-Pure `ast` + `tokenize` — the target is parsed, never imported.
+Pure `ast` + `tokenize` — the target is parsed, never imported. Rules
+GL006-GL009 are interprocedural: every file in one invocation shares a
+`callgraph.ProjectContext`, so facts flow through imports and calls.
+
+The dynamic complement is graftsan (analysis/sanitizer.py): `with
+sanitize():` — or `CLOUD_TPU_SANITIZE=1` around `Trainer.fit` — hooks
+the runtime's transfer/compile records and `jax.random` key
+consumption, attributes each event to its source line, and checks the
+same invariants the static rules encode.
 """
 
 from cloud_tpu.analysis.engine import Finding
@@ -23,6 +33,10 @@ from cloud_tpu.analysis.engine import check_paths
 from cloud_tpu.analysis.engine import check_source
 from cloud_tpu.analysis.preflight import GraftlintError
 from cloud_tpu.analysis.preflight import preflight_lint
+from cloud_tpu.analysis.sanitizer import GraftsanError
+from cloud_tpu.analysis.sanitizer import Sanitizer
+from cloud_tpu.analysis.sanitizer import sanitize
 
 __all__ = ["Finding", "RULES", "check_paths", "check_source",
-           "GraftlintError", "preflight_lint"]
+           "GraftlintError", "preflight_lint",
+           "GraftsanError", "Sanitizer", "sanitize"]

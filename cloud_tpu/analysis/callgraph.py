@@ -1,0 +1,445 @@
+"""Package-wide module graph + call graph for interprocedural graftlint.
+
+PR 4's rules are strictly intraprocedural: a `float()` two frames below
+a jitted body, a PRNG key consumed inside a helper, or a PartitionSpec
+checked against a Mesh declared in another file all sail through. This
+module gives the rules the facts they need to see across calls — still
+pure `ast`, targets parsed and never imported.
+
+Three ingredients:
+
+1. **Module graph.** Every linted file gets a dotted module name
+   (derived by walking up `__init__.py` parents, so
+   `cloud_tpu/parallel/runtime.py` -> `cloud_tpu.parallel.runtime`;
+   loose scripts use their stem). Import statements — absolute,
+   aliased, and relative — resolve to other linted modules when the
+   target is in the same lint invocation, and to nothing otherwise
+   (facts never cross into code we did not parse).
+
+2. **Call graph.** Module-level `def`s are registered per module; a
+   call `helper(...)`, `mod.helper(...)` or `from m import helper;
+   helper(...)` resolves to its `FunctionSummary`. Methods and nested
+   defs are deliberately unresolved — attribute dispatch on instances
+   is untyped guesswork, and a wrong edge turns a heuristic lint into
+   a noise source.
+
+3. **Transitive summaries.** Per function, computed to fixpoint over
+   the call graph (cycle-safe):
+   - `host_sync`: the function directly performs a host sync
+     (`float`/`.item()`/`np.asarray`/`print`/`jax.device_get`), or
+     calls (transitively) one that does. `host_sync_chain` reproduces
+     the full call chain for the finding message.
+   - `key_params`: parameters the function consumes as PRNG keys —
+     directly (first argument of a `jax.random.<fn>` call) or by
+     passing them into a callee's key parameter.
+   - `retained_params`: parameters the function stores somewhere that
+     outlives the call (an attribute, a subscript, a declared global,
+     or a `.append/.add/.insert` container call) — the escape facts
+     GL009 needs to see a donated buffer leak through a helper.
+"""
+
+import ast
+import os
+
+# Mirrors rules._STATIC_CALLS conceptually: container-mutation method
+# names that retain their argument beyond the call.
+_RETAIN_METHODS = {"append", "add", "insert", "appendleft", "push",
+                   "setdefault"}
+
+#: Hard ceiling on call-chain depth for transitive facts. Real pitfalls
+#: hide one or two frames down; past that the chain message is noise
+#: and a pathological tree could make the DFS expensive.
+MAX_CHAIN_DEPTH = 8
+
+
+def module_name_for(path):
+    """Dotted module name for a file path.
+
+    Walks up while `__init__.py` siblings exist, so files inside a
+    package get their importable name; loose files get their stem.
+    `__init__.py` itself names the package.
+    """
+    path = os.path.abspath(path)
+    directory, base = os.path.split(path)
+    stem = base[:-3] if base.endswith(".py") else base
+    parts = [] if stem == "__init__" else [stem]
+    while os.path.isfile(os.path.join(directory, "__init__.py")):
+        directory, pkg = os.path.split(directory)
+        if not pkg:
+            break
+        parts.insert(0, pkg)
+    return ".".join(parts) if parts else stem
+
+
+class FunctionSummary:
+    """Everything the interprocedural rules know about one def."""
+
+    __slots__ = ("name", "qualname", "module", "node", "ctx",
+                 "params", "direct_sync", "calls", "key_params",
+                 "retained_params")
+
+    def __init__(self, name, module, node, ctx):
+        self.name = name
+        self.module = module                  # ModuleView
+        self.qualname = "{}.{}".format(module.name, name)
+        self.node = node
+        self.ctx = ctx
+        args = node.args
+        self.params = [a.arg for a in args.posonlyargs + args.args]
+        #: (label, line) of a direct host-sync call in the body, or None.
+        self.direct_sync = None
+        #: [(call_node, callee_name_expr)] — resolved lazily.
+        self.calls = []
+        #: param name -> (line, via_summary, via_param): the consuming
+        #: jax.random call's line (via None) or the line of the call
+        #: that forwards the key into `via_summary`'s `via_param`.
+        self.key_params = {}
+        #: param name -> (line, how, via_summary, via_param) for params
+        #: retained past the call; `how` is the human label, via fields
+        #: follow the same convention as key_params.
+        self.retained_params = {}
+
+    def __repr__(self):
+        return "FunctionSummary({})".format(self.qualname)
+
+
+class ModuleView:
+    """Per-file view of the project: name, context, import resolution."""
+
+    __slots__ = ("path", "name", "ctx", "functions", "import_modules",
+                 "from_imports")
+
+    def __init__(self, path, name, ctx):
+        self.path = path
+        self.name = name
+        self.ctx = ctx
+        #: top-level def name -> FunctionSummary
+        self.functions = {}
+        #: local alias -> dotted module (import x.y as z; import x.y)
+        self.import_modules = {}
+        #: local name -> (dotted module, original name) for
+        #: `from m import f [as g]` (f may itself be a submodule).
+        self.from_imports = {}
+
+
+class ProjectContext:
+    """The cross-file fact base rules GL006-GL009 read.
+
+    Built once per lint invocation from every parseable file in it.
+    Single-file runs get a one-module project, so interprocedural
+    rules still see helpers defined in the same file.
+    """
+
+    def __init__(self, contexts):
+        #: path -> ModuleView
+        self.modules = {}
+        #: dotted name -> ModuleView (first wins on duplicates)
+        self.by_name = {}
+        #: axis name -> sorted list of declaring module paths
+        self.mesh_axes = {}
+        for ctx in contexts:
+            view = ModuleView(ctx.path, module_name_for(ctx.path), ctx)
+            self.modules[ctx.path] = view
+            self.by_name.setdefault(view.name, view)
+            for axis in ctx.mesh_axes:
+                self.mesh_axes.setdefault(axis, []).append(ctx.path)
+        for view in self.modules.values():
+            self._collect_imports(view)
+            self._collect_functions(view)
+        self._summarize_direct_facts()
+        self._fixpoint_key_and_retain()
+
+    # -- construction --------------------------------------------------
+
+    def _collect_imports(self, view):
+        for node in ast.walk(view.ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    # `import x.y` binds `x`; `import x.y as z` binds z
+                    # to x.y itself.
+                    target = alias.name if alias.asname else \
+                        alias.name.split(".")[0]
+                    view.import_modules[bound] = target
+            elif isinstance(node, ast.ImportFrom):
+                module = self._resolve_from(view, node)
+                if module is None:
+                    continue
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    view.from_imports[bound] = (module, alias.name)
+
+    @staticmethod
+    def _resolve_from(view, node):
+        """Absolute dotted module for a `from ... import` statement.
+
+        Relative imports resolve against the importing module's
+        package (cycle-safe by construction: name resolution only, no
+        recursion)."""
+        if not node.level:
+            return node.module
+        parts = view.name.split(".")
+        # level 1 strips the module segment, each extra level one
+        # package; a too-deep relative import resolves to nothing.
+        if node.level > len(parts):
+            return None
+        base = parts[:len(parts) - node.level]
+        if node.module:
+            base.append(node.module)
+        return ".".join(base) if base else None
+
+    def _collect_functions(self, view):
+        for node in view.ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                view.functions[node.name] = FunctionSummary(
+                    node.name, view, node, view.ctx)
+
+    # -- resolution ----------------------------------------------------
+
+    def view_for(self, ctx):
+        return self.modules.get(ctx.path)
+
+    def resolve_call(self, ctx, func):
+        """FunctionSummary for a Call's func expression, or None.
+
+        Handles `f(...)` (local def or from-import) and `mod.f(...)`
+        (module alias or from-imported submodule). Anything else —
+        methods, nested defs, chains — is unresolved on purpose.
+        """
+        view = self.view_for(ctx)
+        if view is None:
+            return None
+        if isinstance(func, ast.Name):
+            local = view.functions.get(func.id)
+            if local is not None:
+                return local
+            origin = view.from_imports.get(func.id)
+            if origin is not None:
+                target = self.by_name.get(origin[0])
+                if target is not None:
+                    return target.functions.get(origin[1])
+            return None
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)):
+            base = func.value.id
+            module = view.import_modules.get(base)
+            if module is None:
+                origin = view.from_imports.get(base)
+                if origin is not None:
+                    # `from cloud_tpu.parallel import runtime` — the
+                    # bound name is a submodule.
+                    module = "{}.{}".format(origin[0], origin[1])
+            if module is None:
+                return None
+            target = self.by_name.get(module)
+            if target is None:
+                return None
+            return target.functions.get(func.attr)
+        return None
+
+    # -- direct facts --------------------------------------------------
+
+    def _summarize_direct_facts(self):
+        from cloud_tpu.analysis import rules
+
+        for view in self.modules.values():
+            for summary in view.functions.values():
+                self._scan_body(view, summary, rules)
+
+    def _scan_body(self, view, summary, rules):
+        params = set(summary.params)
+        global_names = set()
+        for node in ast.walk(summary.node):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+        for node in ast.walk(summary.node):
+            if isinstance(node, ast.Call):
+                label = rules.HostSyncInJit._host_sync_label(node)
+                if label is not None and summary.direct_sync is None:
+                    summary.direct_sync = (label, node.lineno)
+                summary.calls.append(node)
+                # Direct key consumption: jax.random.<fn>(param, ...).
+                if (rules._is_random_call(node.func, view.ctx)
+                        and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in params):
+                    summary.key_params.setdefault(
+                        node.args[0].id, (node.lineno, None, None))
+                # Container retention: box.append(param) and friends.
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _RETAIN_METHODS):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name) and arg.id in params:
+                            summary.retained_params.setdefault(
+                                arg.id,
+                                (node.lineno,
+                                 ".{}()".format(node.func.attr),
+                                 None, None))
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = node.value
+                if not (isinstance(value, ast.Name)
+                        and value.id in params):
+                    continue
+                for target in targets:
+                    if isinstance(target, (ast.Attribute, ast.Subscript)):
+                        summary.retained_params.setdefault(
+                            value.id,
+                            (node.lineno,
+                             "attribute store" if isinstance(
+                                 target, ast.Attribute)
+                             else "subscript store",
+                             None, None))
+                    elif (isinstance(target, ast.Name)
+                          and target.id in global_names):
+                        summary.retained_params.setdefault(
+                            value.id,
+                            (node.lineno, "global store", None, None))
+
+    # -- fixpoint propagation ------------------------------------------
+
+    def _fixpoint_key_and_retain(self):
+        """Propagates key consumption and retention through call args.
+
+        A param flows into a callee when it appears as a plain Name in
+        a resolvable call's positional args; the callee's fact at that
+        position transfers back to the caller's param. Iterated to a
+        fixpoint — the graphs are small and each pass only adds facts,
+        so termination is by monotonicity.
+        """
+        changed = True
+        passes = 0
+        while changed and passes < 20:  # belt over the monotonic brace
+            changed = False
+            passes += 1
+            for view in self.modules.values():
+                for summary in view.functions.values():
+                    params = set(summary.params)
+                    for call in summary.calls:
+                        callee = self.resolve_call(view.ctx, call.func)
+                        if callee is None or callee is summary:
+                            continue
+                        for pos, arg in enumerate(call.args):
+                            if not (isinstance(arg, ast.Name)
+                                    and arg.id in params):
+                                continue
+                            if pos >= len(callee.params):
+                                continue
+                            callee_param = callee.params[pos]
+                            if (callee_param in callee.key_params
+                                    and arg.id not in summary.key_params):
+                                summary.key_params[arg.id] = (
+                                    call.lineno, callee, callee_param)
+                                changed = True
+                            if (callee_param in callee.retained_params
+                                    and arg.id not in
+                                    summary.retained_params):
+                                summary.retained_params[arg.id] = (
+                                    call.lineno,
+                                    "via {}".format(callee.qualname),
+                                    callee, callee_param)
+                                changed = True
+
+    # -- chain reconstruction ------------------------------------------
+
+    def consuming_key_param(self, ctx, call, name):
+        """(callee, param) when the Call passes local `name` into a
+        callee parameter known to consume it as a PRNG key; else None.
+        """
+        return self._param_fact(ctx, call, name, "key_params")
+
+    def retaining_param(self, ctx, call, name):
+        """(callee, param) when the Call passes local `name` into a
+        callee parameter known to retain it past the call; else None."""
+        return self._param_fact(ctx, call, name, "retained_params")
+
+    def _param_fact(self, ctx, call, name, table):
+        callee = self.resolve_call(ctx, call.func)
+        if callee is None:
+            return None
+        for pos, arg in enumerate(call.args):
+            if (isinstance(arg, ast.Name) and arg.id == name
+                    and pos < len(callee.params)
+                    and callee.params[pos] in getattr(callee, table)):
+                return callee, callee.params[pos]
+        return None
+
+    def key_chain(self, summary, param):
+        """[(qualname, line), ...] from `summary`'s `param` down to the
+        jax.random call that consumes it (depth-capped, cycle-safe)."""
+        return self._fact_chain(summary, param, "key_params")
+
+    def retain_chain(self, summary, param):
+        """[(qualname, line, how), ...] down to the direct retention."""
+        chain = []
+        for _ in range(MAX_CHAIN_DEPTH):
+            fact = summary.retained_params.get(param)
+            if fact is None:
+                break
+            line, how, via, via_param = fact
+            chain.append((summary.qualname, line, how))
+            if via is None:
+                break
+            summary, param = via, via_param
+        return chain
+
+    def _fact_chain(self, summary, param, table):
+        chain = []
+        for _ in range(MAX_CHAIN_DEPTH):
+            fact = getattr(summary, table).get(param)
+            if fact is None:
+                break
+            line, via, via_param = fact
+            chain.append((summary.qualname, line))
+            if via is None:
+                break
+            summary, param = via, via_param
+        return chain
+
+    # -- transitive host-sync chains -----------------------------------
+
+    def host_sync_chain(self, ctx, func, _depth=0, _visiting=None):
+        """Call chain from `func` (a Call's func expr in `ctx`) down to
+        a host-sync primitive, or None.
+
+        Returns [(qualname, line, label), ...] — one frame per hop,
+        last frame carrying the primitive's label and line. Callees
+        that are themselves jit-compiled are excluded: GL001 already
+        flags the sync inside them, and double-reporting one pitfall
+        under two rules would train people to suppress both.
+        """
+        summary = self.resolve_call(ctx, func)
+        if summary is None:
+            return None
+        return self._chain_from(summary, _depth, _visiting or set())
+
+    def _chain_from(self, summary, depth, visiting):
+        if depth >= MAX_CHAIN_DEPTH or summary in visiting:
+            return None
+        if summary.node in summary.ctx.jit_defs:
+            return None  # GL001's jurisdiction (see docstring)
+        if summary.direct_sync is not None:
+            label, line = summary.direct_sync
+            return [(summary.qualname, line, label)]
+        visiting = visiting | {summary}
+        for call in summary.calls:
+            sub = self.host_sync_chain(summary.ctx, call.func,
+                                       depth + 1, visiting)
+            if sub is not None:
+                return [(summary.qualname, call.lineno, None)] + sub
+        return None
+
+    # -- mesh axes -----------------------------------------------------
+
+    def mesh_axis_declared(self, axis):
+        return axis in self.mesh_axes
+
+    def declared_axes_label(self):
+        """Human-readable 'axis (module.py), ...' summary for messages."""
+        parts = []
+        for axis in sorted(self.mesh_axes):
+            paths = self.mesh_axes[axis]
+            parts.append("{!r} ({})".format(
+                axis, os.path.basename(paths[0])))
+        return ", ".join(parts) if parts else "none"

@@ -91,11 +91,8 @@ def _suppressed(finding, per_line, per_file):
     return "all" in codes or finding.rule in codes
 
 
-def check_source(source, path="<string>", select=None):
-    """Lints one source string -> sorted [Finding].
-
-    select: optional iterable of rule ids to run (default: all).
-    """
+def _parse_context(source, path):
+    """-> (FileContext, None) or (None, GL000 Finding)."""
     # Imported here, not at module top: rules imports engine for the
     # Finding type, and this lazy edge breaks the cycle.
     from cloud_tpu.analysis import rules
@@ -103,11 +100,16 @@ def check_source(source, path="<string>", select=None):
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
-        return [Finding(path, exc.lineno or 1, exc.offset or 0,
-                        PARSE_ERROR,
-                        "could not parse file: {}".format(exc.msg))]
-    per_line, per_file = _suppressions(source)
-    ctx = rules.FileContext(tree, source, path)
+        return None, Finding(path, exc.lineno or 1, exc.offset or 0,
+                             PARSE_ERROR,
+                             "could not parse file: {}".format(exc.msg))
+    return rules.FileContext(tree, source, path), None
+
+
+def _check_context(ctx, select):
+    """Runs the (selected) rules over one FileContext, honouring the
+    file's suppression comments. `ctx.project` must already be set."""
+    per_line, per_file = _suppressions(ctx.source)
     findings = []
     for rule in RULES.values():
         if select is not None and rule.id not in select:
@@ -115,7 +117,23 @@ def check_source(source, path="<string>", select=None):
         for finding in rule.check(ctx):
             if not _suppressed(finding, per_line, per_file):
                 findings.append(finding)
-    return sorted(findings, key=Finding.sort_key)
+    return findings
+
+
+def check_source(source, path="<string>", select=None):
+    """Lints one source string -> sorted [Finding].
+
+    select: optional iterable of rule ids to run (default: all).
+    The interprocedural rules see a one-module project, so chains
+    through helpers defined in the same source still resolve.
+    """
+    from cloud_tpu.analysis import callgraph
+
+    ctx, error = _parse_context(source, path)
+    if error is not None:
+        return [error]
+    ctx.project = callgraph.ProjectContext([ctx])
+    return sorted(_check_context(ctx, select), key=Finding.sort_key)
 
 
 def iter_python_files(paths):
@@ -146,13 +164,38 @@ def iter_python_files(paths):
 
 
 def check_paths(paths, select=None):
-    """Lints files/directories -> (sorted [Finding], files_checked)."""
+    """Lints files/directories -> (sorted [Finding], files_checked).
+
+    All parseable files share ONE `callgraph.ProjectContext`, so the
+    interprocedural rules (GL006-GL009) resolve imports and call
+    chains across every file in the invocation — linting a package
+    directory sees strictly more than linting its files one by one.
+    """
+    from cloud_tpu.analysis import callgraph
+
     files = iter_python_files(paths)
-    findings = []
+    findings, contexts = [], []
     for filename in files:
-        with open(filename, "r", encoding="utf-8") as handle:
-            source = handle.read()
-        findings.extend(check_source(source, filename, select=select))
+        try:
+            with open(filename, "r", encoding="utf-8") as handle:
+                source = handle.read()
+        except OSError as exc:
+            # A file that vanished or lost read permission between
+            # listing and reading (preflight races the user's editor)
+            # degrades to a finding, not a crashed lint run.
+            findings.append(Finding(
+                filename, 0, 0, PARSE_ERROR,
+                "unreadable: {}".format(exc)))
+            continue
+        ctx, error = _parse_context(source, filename)
+        if error is not None:
+            findings.append(error)
+        else:
+            contexts.append(ctx)
+    project = callgraph.ProjectContext(contexts)
+    for ctx in contexts:
+        ctx.project = project
+        findings.extend(_check_context(ctx, select))
     return sorted(findings, key=Finding.sort_key), len(files)
 
 
@@ -208,5 +251,5 @@ class _LazyRegistry(dict):
         return super().__contains__(key)
 
 
-#: Rule registry: id -> rule instance, in GL001..GL006 order.
+#: Rule registry: id -> rule instance, in GL001..GL009 order.
 RULES = _LazyRegistry()

@@ -13,6 +13,12 @@ JSON output schema (test-pinned, `--format json`):
      "counts": {"GL001": <int>, ...},        # only rules that fired
      "findings": [{"path": str, "line": int, "col": int,
                    "rule": str, "message": str}, ...]}    # sorted
+
+`--format sarif` emits a SARIF 2.1.0 log (one run, driver "graftlint",
+every registered rule in the rule table, findings as level "warning"
+results with 1-based line/column physical locations) — the interchange
+format code-scanning UIs (GitHub, VS Code SARIF viewer) ingest
+directly; CI uploads it as the analysis artifact.
 """
 
 import argparse
@@ -29,10 +35,10 @@ def _build_parser():
     parser = argparse.ArgumentParser(
         prog="python -m cloud_tpu.analysis.lint",
         description="graftlint: static analysis for JAX/TPU training "
-                    "code (rules GL001-GL006; see --list-rules).")
+                    "code (rules GL001-GL009; see --list-rules).")
     parser.add_argument("paths", nargs="*",
                         help=".py files and/or directories to lint")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text",
                         help="output format (default: text)")
     parser.add_argument("--strict", action="store_true",
@@ -50,6 +56,60 @@ def _list_rules(out):
     for rule in engine.RULES.values():
         out.write("{}  {:<24} predicts: {}\n".format(
             rule.id, rule.title, rule.predicts))
+
+
+#: SARIF spec version emitted by --format sarif (schema is test-pinned).
+SARIF_VERSION = "2.1.0"
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings, files_checked):
+    """Findings -> a SARIF 2.1.0 log dict (one run, driver graftlint).
+
+    Every registered rule (plus GL000, the parse-error pseudo-rule)
+    appears in the driver's rule table whether or not it fired, so
+    `ruleIndex` is stable across runs of the same linter version.
+    SARIF columns/lines are 1-based; `Finding.col` is the 0-based ast
+    col_offset.
+    """
+    rule_ids = [engine.PARSE_ERROR] + list(engine.RULES.keys())
+    rules = [{"id": engine.PARSE_ERROR,
+              "name": "parse-error",
+              "shortDescription": {"text": "file does not parse"}}]
+    for rule in engine.RULES.values():
+        rules.append({
+            "id": rule.id,
+            "name": rule.title,
+            "shortDescription": {"text": rule.title},
+            "fullDescription": {
+                "text": "predicts: {}".format(rule.predicts)},
+        })
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": rule_ids.index(f.rule),
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+            }],
+        })
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {"name": "graftlint",
+                                "rules": rules}},
+            "results": results,
+            "properties": {"files_checked": files_checked},
+        }],
+    }
 
 
 def run_lint(paths, select=None):
@@ -95,6 +155,9 @@ def main(argv=None, out=None):
                "counts": counts,
                "findings": [f.to_dict() for f in findings]}
         out.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    elif args.format == "sarif":
+        out.write(json.dumps(to_sarif(findings, files_checked),
+                             indent=2, sort_keys=True) + "\n")
     else:
         for finding in findings:
             out.write(finding.format() + "\n")

@@ -6,6 +6,12 @@ which is the whole point: a GL001 host sync or GL002 retrace hazard
 costs minutes of idle TPU slice once it is only discoverable from the
 job's wall-clock metrics.
 
+The lint covers the entry point AND its first-level local imports
+(`local_imports`): one level deep, bounded at MAX_IMPORT_FOLLOW files,
+cycle-safe — enough for the interprocedural rules (GL006-GL009) to see
+the helper modules a real training script factors its step functions
+into, without turning a launch into a whole-tree crawl.
+
 Modes (the `lint=` knob on `run()`):
 
     "warn"    (default) findings go to stderr + the job event log;
@@ -19,6 +25,7 @@ file — local or gs:// — gets a structured JSONL record of what the
 preflight saw, alongside whatever else the job logs.
 """
 
+import ast
 import os
 import sys
 
@@ -26,6 +33,11 @@ from cloud_tpu.analysis import engine
 from cloud_tpu.utils import events
 
 LINT_MODES = ("warn", "strict", "off")
+
+#: Import-following is first-level only, and even that is bounded: an
+#: entry point with a pathological import list can't turn preflight
+#: into a whole-tree lint (the CI self-run owns that job).
+MAX_IMPORT_FOLLOW = 16
 
 
 class GraftlintError(ValueError):
@@ -53,8 +65,73 @@ def resolve_target(entry_point):
     return target
 
 
+def local_imports(target):
+    """First-level local imports of `target` that exist as .py files.
+
+    "Local" means resolvable RELATIVE TO THE ENTRY POINT's directory —
+    the files that ship in the same container context and that the
+    user actually wrote; site-packages and stdlib imports resolve to
+    nothing here and are skipped. Both `import helpers` and
+    `from helpers import step` map to `<dir>/helpers.py`; dotted and
+    relative forms map through the package path (`from pkg.sub import
+    f` -> `<dir>/pkg/sub.py` or `<dir>/pkg/sub/__init__.py`). One
+    level only (imports of imports are NOT followed), capped at
+    MAX_IMPORT_FOLLOW, cycle-safe by construction (the entry point
+    itself is excluded, and each path appears once).
+
+    A `target` that is missing or unreadable yields [] — the caller
+    already linted (or failed to read) it; this helper never raises.
+    """
+    try:
+        with open(target, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=target)
+    except (OSError, SyntaxError, ValueError):
+        return []
+    base = os.path.dirname(os.path.abspath(target))
+
+    modules = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            modules.extend(alias.name for alias in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module:
+                # Relative imports (level>0) resolve against the entry
+                # point's own directory too — for a shipped flat
+                # context that IS the package root.
+                modules.append(node.module)
+            elif node.level:
+                # `from . import helpers`: the imported NAMES are the
+                # modules.
+                modules.extend(alias.name for alias in node.names)
+
+    found = []
+    seen = {os.path.abspath(target)}
+    for module in modules:
+        parts = module.split(".")
+        candidates = (
+            os.path.join(base, *parts) + ".py",
+            os.path.join(base, *(parts + ["__init__.py"])),
+        )
+        for candidate in candidates:
+            resolved = os.path.abspath(candidate)
+            if resolved in seen or not os.path.isfile(resolved):
+                continue
+            seen.add(resolved)
+            found.append(resolved)
+            break
+        if len(found) >= MAX_IMPORT_FOLLOW:
+            break
+    return found
+
+
 def preflight_lint(entry_point, mode="warn"):
-    """Lints the launch's entry point; returns the findings list.
+    """Lints the launch's entry point AND its first-level local
+    imports; returns the findings list.
+
+    The imports ride along because they ship in the same container: a
+    GL001 host sync in `helpers.py` costs the same idle slice minutes
+    as one in `train.py`, and the interprocedural rules (GL006-GL009)
+    only see cross-module facts when the modules are linted together.
 
     Raises GraftlintError in strict mode when anything fires, and
     ValueError on an unknown mode (validate.py rejects that earlier on
@@ -70,7 +147,7 @@ def preflight_lint(entry_point, mode="warn"):
     if target is None:
         return []
 
-    findings, _ = engine.check_paths([target])
+    findings, _ = engine.check_paths([target] + local_imports(target))
     if not findings:
         return []
 

@@ -1,4 +1,4 @@
-"""graftlint rules GL001-GL006.
+"""graftlint rules GL001-GL009.
 
 Every rule is keyed to the runtime counter it predicts (PERF.md has the
 table): the linter is the static half of the transfer/compile
@@ -15,6 +15,14 @@ jit-compiled (decorator forms, `functools.partial` forms, and
 `g = jax.jit(f, ...)` assignment forms), their static/donated argument
 positions, module-level mutable literals, mesh axis-name literals, and
 the import aliases under which `PartitionSpec` and `jax.random` travel.
+
+GL001-GL005 are intraprocedural and need only the FileContext. GL006
+(cross-module mesh axes) and GL007-GL009 additionally read
+`ctx.project` — a `callgraph.ProjectContext` over every file in the
+lint invocation, attached by the engine before rules run — so facts
+propagate through calls: a host sync two helpers below a jit body
+(GL007), a key consumed inside a callee then reused at the call site
+(GL008), a donated buffer retained by an earlier callee (GL009).
 """
 
 import ast
@@ -146,6 +154,9 @@ class FileContext:
         self.random_aliases = {"jrandom", "jran"}
         #: function names imported directly from jax.random.
         self.random_funcs = set()
+        #: callgraph.ProjectContext, attached by the engine once every
+        #: file in the invocation is parsed; GL006-GL009 read it.
+        self.project = None
 
         self._collect_imports(tree)
         self._collect_jit(tree)
@@ -371,8 +382,8 @@ def _scope_events(body, ctx):
 
 
 def _call_events(node, ctx, events):
-    """Appends donate/keyuse events for one Call node (loads of its
-    arguments were already emitted by the caller)."""
+    """Appends donate/keyuse/keyuse_ip/escape events for one Call node
+    (loads of its arguments were already emitted by the caller)."""
     func = node.func
     # Donation: a call to a known-jit callable with donate_argnums.
     if isinstance(func, ast.Name) and func.id in ctx.jit_names:
@@ -387,6 +398,18 @@ def _call_events(node, ctx, events):
         key = node.args[0]
         if isinstance(key, ast.Name):
             events.append(("keyuse", key.id, node))
+    # Interprocedural facts: the call resolves to a function whose
+    # summary says a parameter consumes a key / retains its argument.
+    # Separate event kinds so GL004/GL003 keep their intraprocedural
+    # jurisdiction and GL008/GL009 own the cross-call pairs.
+    if ctx.project is not None:
+        for arg in node.args:
+            if not isinstance(arg, ast.Name):
+                continue
+            if ctx.project.consuming_key_param(ctx, node, arg.id):
+                events.append(("keyuse_ip", arg.id, node))
+            if ctx.project.retaining_param(ctx, node, arg.id):
+                events.append(("escape", arg.id, node))
 
 
 def _is_random_call(func, ctx):
@@ -686,15 +709,30 @@ class ShardingAxisMismatch(Rule):
     predicts = "mesh-resolution error at dispatch (after compile time)"
 
     _MSG = ("PartitionSpec axis {!r} is not declared by any mesh "
-            "literal in this file (declared: {}): "
+            "literal in {} (declared: {}): "
             "with_sharding_constraint would fail at dispatch, after "
             "the compile was already paid — fix the axis name or the "
             "mesh's axis_names")
 
     def check(self, ctx):
-        if not ctx.mesh_axes:
+        # Axis names are checked against every Mesh literal the lint
+        # invocation can see: the file's own meshes plus every other
+        # linted module's (the common split is PartitionSpecs in
+        # models/ against a Mesh built in parallel/sharding.py). A
+        # file with no mesh in sight anywhere stays unchecked — the
+        # mesh may live in code we were not asked to lint.
+        project = ctx.project
+        if project is not None and project.mesh_axes:
+            known = set(project.mesh_axes)
+            declared = project.declared_axes_label()
+            scope = ("this file" if ctx.mesh_axes
+                     else "any linted module")
+        elif ctx.mesh_axes:
+            known = ctx.mesh_axes
+            declared = ", ".join(sorted(ctx.mesh_axes))
+            scope = "this file"
+        else:
             return
-        declared = ", ".join(sorted(ctx.mesh_axes))
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -709,12 +747,135 @@ class ShardingAxisMismatch(Rule):
                 elif isinstance(value, (tuple, list)):
                     axes = [v for v in value if isinstance(v, str)]
                 for axis in axes:
-                    if axis not in ctx.mesh_axes:
+                    if axis not in known:
                         yield ctx.finding(
                             arg, self.id,
-                            self._MSG.format(axis, declared))
+                            self._MSG.format(axis, scope, declared))
+
+
+# -- interprocedural rules (read ctx.project) -------------------------
+
+
+def _chain_label(chain):
+    """'pkg.mod.f (line 3) -> pkg.mod.g (line 9: float(...))' for a
+    host-sync chain; entries are (qualname, line[, label])."""
+    parts = []
+    for entry in chain:
+        qualname, line = entry[0], entry[1]
+        label = entry[2] if len(entry) > 2 else None
+        if label:
+            parts.append("{} (line {}: {})".format(qualname, line, label))
+        else:
+            parts.append("{} (line {})".format(qualname, line))
+    return " -> ".join(parts)
+
+
+class TransitiveHostSync(Rule):
+    id = "GL007"
+    title = "transitive-host-sync-in-jit"
+    predicts = "transfer_stats().d2h_fetches"
+
+    _MSG = ("call to `{}` inside a jit-compiled function reaches a "
+            "host sync through its call chain: {} — hoist the sync "
+            "out of the jitted region or return device values "
+            "[predicts {} growth]")
+
+    def check(self, ctx):
+        if ctx.project is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.enclosing_jit(node) is None:
+                continue
+            if HostSyncInJit._host_sync_label(node) is not None:
+                continue  # the direct form is GL001's finding
+            chain = ctx.project.host_sync_chain(ctx, node.func)
+            if chain:
+                yield ctx.finding(
+                    node, self.id,
+                    self._MSG.format(_terminal_name(node.func),
+                                     _chain_label(chain),
+                                     self.predicts))
+
+
+class RngKeyReuseAcrossCalls(Rule):
+    id = "GL008"
+    title = "rng-key-reuse-across-calls"
+    predicts = "correlated randomness (no counter; silently wrong)"
+
+    _MSG = ("RNG key `{}` is consumed twice (first at line {}, again "
+            "here) and at least one consumption happens inside a "
+            "callee: {} — both draws see identical randomness; "
+            "`jax.random.split` before the call and pass a subkey")
+
+    def check(self, ctx):
+        project = ctx.project
+        if project is None:
+            return
+        for body in _scope_bodies(ctx):
+            consumed = {}  # name -> (kind, node) of the first use
+            for kind, name, node in _scope_events(body, ctx):
+                if kind in ("keyuse", "keyuse_ip"):
+                    if name not in consumed:
+                        consumed[name] = (kind, node)
+                        continue
+                    first_kind, first_node = consumed[name]
+                    if "keyuse_ip" not in (kind, first_kind):
+                        continue  # direct-direct pairs are GL004's
+                    chain = (self._chain(project, ctx, node, name)
+                             or self._chain(project, ctx, first_node,
+                                            name) or [])
+                    yield ctx.finding(
+                        node, self.id,
+                        self._MSG.format(name, first_node.lineno,
+                                         _chain_label(chain)))
+                elif kind == "store":
+                    consumed.pop(name, None)
+
+    @staticmethod
+    def _chain(project, ctx, call_node, name):
+        hit = project.consuming_key_param(ctx, call_node, name)
+        if hit is None:
+            return None
+        callee, param = hit
+        return project.key_chain(callee, param)
+
+
+class DonationEscape(Rule):
+    id = "GL009"
+    title = "donation-escape"
+    predicts = "donated-buffer UAF (jax 'donated buffers' warning)"
+
+    _MSG = ("`{}` is donated to jit-compiled `{}` but a reference "
+            "escaped at line {} into {} — the retained alias outlives "
+            "the donation and will see freed or aliased memory; drop "
+            "the retained reference or donate a copy")
+
+    def check(self, ctx):
+        project = ctx.project
+        if project is None:
+            return
+        for body in _scope_bodies(ctx):
+            escaped = {}  # name -> the escaping Call node
+            for kind, name, node in _scope_events(body, ctx):
+                if kind == "escape":
+                    escaped.setdefault(name, node)
+                elif kind == "store":
+                    escaped.pop(name, None)
+                elif kind == "donate" and name in escaped:
+                    esc = escaped.pop(name)
+                    hit = project.retaining_param(ctx, esc, name)
+                    if hit is None:
+                        continue
+                    chain = project.retain_chain(*hit)
+                    yield ctx.finding(
+                        node, self.id,
+                        self._MSG.format(name, node.func.id, esc.lineno,
+                                         _chain_label(chain)))
 
 
 ALL_RULES = [HostSyncInJit(), RetraceHazard(), DonationAfterUse(),
              RngKeyReuse(), TracerControlFlow(),
-             ShardingAxisMismatch()]
+             ShardingAxisMismatch(), TransitiveHostSync(),
+             RngKeyReuseAcrossCalls(), DonationEscape()]

@@ -45,6 +45,7 @@ def run(
     container_builder_cls=None,
     api_client=None,
     lint="warn",
+    sanitize="off",
     **kwargs
 ):
     """Runs your training code on Cloud TPUs (or GPUs) in GCP.
@@ -76,6 +77,16 @@ def run(
             (`cloud_tpu.analysis`): "warn" (default) reports findings
             and proceeds, "strict" raises before containerize, "off"
             skips. Notebook entry points are never linted.
+        sanitize: graftsan runtime-sanitizer mode for the REMOTE job
+            ("off" default): "warn"/"strict" bake CLOUD_TPU_SANITIZE
+            into the generated runner, so every Trainer.fit/evaluate on
+            the slice runs under a `sanitize()` scope — step-loop
+            fetches, steady-state retraces and RNG key reuse are
+            attributed to their source lines in the job's event log
+            ("strict" makes any finding fatal at scope exit). The
+            dynamic complement of `lint`. Requires
+            distribution_strategy='auto' (the runner is where the env
+            var lives); ignored with a warning otherwise.
         **kwargs: Swallowed-then-rejected for forward compatibility with
             newer clients in older cloud environments (reference
             run.py:137-145).
@@ -123,6 +134,7 @@ def run(
         job_labels=job_labels or {},
         docker_base_image=docker_base_image,
         lint=lint,
+        sanitize=sanitize,
     )
 
     # Static analysis of the code being shipped, after argument
@@ -145,7 +157,16 @@ def run(
             worker_count,
             distribution_strategy,
             called_from_notebook=called_from_notebook,
+            sanitize=sanitize,
         )
+    elif sanitize != "off":
+        # No generated runner means nowhere to bake the env var; warn
+        # instead of silently shipping an unsanitized job.
+        import warnings
+        warnings.warn(
+            "sanitize={!r} requires the generated runner "
+            "(distribution_strategy='auto' or a notebook entry point); "
+            "the job will run without graftsan.".format(sanitize))
 
     cb_args = (
         entry_point,

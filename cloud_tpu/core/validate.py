@@ -33,6 +33,7 @@ def validate(
     job_labels=None,
     docker_base_image=None,
     lint="warn",
+    sanitize="off",
 ):
     """Validates the inputs to `run()`.
 
@@ -60,6 +61,10 @@ def validate(
         lint: "warn", "strict" or "off" — the graftlint preflight mode
             (`cloud_tpu.analysis`); the lint itself runs in `run()`
             after validation, this only rejects unknown modes.
+        sanitize: "off", "warn" or "strict" — the graftsan runtime
+            sanitizer mode baked into the generated runner (the remote
+            job sees it as CLOUD_TPU_SANITIZE); this only rejects
+            unknown modes.
 
     Raises:
         ValueError: if any of the inputs is invalid.
@@ -70,6 +75,7 @@ def validate(
         chief_config, worker_count, worker_config, docker_base_image)
     gcp.validate_job_labels(job_labels or {})
     _validate_lint_mode(lint)
+    _validate_sanitize_mode(sanitize)
     _validate_other_args(
         region,
         entry_point_args,
@@ -190,6 +196,15 @@ def _validate_lint_mode(lint):
             "Invalid `lint` input. "
             'Expected "warn", "strict" or "off". '
             "Received {}.".format(str(lint)))
+
+
+def _validate_sanitize_mode(sanitize):
+    """The graftsan runtime-sanitizer knob takes exactly three modes."""
+    if sanitize not in ("off", "warn", "strict"):
+        raise ValueError(
+            "Invalid `sanitize` input. "
+            'Expected "off", "warn" or "strict". '
+            "Received {}.".format(str(sanitize)))
 
 
 def _validate_other_args(region, args, stream_logs, docker_image_bucket_name,

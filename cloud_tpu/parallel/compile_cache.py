@@ -170,6 +170,14 @@ def _install_listener():
             runtime.record_compile(cache_hits=1)
         elif event == "/jax/compilation_cache/cache_misses":
             _event_stats["persistent_misses"] += 1
+            # A miss is a compile-from-scratch the persistent cache
+            # could not absorb; the graftsan observer attributes it to
+            # the dispatch site (the hit path notifies through
+            # record_compile above).
+            from cloud_tpu.parallel import runtime
+            observer = runtime.get_observer()
+            if observer is not None:
+                observer.on_cache_miss()
 
     monitoring.register_event_listener(_on_event)
     _listener_installed = True

@@ -28,6 +28,7 @@ Env contract (set by the deployer on every remote process):
 
 import logging
 import os
+import threading
 import time
 
 logger = logging.getLogger("cloud_tpu")
@@ -365,6 +366,61 @@ def reset():
 _transfer_stats = {"h2d_transfers": 0, "h2d_bytes": 0,
                    "d2h_fetches": 0, "d2h_bytes": 0}
 
+# --------------------------------------------------------------------------
+# graftsan observer seam (cloud_tpu.analysis.sanitizer).
+#
+# The counters above say THAT a transfer/compile happened; the sanitizer
+# wants to know WHERE. Rather than having the sanitizer monkeypatch the
+# record_* functions (fragile against `from runtime import record_d2h`
+# binding), each record site notifies a single module-level observer.
+# When no observer is installed — the default, and the production state
+# — the cost is one global load + None check per record call; nothing
+# is wrapped, patched, or allocated.
+#
+# Phases are thread-local labels the Trainer (and its worker threads)
+# publish so an observer can tell a step-loop fetch from a sanctioned
+# boundary fetch: "step" inside the epoch step loop, "boundary" between
+# epochs, "async_reader" / "checkpoint" on the worker threads. The
+# label is advisory context for attribution, never control flow.
+
+_observer = None
+_phase = threading.local()
+
+
+def set_observer(observer):
+    """Installs `observer` (or None to remove). Returns the previous
+    observer so scoped installers can restore it. The observer sees
+    `on_h2d(transfers, nbytes)`, `on_d2h(nbytes)`,
+    `on_compile(n_traces, n_compiles, cache_hits)` and
+    `on_epoch(epoch)` — all best-effort, called inline at record time
+    on whatever thread recorded."""
+    global _observer
+    previous = _observer
+    _observer = observer
+    return previous
+
+
+def get_observer():
+    return _observer
+
+
+def set_phase(name):
+    """Sets this thread's phase label; returns the previous label."""
+    previous = getattr(_phase, "name", None)
+    _phase.name = name
+    return previous
+
+
+def current_phase():
+    """This thread's phase label, or None when never set."""
+    return getattr(_phase, "name", None)
+
+
+def notify_epoch(epoch):
+    """Tells the observer (if any) that epoch `epoch` just finished."""
+    if _observer is not None:
+        _observer.on_epoch(epoch)
+
 
 def record_h2d(batch):
     """Counts the host->device bytes about to be transferred for `batch`.
@@ -391,6 +447,8 @@ def record_h2d(batch):
     if transfers:
         _transfer_stats["h2d_transfers"] += transfers
         _transfer_stats["h2d_bytes"] += total
+        if _observer is not None:
+            _observer.on_h2d(transfers, total)
     return total
 
 
@@ -416,6 +474,8 @@ def record_d2h(tree):
     if device_leaves:
         _transfer_stats["d2h_fetches"] += 1
         _transfer_stats["d2h_bytes"] += total
+        if _observer is not None:
+            _observer.on_d2h(total, tree)
     return total
 
 
@@ -487,6 +547,8 @@ def record_compile(n_traces=0, n_compiles=0, compile_seconds=0.0,
     _compile_stats["n_compiles"] += n_compiles
     _compile_stats["compile_seconds"] += compile_seconds
     _compile_stats["cache_hits"] += cache_hits
+    if _observer is not None and (n_traces or n_compiles or cache_hits):
+        _observer.on_compile(n_traces, n_compiles, cache_hits)
 
 
 def compile_stats():
@@ -568,6 +630,15 @@ class InstrumentedJit:
         self._fun = fun
         self._trace_count = 0
         self._warm = {}
+        # Donated positions, kept for the graftsan observer: donation
+        # invalidates the caller's buffer, so the sanitizer tracks the
+        # donated arrays (by weakref) to catch later reads of them.
+        donate = jit_kwargs.get("donate_argnums")
+        if donate is None:
+            donate = ()
+        elif isinstance(donate, int):
+            donate = (donate,)
+        self._donate_argnums = tuple(donate)
         # The warm table matches on positional avals only; static or
         # keyword-routed arguments would make the signature ambiguous.
         self._warmable = not any(
@@ -592,6 +663,10 @@ class InstrumentedJit:
         return self._trace_count
 
     def __call__(self, *args, **kwargs):
+        if _observer is not None and self._donate_argnums:
+            _observer.on_donation(
+                [args[i] for i in self._donate_argnums
+                 if 0 <= i < len(args)])
         if self._warm and not kwargs:
             sig = _aval_signature(args)
             compiled = self._warm.get(sig) if sig is not None else None

@@ -116,6 +116,9 @@ class AsyncMetricReader:
                 self._thread.start()
 
     def _run(self):
+        # Label this thread for the graftsan sanitizer: fetches here
+        # are the sanctioned off-thread readback, not step-loop syncs.
+        runtime.set_phase("async_reader")
         while True:
             item = self._queue.get()
             if item is _CLOSE:

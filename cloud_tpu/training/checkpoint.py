@@ -84,7 +84,13 @@ def _host_snapshot(state):
     leaves = [l for l in jax.tree_util.tree_leaves(state)
               if isinstance(l, jax.Array)]
     if leaves and all(l.is_fully_addressable for l in leaves):
-        return runtime.device_fetch(state)
+        # Phase label for the graftsan sanitizer: this coalesced fetch
+        # is the sanctioned snapshot copy, whatever thread saves from.
+        previous = runtime.set_phase("checkpoint")
+        try:
+            return runtime.device_fetch(state)
+        finally:
+            runtime.set_phase(previous)
     return state
 
 

@@ -17,6 +17,7 @@ Example:
     history = trainer.fit(x_train, y_train, epochs=2, batch_size=128)
 """
 
+import functools
 import inspect
 import logging
 import os
@@ -37,6 +38,27 @@ from cloud_tpu.training import async_logs as async_logs_lib
 from cloud_tpu.training import data as data_lib
 
 logger = logging.getLogger("cloud_tpu")
+
+
+def _env_sanitized(method):
+    """Runs a Trainer entry point under a graftsan env scope.
+
+    `CLOUD_TPU_SANITIZE=1|warn|strict` turns the wrapped call into a
+    sanitized region (cloud_tpu.analysis.sanitizer): runtime transfer/
+    compile records and jax.random key consumption are attributed to
+    their call sites and checked against the step-loop invariants.
+    Unset, the wrapper is a plain delegation — no import, no observer
+    hook. Nested regions don't stack: a validation `evaluate` inside a
+    sanitized `fit` sees the already-installed observer and no-ops.
+    """
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        if not os.environ.get("CLOUD_TPU_SANITIZE"):
+            return method(self, *args, **kwargs)
+        from cloud_tpu.analysis import sanitizer
+        with sanitizer.env_scope():
+            return method(self, *args, **kwargs)
+    return wrapper
 
 
 # -- Losses (logits-in, per-example-loss-out) ---------------------------
@@ -1418,6 +1440,7 @@ class Trainer:
 
     # -- public API -----------------------------------------------------
 
+    @_env_sanitized
     def fit(self,
             x=None,
             y=None,
@@ -1691,6 +1714,10 @@ class Trainer:
                                  initial_epoch=initial_epoch,
                                  cast=policy, weighted=weighted)
         finally:
+            # The epoch loops label this thread "step"/"boundary" for
+            # graftsan; an abort can exit mid-"step". Clear the label so
+            # post-fit host code is never counted against the step loop.
+            runtime.set_phase(None)
             # Guaranteed even when a train step raises (OOM, interrupt):
             # callbacks holding external resources (profiler traces,
             # open files) rely on on_train_end for cleanup. Isolated per
@@ -1798,6 +1825,11 @@ class Trainer:
             count = 0
             examples = 0
             t0 = time.time()
+            # Thread label for graftsan: a device fetch from inside the
+            # step loop is the violation the sanitizer exists to catch;
+            # _post_epoch_logs flips the label back to "boundary" where
+            # the per-epoch coalesced fetch is sanctioned.
+            runtime.set_phase("step")
             spe = self.steps_per_execution
             multi_step = getattr(self, "_jit_multi_step", None)
             if spe > 1 and multi_step is not None:
@@ -2014,6 +2046,9 @@ class Trainer:
             step_logs = []
             count = 0
             t0 = time.time()
+            # Same graftsan step label as _fit_epochs: executable calls
+            # only between here and _post_epoch_logs' "boundary".
+            runtime.set_phase("step")
             calls = [(run_group, spe)] * n_groups
             if leftover:
                 calls.append((run_tail, leftover))
@@ -2063,6 +2098,10 @@ class Trainer:
         resolves only when something actually reads a metric value,
         and the history append is deferred to fit's exit barrier.
         """
+        # Epoch boundary: host syncs (the coalesced fetch, validation,
+        # verbose printing) are sanctioned here — relabel the thread so
+        # graftsan doesn't count them against the step loop.
+        runtime.set_phase("boundary")
         if step_logs and "_batch_weight" in step_logs[0]:
             # Weighted fit: epoch metrics re-weight each batch's
             # weighted mean by that batch's weight sum (exact over
@@ -2176,6 +2215,10 @@ class Trainer:
                 raise runtime.RetraceWarning(msg)
             if policy == "warn":
                 warnings.warn(runtime.RetraceWarning(msg))
+        # One completed epoch: graftsan's retrace check (GS002) arms
+        # only after the warm-up epoch has finished, mirroring the
+        # sentinel's own baseline timing above.
+        runtime.notify_epoch(epoch)
 
     def summary(self, print_fn=None):
         """Keras `model.summary()` parity: per-top-level-module
@@ -2263,6 +2306,7 @@ class Trainer:
                                             step=step)
         return self.state
 
+    @_env_sanitized
     def evaluate(self, x, y=None, batch_size=32, verbose=True,
                  steps=None, prefetch=2, use_ema=False,
                  sample_weight=None):

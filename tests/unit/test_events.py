@@ -73,3 +73,45 @@ class TestTensorBoardCallback:
         got = events.read_events(files[0])
         assert [step for step, _ in got] == [0, 1]
         assert all("epoch_loss" in scalars for _, scalars in got)
+
+
+class TestJobEventLog:
+    def test_noop_without_path_or_env(self, monkeypatch):
+        monkeypatch.delenv("CLOUD_TPU_EVENT_LOG", raising=False)
+        assert events.log_job_event("k", {"a": 1}) is None
+
+    def test_explicit_path_beats_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_EVENT_LOG",
+                           str(tmp_path / "env.jsonl"))
+        target = str(tmp_path / "explicit.jsonl")
+        assert events.log_job_event("k", {"a": 1}, path=target) == target
+        assert len(events.read_job_events(target)) == 1
+
+    def test_concurrent_writers_interleave_whole_lines(self, tmp_path):
+        # log_job_event appends ONE line per call via a single
+        # O_APPEND write; concurrent writers (the training thread, the
+        # async reader, a checkpoint worker all finalizing sanitizer/
+        # lint events) must interleave records, never bytes.
+        import threading
+
+        path = str(tmp_path / "events.jsonl")
+        n_threads, n_records = 4, 50
+
+        def writer(tag):
+            for i in range(n_records):
+                events.log_job_event(
+                    "stress", {"tag": tag, "i": i}, path=path)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        records = events.read_job_events(path)  # raises on a torn line
+        assert len(records) == n_threads * n_records
+        for tag in range(n_threads):
+            got = sorted(r["payload"]["i"] for r in records
+                         if r["payload"]["tag"] == tag)
+            assert got == list(range(n_records))

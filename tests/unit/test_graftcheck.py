@@ -1,0 +1,461 @@
+"""graftcheck: the interprocedural layer (GL007-GL009, cross-module
+GL006), SARIF output, and preflight import-following.
+
+Single-file fixtures exercise the one-module ProjectContext that
+`check_source` builds; the cross-module tests write real files to
+tmp_path and go through `check_paths`, which is the configuration the
+CI self-run and preflight use.
+"""
+
+import io
+import json
+import os
+from unittest import mock
+
+import pytest
+
+from cloud_tpu.analysis import callgraph
+from cloud_tpu.analysis import engine
+from cloud_tpu.analysis import lint
+from cloud_tpu.analysis import preflight
+
+
+def rules_of(source):
+    return [f.rule for f in engine.check_source(source)]
+
+
+def write_tree(root, files):
+    for name, source in files.items():
+        path = os.path.join(str(root), *name.split("/"))
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(source)
+    return str(root)
+
+
+class TestGL007TransitiveHostSync:
+
+    def test_one_hop_chain_fires_with_chain_in_message(self):
+        src = (
+            "import jax\n"
+            "def to_scalar(x):\n"
+            "    return float(x)\n"
+            "@jax.jit\n"
+            "def step(s):\n"
+            "    return to_scalar(s)\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL007"]
+        assert "to_scalar" in findings[0].message
+        assert "float" in findings[0].message
+
+    def test_two_hop_chain_lists_every_frame(self):
+        src = (
+            "import jax\n"
+            "def deep(x):\n"
+            "    return x.item()\n"
+            "def shallow(x):\n"
+            "    return deep(x)\n"
+            "@jax.jit\n"
+            "def step(s):\n"
+            "    return shallow(s)\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL007"]
+        assert "shallow" in findings[0].message
+        assert "deep" in findings[0].message
+
+    def test_clean_helper_silent(self):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def double(x):\n"
+            "    return jnp.add(x, x)\n"
+            "@jax.jit\n"
+            "def step(s):\n"
+            "    return double(s)\n")
+        assert rules_of(src) == []
+
+    def test_direct_sync_is_gl001_not_gl007(self):
+        # The direct form stays GL001's finding; GL007 must not
+        # double-report it.
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def step(s):\n"
+            "    return float(s)\n")
+        assert rules_of(src) == ["GL001"]
+
+    def test_jitted_callee_excluded_from_chain(self):
+        # A callee that is itself jit-compiled gets its own GL001;
+        # the caller does not ALSO get a GL007 through it.
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def inner(x):\n"
+            "    return float(x)\n"
+            "@jax.jit\n"
+            "def outer(s):\n"
+            "    return inner(s)\n")
+        assert rules_of(src) == ["GL001"]
+
+    def test_sync_helper_called_outside_jit_silent(self):
+        src = (
+            "def to_scalar(x):\n"
+            "    return float(x)\n"
+            "def host_loop(s):\n"
+            "    return to_scalar(s)\n")
+        assert rules_of(src) == []
+
+
+class TestGL008RngKeyReuseAcrossCalls:
+
+    def test_key_consumed_directly_then_via_helper(self):
+        src = (
+            "import jax\n"
+            "def sample(key, shape):\n"
+            "    return jax.random.normal(key, shape)\n"
+            "def f(key):\n"
+            "    a = jax.random.uniform(key, (2,))\n"
+            "    b = sample(key, (2,))\n"
+            "    return a, b\n")
+        findings = engine.check_source(src)
+        assert "GL008" in [f.rule for f in findings]
+        message = [f.message for f in findings if f.rule == "GL008"][0]
+        assert "sample" in message
+
+    def test_two_helper_calls_fire(self):
+        src = (
+            "import jax\n"
+            "def sample(key):\n"
+            "    return jax.random.normal(key, (2,))\n"
+            "def f(key):\n"
+            "    return sample(key), sample(key)\n")
+        assert "GL008" in rules_of(src)
+
+    def test_split_between_uses_silent(self):
+        src = (
+            "import jax\n"
+            "def sample(key):\n"
+            "    return jax.random.normal(key, (2,))\n"
+            "def f(key):\n"
+            "    k1, key = jax.random.split(key)\n"
+            "    a = sample(k1)\n"
+            "    k2, key = jax.random.split(key)\n"
+            "    return a, sample(k2)\n")
+        assert "GL008" not in rules_of(src)
+
+    def test_direct_double_use_is_gl004_not_gl008(self):
+        # Both uses direct in one function: that is GL004's finding.
+        src = (
+            "import jax\n"
+            "def f(key):\n"
+            "    a = jax.random.normal(key, (2,))\n"
+            "    b = jax.random.uniform(key, (2,))\n"
+            "    return a, b\n")
+        found = rules_of(src)
+        assert "GL004" in found
+        assert "GL008" not in found
+
+    def test_non_consuming_helper_silent(self):
+        src = (
+            "import jax\n"
+            "def describe(key):\n"
+            "    return key.shape\n"
+            "def f(key):\n"
+            "    a = jax.random.normal(key, (2,))\n"
+            "    return a, describe(key)\n")
+        assert "GL008" not in rules_of(src)
+
+
+class TestGL009DonationEscape:
+
+    def test_retained_then_donated_fires_with_chain(self):
+        src = (
+            "import jax\n"
+            "from cloud_tpu.parallel import runtime\n"
+            "HISTORY = []\n"
+            "def remember(state):\n"
+            "    HISTORY.append(state)\n"
+            "def train(step, state, batch):\n"
+            "    remember(state)\n"
+            "    jit_step = runtime.instrumented_jit(step, donate_argnums=0)\n"
+            "    return jit_step(state, batch)\n")
+        findings = engine.check_source(src)
+        assert "GL009" in [f.rule for f in findings]
+        message = [f.message for f in findings if f.rule == "GL009"][0]
+        assert "remember" in message
+
+    def test_no_donation_silent(self):
+        src = (
+            "import jax\n"
+            "HISTORY = []\n"
+            "def remember(state):\n"
+            "    HISTORY.append(state)\n"
+            "def train(step, state, batch):\n"
+            "    remember(state)\n"
+            "    return jax.jit(step)(state, batch)\n")
+        assert "GL009" not in rules_of(src)
+
+    def test_donation_without_escape_silent(self):
+        src = (
+            "from cloud_tpu.parallel import runtime\n"
+            "def train(step, state, batch):\n"
+            "    jit_step = runtime.instrumented_jit(step, donate_argnums=0)\n"
+            "    return jit_step(state, batch)\n")
+        assert "GL009" not in rules_of(src)
+
+    def test_rebinding_clears_escape(self):
+        # The retained object is the OLD binding; the donated one is a
+        # fresh value, so no escape-then-donate pair exists.
+        src = (
+            "from cloud_tpu.parallel import runtime\n"
+            "HISTORY = []\n"
+            "def remember(state):\n"
+            "    HISTORY.append(state)\n"
+            "def train(step, state, batch, fresh):\n"
+            "    remember(state)\n"
+            "    state = fresh\n"
+            "    jit_step = runtime.instrumented_jit(step, donate_argnums=0)\n"
+            "    return jit_step(state, batch)\n")
+        assert "GL009" not in rules_of(src)
+
+
+class TestCrossModule:
+
+    def test_gl006_axis_declared_in_other_module(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/sharding.py": (
+                "import jax\n"
+                "from jax.sharding import Mesh\n"
+                "def make_mesh(devices):\n"
+                "    return Mesh(devices, axis_names=(\"dp\",))\n"),
+            "pkg/train.py": (
+                "from jax.sharding import PartitionSpec as P\n"
+                "SPEC = P(\"model\")\n"),
+        })
+        findings, _ = engine.check_paths([root])
+        gl006 = [f for f in findings if f.rule == "GL006"]
+        assert len(gl006) == 1
+        assert gl006[0].path.endswith("train.py")
+        assert "dp" in gl006[0].message
+
+    def test_gl006_matching_axis_across_modules_silent(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/sharding.py": (
+                "from jax.sharding import Mesh\n"
+                "def make_mesh(devices):\n"
+                "    return Mesh(devices, axis_names=(\"dp\", \"model\"))\n"),
+            "pkg/train.py": (
+                "from jax.sharding import PartitionSpec as P\n"
+                "SPEC = P(\"model\")\n"),
+        })
+        findings, _ = engine.check_paths([root])
+        assert [f for f in findings if f.rule == "GL006"] == []
+
+    def test_gl007_chain_through_from_import(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/helpers.py": (
+                "def to_scalar(x):\n"
+                "    return float(x)\n"),
+            "pkg/train.py": (
+                "import jax\n"
+                "from pkg.helpers import to_scalar\n"
+                "@jax.jit\n"
+                "def step(s):\n"
+                "    return to_scalar(s)\n"),
+        })
+        findings, _ = engine.check_paths([root])
+        gl007 = [f for f in findings if f.rule == "GL007"]
+        assert len(gl007) == 1
+        assert gl007[0].path.endswith("train.py")
+        assert "helpers.to_scalar" in gl007[0].message
+
+    def test_gl008_chain_through_module_alias(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/samplers.py": (
+                "import jax\n"
+                "def draw(key):\n"
+                "    return jax.random.normal(key, (2,))\n"),
+            "pkg/train.py": (
+                "import jax\n"
+                "import pkg.samplers as samplers\n"
+                "def f(key):\n"
+                "    return samplers.draw(key), samplers.draw(key)\n"),
+        })
+        findings, _ = engine.check_paths([root])
+        assert "GL008" in [f.rule for f in findings]
+
+    def test_module_name_for_walks_to_package_root(self, tmp_path):
+        write_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/sub/__init__.py": "",
+            "pkg/sub/mod.py": "",
+        })
+        path = os.path.join(str(tmp_path), "pkg", "sub", "mod.py")
+        assert callgraph.module_name_for(path) == "pkg.sub.mod"
+
+
+class TestSarifFormat:
+
+    def test_document_shape(self):
+        findings = engine.check_source(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x)\n", path="train.py")
+        doc = lint.to_sarif(findings, files_checked=1)
+        assert doc["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in doc["$schema"]
+        (run,) = doc["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "graftlint"
+        # GL000 + every registered rule, stable order.
+        assert [r["id"] for r in driver["rules"]] == (
+            ["GL000"] + list(engine.RULES.keys()))
+        (result,) = run["results"]
+        assert result["ruleId"] == "GL001"
+        assert driver["rules"][result["ruleIndex"]]["id"] == "GL001"
+        assert result["level"] == "warning"
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "train.py"
+        assert loc["region"]["startLine"] == 4
+        # SARIF columns are 1-based; Finding.col is the 0-based offset.
+        assert loc["region"]["startColumn"] == (
+            findings[0].col + 1)
+        assert run["properties"]["files_checked"] == 1
+
+    def test_cli_emits_parseable_sarif(self, tmp_path):
+        target = tmp_path / "clean.py"
+        target.write_text("x = 1\n")
+        out = io.StringIO()
+        code = lint.main([str(target), "--format", "sarif"], out=out)
+        assert code == 0
+        doc = json.loads(out.getvalue())
+        assert doc["runs"][0]["results"] == []
+
+    def test_cli_sarif_strict_still_gates(self, tmp_path):
+        target = tmp_path / "bad.py"
+        target.write_text(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x)\n")
+        out = io.StringIO()
+        code = lint.main([str(target), "--format", "sarif", "--strict"],
+                         out=out)
+        assert code == 1
+        doc = json.loads(out.getvalue())
+        assert len(doc["runs"][0]["results"]) == 1
+
+
+class TestPreflightImportFollowing:
+
+    def test_finding_in_helper_module_surfaces(self, tmp_path,
+                                               capsys):
+        write_tree(tmp_path, {
+            "helpers.py": (
+                "import jax\n"
+                "@jax.jit\n"
+                "def f(x):\n"
+                "    return float(x)\n"),
+            "train.py": "import helpers\n",
+        })
+        entry = os.path.join(str(tmp_path), "train.py")
+        findings = preflight.preflight_lint(entry, mode="warn")
+        assert [f.rule for f in findings] == ["GL001"]
+        assert findings[0].path.endswith("helpers.py")
+
+    def test_interprocedural_chain_through_import(self, tmp_path):
+        write_tree(tmp_path, {
+            "helpers.py": (
+                "def to_scalar(x):\n"
+                "    return float(x)\n"),
+            "train.py": (
+                "import jax\n"
+                "from helpers import to_scalar\n"
+                "@jax.jit\n"
+                "def step(s):\n"
+                "    return to_scalar(s)\n"),
+        })
+        entry = os.path.join(str(tmp_path), "train.py")
+        with mock.patch.object(preflight.sys, "stderr", io.StringIO()):
+            findings = preflight.preflight_lint(entry, mode="warn")
+        assert [f.rule for f in findings] == ["GL007"]
+
+    def test_local_imports_resolution_forms(self, tmp_path):
+        write_tree(tmp_path, {
+            "plain.py": "",
+            "pkg/__init__.py": "",
+            "pkg/sub.py": "",
+            "train.py": (
+                "import os\n"                 # stdlib: skipped
+                "import numpy as np\n"        # site-packages: skipped
+                "import plain\n"
+                "import pkg.sub\n"
+                "from pkg import nothing\n"   # resolves to pkg/__init__
+                "from . import plain\n"       # relative: already seen
+                "import missing_module\n"),   # nonexistent: skipped
+        })
+        entry = os.path.join(str(tmp_path), "train.py")
+        found = preflight.local_imports(entry)
+        names = sorted(os.path.relpath(p, str(tmp_path)) for p in found)
+        assert names == ["pkg/__init__.py", "pkg/sub.py", "plain.py"]
+
+    def test_one_level_only(self, tmp_path):
+        # deep.py has a finding, but only first-level imports ride.
+        write_tree(tmp_path, {
+            "deep.py": (
+                "import jax\n"
+                "@jax.jit\n"
+                "def f(x):\n"
+                "    return float(x)\n"),
+            "middle.py": "import deep\n",
+            "train.py": "import middle\n",
+        })
+        entry = os.path.join(str(tmp_path), "train.py")
+        findings = preflight.preflight_lint(entry, mode="warn")
+        assert findings == []
+
+    def test_follow_cap(self, tmp_path):
+        files = {"m{}.py".format(i): "" for i in range(30)}
+        files["train.py"] = "".join(
+            "import m{}\n".format(i) for i in range(30))
+        write_tree(tmp_path, files)
+        entry = os.path.join(str(tmp_path), "train.py")
+        found = preflight.local_imports(entry)
+        assert len(found) == preflight.MAX_IMPORT_FOLLOW
+
+    def test_missing_or_unparseable_target_yields_nothing(self,
+                                                          tmp_path):
+        assert preflight.local_imports(
+            str(tmp_path / "absent.py")) == []
+        broken = tmp_path / "broken.py"
+        broken.write_text("def f(:\n")
+        assert preflight.local_imports(str(broken)) == []
+
+
+class TestUnreadableFiles:
+
+    def test_unreadable_file_becomes_gl000_finding(self, tmp_path):
+        target = tmp_path / "gone.py"
+        target.write_text("x = 1\n")
+        real_open = open
+
+        def fake_open(path, *args, **kwargs):
+            if str(path) == str(target):
+                raise OSError("permission denied")
+            return real_open(path, *args, **kwargs)
+
+        with mock.patch("builtins.open", side_effect=fake_open):
+            findings, checked = engine.check_paths([str(target)])
+        assert checked == 1
+        assert [f.rule for f in findings] == ["GL000"]
+        assert "unreadable" in findings[0].message
+
+    def test_nonexistent_path_still_raises(self, tmp_path):
+        # A typo'd path is a usage error (CLI exit 2), not a finding.
+        with pytest.raises(ValueError, match="No such file"):
+            engine.check_paths([str(tmp_path / "absent.py")])
