@@ -924,6 +924,18 @@ def worker():
         # what it is asked for against what this record measured.
         "requested_config": _requested_config(),
     }
+    # graftscope: the census MFU number IS the telemetry MFU gauge —
+    # one denominator (V5E_PEAK_TFLOPS == telemetry's default peak),
+    # one value, surfaced both as `pct_peak` here and as
+    # cloud_tpu_mfu_pct_peak in the Prometheus textfile when a
+    # telemetry session is live. sys.modules.get keeps the disabled
+    # bench import-free.
+    _telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+    if _telemetry is not None and _telemetry.enabled():
+        _tele = _telemetry.get()
+        _tele.registry.gauge(_telemetry.MFU_GAUGE).set(
+            record["pct_peak"])
+        _tele.flush()
     if first_step_seconds is not None:
         record["time_to_first_step_seconds"] = round(first_step_seconds, 3)
     if compile_cache.is_enabled():

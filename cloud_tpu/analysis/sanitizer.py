@@ -386,13 +386,16 @@ def sanitize(mode="warn", event_log=None):
             "Invalid graftsan mode {!r}. Expected \"warn\" or "
             "\"strict\".".format(mode))
     san = Sanitizer(mode=mode, event_log=event_log)
-    previous = runtime.set_observer(san)
+    # add/remove (not set/restore): the sanitizer STACKS with other
+    # runtime observers — graftscope telemetry keeps counting while a
+    # sanitize scope is live, and vice versa.
+    runtime.add_observer(san)
     originals = _install_random_watchers(san)
     try:
         yield san
     finally:
         _remove_random_watchers(originals)
-        runtime.set_observer(previous)
+        runtime.remove_observer(san)
         san.finalize()
 
 
@@ -414,8 +417,10 @@ def env_scope():
     evaluate): a real `sanitize()` scope when CLOUD_TPU_SANITIZE asks
     for one and no sanitizer is already active, else a no-op. Nested
     fits under an explicit `sanitize()` reuse the outer scope instead
-    of stacking."""
+    of stacking. Only SANITIZERS suppress: another observer kind on
+    the seam (graftscope telemetry) must not swallow the env ask."""
     mode = env_mode()
-    if mode is None or runtime.get_observer() is not None:
+    if mode is None or any(isinstance(obs, Sanitizer)
+                           for obs in runtime.observers()):
         return contextlib.nullcontext()
     return sanitize(mode=mode)

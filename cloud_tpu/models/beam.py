@@ -232,6 +232,10 @@ def generate_beam(model, params, prompt, max_new_tokens, beam_width=4,
     # would buy nothing. Per-example bookkeeping (slot_valid,
     # token_count) repeats with its prompt; the scalar write pointer
     # passes through exactly as it passes through _reorder's gather.
+    from cloud_tpu.models.decoding import (decode_latency_finish,
+                                           decode_latency_start)
+
+    latency = decode_latency_start()
     mask_arg = (None if prompt_mask is None
                 else jnp.asarray(prompt_mask, bool))
     cache_b, logp = step(params, empty_cache(decoder, batch), prompt,
@@ -269,8 +273,11 @@ def generate_beam(model, params, prompt, max_new_tokens, beam_width=4,
         scores, finished, buf = run(params, cache, scores, finished,
                                     buf, feed,
                                     jnp.arange(1, max_new_tokens))
-    # The ONLY device→host fetch of the whole generation.
+    # The ONLY device→host fetch of the whole generation. The fetch
+    # retires every decode dispatch, so the latency handle closes here
+    # (result=None: this device_get IS the block).
     scores_h, buf_h = jax.device_get((scores, buf))
+    decode_latency_finish(latency, max_new_tokens)
     scores_h = np.asarray(scores_h, np.float64)                # [B, W]
     seqs = [[buf_h[b, w].tolist() for w in range(width)]
             for b in range(batch)]

@@ -228,5 +228,56 @@ def empty_cache(decoder, batch):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
-__all__ = ["best_effort_donation", "bucket_length", "decode_slot_update",
-           "empty_cache", "validate_prompt_mask", "warp_logits"]
+def decode_latency_start():
+    """graftscope hook: monotonic-ns start handle for one generate()/
+    beam/speculative call, or None when telemetry is off.
+
+    Zero-cost discipline: `sys.modules.get` means the disabled path is
+    one dict lookup — if the telemetry module was never imported, it is
+    certainly not enabled, and no import happens here.
+    """
+    import sys
+
+    telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+    if telemetry is None or not telemetry.enabled():
+        return None
+    import time
+
+    return time.monotonic_ns()
+
+
+def decode_latency_finish(start, n_tokens, result=None):
+    """Completes a `decode_latency_start` handle: blocks on `result`'s
+    device leaves (the tokens are only 'generated' once the dispatch
+    retires — measuring dispatch alone would report async-dispatch
+    latency, not token latency), records one "decode" span and feeds
+    the per-token decode-latency histogram. No-op for a None handle.
+    The deliberate block only happens when telemetry is on: the
+    measurement cost is the measurement.
+    """
+    if start is None:
+        return
+    import sys
+    import time
+
+    telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+    if telemetry is None:
+        return
+    tele = telemetry.get()
+    if tele is None or not tele.active:
+        return
+    if result is not None:
+        for leaf in jax.tree_util.tree_leaves(result):
+            if isinstance(leaf, jax.Array):
+                leaf.block_until_ready()
+    elapsed_ns = time.monotonic_ns() - start
+    from cloud_tpu.monitoring import spans
+
+    spans.complete("decode", start, elapsed_ns)
+    tele.observe_decode(n_tokens, elapsed_ns / 1e9)
+
+
+__all__ = ["best_effort_donation", "bucket_length",
+           "decode_latency_finish", "decode_latency_start",
+           "decode_slot_update", "empty_cache", "validate_prompt_mask",
+           "warp_logits"]

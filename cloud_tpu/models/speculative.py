@@ -365,6 +365,10 @@ def generate_speculative(model, params, draft_model, draft_params,
     t_cache = empty_cache(target, 1)
     d_cache = empty_cache(draft, 1)
 
+    from cloud_tpu.models.decoding import (decode_latency_finish,
+                                           decode_latency_start)
+
+    latency = decode_latency_start()
     seq = [int(t) for t in np.asarray(prompt)[0]]
     # Invariant between rounds: both caches hold entries for seq[:-1].
     if prompt_len > 1:
@@ -413,6 +417,9 @@ def generate_speculative(model, params, draft_model, draft_params,
             break
 
     seq = seq[:total]
+    # The per-round device_get above already retired every dispatch;
+    # n_tokens is what was actually generated (EOS may cut the budget).
+    decode_latency_finish(latency, len(seq) - prompt_len)
     if eos_token is not None and len(seq) < total:
         seq = seq + [eos_token] * (total - len(seq))
     return finish(jnp.asarray([seq], jnp.int32))

@@ -404,6 +404,10 @@ def generate(model,
             real = (jnp.ones((batch, prompt_len), bool)
                     if mask_arg is None else mask_arg)
             mask_arg = jnp.pad(real, ((0, 0), (pad, 0)))
+    from cloud_tpu.models.decoding import (decode_latency_finish,
+                                           decode_latency_start)
+
+    latency = decode_latency_start()
     cache, first = prefill(params, cache, prefill_tokens, prefill_rng,
                            mask_arg)
     out = [first[:, None]]
@@ -411,7 +415,9 @@ def generate(model,
         toks = decode_steps(params, cache, first,
                             jax.random.split(rng, max_new_tokens - 1))
         out.append(jnp.transpose(toks, (1, 0)))
-    return jnp.concatenate([prompt] + out, axis=1)
+    result = jnp.concatenate([prompt] + out, axis=1)
+    decode_latency_finish(latency, max_new_tokens, result)
+    return result
 
 
 @functools.lru_cache(maxsize=64)

@@ -24,8 +24,11 @@ STEP_TIME_BOUNDS = [1e3, 1e4, 1e5, 1e6, 1e7, 1e8]
 
 def __getattr__(name):
     # Lazy: profiler pulls in jax + the training stack, which metric-only
-    # consumers of this package should not pay for.
-    if name == "profiler":
+    # consumers of this package should not pay for; the graftscope
+    # modules (telemetry/spans/export) stay unimported until someone
+    # actually enables telemetry — the zero-cost-when-off discipline
+    # starts at import time.
+    if name in ("profiler", "telemetry", "spans", "export"):
         import importlib
-        return importlib.import_module("cloud_tpu.monitoring.profiler")
+        return importlib.import_module("cloud_tpu.monitoring." + name)
     raise AttributeError(name)

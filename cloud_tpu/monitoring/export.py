@@ -1,0 +1,254 @@
+"""graftscope exporters: Prometheus textfile, Chrome trace, JSONL, native.
+
+Every backend renders ONE registry snapshot (telemetry.Registry
+.snapshot() — plain dicts, no locks held) so a flush pass is consistent
+across files. Flushes run on a background daemon thread behind a
+bounded queue — the PR 2 async-reader pattern — so the training loop
+never blocks on disk: non-waiting requests coalesce (a queued flush
+already covers them) and `env_scope()` issues one blocking flush at
+exit so artifacts exist when fit() returns.
+
+Outputs under the telemetry directory:
+    trace.json       Chrome trace-event JSON (open in Perfetto)
+    metrics.prom     Prometheus textfile-collector format
+    telemetry.jsonl  JSONL rollups via utils/events (one line per flush)
+plus the monitoring/native.py registry as a third (in-process) backend.
+"""
+
+import json
+import logging
+import os
+import queue
+import threading
+
+logger = logging.getLogger("cloud_tpu")
+
+__all__ = ["FlushWorker", "PrometheusTextfileExporter",
+           "ChromeTraceExporter", "JsonlExporter", "NativeExporter",
+           "default_exporters", "render_prometheus"]
+
+_CLOSE = object()
+
+
+class FlushWorker:
+    """Bounded-queue background flusher (async-reader discipline).
+
+    `request()` is lossy by design: if a flush is already queued the
+    new request is dropped — that queued pass will export strictly
+    newer state than the caller just observed. `request(wait=True)`
+    always enqueues (blocking on the bounded queue if needed) and
+    returns only after its pass completed. Flush errors are logged,
+    never raised into the caller.
+    """
+
+    _QUEUE_DEPTH = 2
+
+    def __init__(self, flush_fn, name="cloud-tpu-telemetry-flush"):
+        self._flush_fn = flush_fn
+        self._queue = queue.Queue(maxsize=self._QUEUE_DEPTH)
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._queue.get()
+            if item is _CLOSE:
+                return
+            try:
+                self._flush_fn()
+            except Exception:
+                logger.debug("telemetry flush failed", exc_info=True)
+            finally:
+                if item is not None:
+                    item.set()
+
+    def request(self, wait=False):
+        if wait:
+            done = threading.Event()
+            self._queue.put(done)
+            done.wait()
+            return
+        try:
+            self._queue.put_nowait(None)
+        except queue.Full:
+            pass  # a queued pass will export newer state anyway
+
+    def close(self, flush=True):
+        """Stops the worker; with flush=True runs one final blocking
+        pass first."""
+        if flush:
+            self.request(wait=True)
+        self._queue.put(_CLOSE)
+        self._thread.join(timeout=10)
+
+
+def _format_number(value):
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    return repr(float(value))
+
+
+def render_prometheus(snapshot):
+    """Registry snapshot -> Prometheus textfile-collector text.
+
+    Histograms render the standard _bucket{le=}/_sum/_count series plus
+    separate `<name>_p50/_p95/_p99` gauges — pre-computed quantiles are
+    a different metric type than the histogram itself, and mixing them
+    as {quantile=} labels on a histogram is invalid exposition format.
+    """
+    lines = []
+    for name in sorted(snapshot.get("counters", ())):
+        value = snapshot["counters"][name]
+        lines.append("# TYPE {} counter".format(name))
+        lines.append("{} {}".format(name, _format_number(value)))
+    for name in sorted(snapshot.get("gauges", ())):
+        value = snapshot["gauges"][name]
+        lines.append("# TYPE {} gauge".format(name))
+        lines.append("{} {}".format(name, _format_number(value)))
+    for name in sorted(snapshot.get("histograms", ())):
+        hist = snapshot["histograms"][name]
+        lines.append("# TYPE {} histogram".format(name))
+        cumulative = 0
+        for bound, count in zip(hist["bounds"], hist["counts"]):
+            cumulative += count
+            lines.append('{}_bucket{{le="{:g}"}} {}'.format(
+                name, bound, cumulative))
+        cumulative += hist["counts"][len(hist["bounds"])]
+        lines.append('{}_bucket{{le="+Inf"}} {}'.format(name, cumulative))
+        lines.append("{}_sum {}".format(name,
+                                        _format_number(hist["sum"])))
+        lines.append("{}_count {}".format(name, hist["count"]))
+        for quantile in ("p50", "p95", "p99"):
+            qname = "{}_{}".format(name, quantile)
+            lines.append("# TYPE {} gauge".format(qname))
+            lines.append("{} {}".format(
+                qname, _format_number(hist[quantile])))
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusTextfileExporter:
+    """Atomic textfile writes (tmp + rename): the node-exporter
+    textfile collector must never read a half-written scrape."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def export(self, telemetry):
+        text = render_prometheus(telemetry.registry.snapshot())
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, self.path)
+
+
+class ChromeTraceExporter:
+    def __init__(self, path):
+        self.path = path
+
+    def export(self, telemetry):
+        tracer = telemetry.tracer
+        if tracer is not None:
+            tracer.write(self.path)
+
+
+class JsonlExporter:
+    """One JSONL rollup line per flush via utils/events, carrying the
+    counter/gauge/percentile view plus any active graftsan
+    `site_counts()` (duck-typed off the runtime observer stack, so the
+    line attributes counter movement to file:line when a sanitizer is
+    stacked alongside telemetry)."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def export(self, telemetry):
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.utils import events
+
+        snapshot = telemetry.registry.snapshot()
+        payload = {
+            "counters": snapshot["counters"],
+            "gauges": snapshot["gauges"],
+            "histograms": {
+                name: {k: hist[k]
+                       for k in ("count", "sum", "p50", "p95", "p99")}
+                for name, hist in snapshot["histograms"].items()
+            },
+        }
+        for observer in runtime.observers():
+            site_counts = getattr(observer, "site_counts", None)
+            if callable(site_counts):
+                try:
+                    payload["sanitizer_sites"] = site_counts()
+                except Exception:
+                    pass
+                break
+        events.log_job_event("telemetry", payload, path=self.path)
+
+
+class NativeExporter:
+    """Mirrors the registry into monitoring/native.py (the ctypes C++
+    exporter, or its pure-Python fallback) as a third backend.
+
+    The native counter API is increment-only, so this exporter keeps a
+    last-pushed table and pushes deltas; gauges and histogram
+    percentiles are set directly under `/cloud_tpu/telemetry/...`
+    metric paths (the native naming convention).
+    """
+
+    def __init__(self):
+        self._pushed = {}
+
+    @staticmethod
+    def _native_name(name):
+        # cloud_tpu_h2d_bytes_total -> /cloud_tpu/telemetry/h2d_bytes_total
+        stripped = name[len("cloud_tpu_"):] if name.startswith(
+            "cloud_tpu_") else name
+        return "/cloud_tpu/telemetry/" + stripped
+
+    def export(self, telemetry):
+        from cloud_tpu.monitoring import native
+
+        snapshot = telemetry.registry.snapshot()
+        for name, value in snapshot["counters"].items():
+            delta = value - self._pushed.get(name, 0)
+            if delta:
+                native.counter_increment(self._native_name(name), delta)
+                self._pushed[name] = value
+        for name, value in snapshot["gauges"].items():
+            native.gauge_set(self._native_name(name), value)
+        for name, hist in snapshot["histograms"].items():
+            base = self._native_name(name)
+            for quantile in ("p50", "p95", "p99"):
+                native.gauge_set("{}/{}".format(base, quantile),
+                                 hist[quantile])
+
+
+class _DebugDumpExporter:
+    """Developer aid: full snapshot as pretty JSON next to the trace
+    when CLOUD_TPU_TELEMETRY_DEBUG is set."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def export(self, telemetry):
+        with open(self.path, "w") as f:
+            json.dump(telemetry.registry.snapshot(), f, indent=2,
+                      sort_keys=True)
+
+
+def default_exporters(out_dir):
+    exporters = [
+        ChromeTraceExporter(os.path.join(out_dir, "trace.json")),
+        PrometheusTextfileExporter(os.path.join(out_dir,
+                                                "metrics.prom")),
+        JsonlExporter(os.path.join(out_dir, "telemetry.jsonl")),
+        NativeExporter(),
+    ]
+    if os.environ.get("CLOUD_TPU_TELEMETRY_DEBUG"):
+        exporters.append(_DebugDumpExporter(
+            os.path.join(out_dir, "registry.json")))
+    return exporters

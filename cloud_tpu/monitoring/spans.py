@@ -1,0 +1,275 @@
+"""graftscope span tracer: nested, thread-aware host wall-time spans.
+
+The jax profiler answers "what did the DEVICE do"; nothing answered
+"where did the HOST's step wall time go" — data wait vs dispatch vs the
+coalesced D2H fetch vs checkpoint snapshot. This module is that layer:
+monotonic-ns spans recorded per thread into one bounded in-process
+buffer, exported as Chrome trace-event JSON (the `{"traceEvents": []}`
+format Perfetto and chrome://tracing load directly). Nesting needs no
+parent pointers: complete ("ph":"X") events on one thread nest by time
+containment, exactly how the viewers render them.
+
+Zero-cost discipline (same seam shape as the graftsan observer): the
+module-level tracer is None until `install()`; every record helper is
+one global load + None check when disabled — nothing is wrapped,
+patched, or allocated. The Trainer additionally gates its generator
+wrapping on `enabled()` so the disabled hot loop is byte-identical to
+the pre-graftscope one.
+
+Span names are a contract (docs/training/README.md span table, the CI
+telemetry smoke, and the telemetry histograms all key on them):
+
+    step                  one epoch's step-loop section
+    boundary              one epoch's end-of-epoch host work
+    train_step            one step: data wait + dispatch + log append
+    data_wait             blocking on the input feeder inside a step
+    dispatch              the jitted step-executable call
+    d2h_fetch             a coalesced device->host readback
+    checkpoint_snapshot   the donation-safe host copy before a save
+    async_reader_drain    the off-thread metric fetch
+    decode                one generate()/beam/speculative call
+"""
+
+import json
+import threading
+import time
+
+__all__ = ["SpanTracer", "install", "uninstall", "current_tracer",
+           "enabled", "span", "begin", "end", "complete", "trace_steps"]
+
+#: Hard cap on buffered span events; beyond it new events are counted
+#: as dropped instead of growing the host heap without bound (a week of
+#: steps would otherwise OOM the host before anyone looked at a trace).
+_DEFAULT_MAX_EVENTS = 500_000
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager returned by `span()` when the
+    tracer is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """Context manager recording one complete event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_t0")
+
+    def __init__(self, tracer, name):
+        self._tracer = tracer
+        self._name = name
+        self._t0 = 0
+
+    def __enter__(self):
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t0 = self._t0
+        self._tracer.complete(self._name, t0,
+                              time.monotonic_ns() - t0)
+        return False
+
+
+class SpanTracer:
+    """Bounded buffer of (name, tid, t0_ns, dur_ns) span events.
+
+    Thread-safe: spans arrive from the training thread, the async
+    metric reader, and the checkpoint worker concurrently; one lock
+    guards the buffer and the listener list. Listeners fire on every
+    span completion (under the lock, so keep them cheap — the
+    telemetry registry's histogram observe is a dict update) and feed
+    the step-latency/data-wait/dispatch distributions without a second
+    timing source.
+    """
+
+    def __init__(self, max_events=_DEFAULT_MAX_EVENTS):
+        self._lock = threading.Lock()
+        self._events = []
+        self._max_events = int(max_events)
+        self._dropped = 0
+        self._listeners = []
+        # Trace epoch: event timestamps export relative to install time
+        # so the Chrome trace starts near t=0 instead of host-uptime ns.
+        self._epoch_ns = time.monotonic_ns()
+
+    def add_listener(self, fn):
+        """Registers `fn(name, t0_ns, dur_ns, tid)` on span completion."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def span(self, name):
+        """Context manager recording one span around its body."""
+        return _Span(self, name)
+
+    def complete(self, name, t0_ns, dur_ns):
+        """Records one already-measured span (begin/end style)."""
+        tid = threading.get_ident()
+        with self._lock:
+            if len(self._events) < self._max_events:
+                self._events.append((name, tid, t0_ns, dur_ns))
+            else:
+                self._dropped += 1
+            listeners = tuple(self._listeners)
+        for fn in listeners:
+            try:
+                fn(name, t0_ns, dur_ns, tid)
+            except Exception:
+                pass  # a metrics sink must never break the traced code
+
+    def events(self):
+        """Snapshot of buffered (name, tid, t0_ns, dur_ns) tuples."""
+        with self._lock:
+            return list(self._events)
+
+    def dropped(self):
+        """Events discarded after the buffer cap was reached."""
+        with self._lock:
+            return self._dropped
+
+    def chrome_trace(self):
+        """The buffered spans as a Chrome trace-event JSON object.
+
+        Complete events ("ph":"X", microsecond ts/dur) on per-thread
+        tracks; Perfetto nests them by time containment. Thread names
+        ride as metadata events so tracks read "cloud-tpu-metric-
+        reader" instead of a bare tid.
+        """
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+            epoch = self._epoch_ns
+        names = {t.ident: t.name for t in threading.enumerate()}
+        trace_events = []
+        for tid in sorted({tid for _, tid, _, _ in events}):
+            trace_events.append({
+                "ph": "M", "pid": 1, "tid": tid,
+                "name": "thread_name",
+                "args": {"name": names.get(tid, "thread-{}".format(tid))},
+            })
+        for name, tid, t0_ns, dur_ns in events:
+            trace_events.append({
+                "ph": "X", "pid": 1, "tid": tid, "name": name,
+                "ts": (t0_ns - epoch) / 1e3,
+                "dur": dur_ns / 1e3,
+            })
+        trace = {"traceEvents": trace_events,
+                 "displayTimeUnit": "ms"}
+        if dropped:
+            trace["metadata"] = {"dropped_events": dropped}
+        return trace
+
+    def write(self, path):
+        """Writes `chrome_trace()` as JSON to `path`."""
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+# -- module seam (the None-check discipline) ----------------------------
+
+_tracer = None
+
+
+def install(tracer=None):
+    """Installs `tracer` (default: a fresh SpanTracer) as the ambient
+    tracer and returns it. Idempotent when one is already installed and
+    no explicit tracer is given."""
+    global _tracer
+    if tracer is None:
+        if _tracer is None:
+            _tracer = SpanTracer()
+    else:
+        _tracer = tracer
+    return _tracer
+
+
+def uninstall():
+    """Removes the ambient tracer (returns it, or None)."""
+    global _tracer
+    previous, _tracer = _tracer, None
+    return previous
+
+
+def current_tracer():
+    return _tracer
+
+
+def enabled():
+    return _tracer is not None
+
+
+def span(name):
+    """A recording context manager when a tracer is installed, else a
+    shared no-op (one global load + None check)."""
+    tracer = _tracer
+    if tracer is None:
+        return _NOOP
+    return tracer.span(name)
+
+
+def begin(name):
+    """Begin handle for code that cannot use `with` (loop phases).
+    Returns None when disabled; pass the handle to `end()`."""
+    if _tracer is None:
+        return None
+    return (name, time.monotonic_ns())
+
+
+def end(handle):
+    """Completes a `begin()` handle (no-op for None)."""
+    tracer = _tracer
+    if tracer is None or handle is None:
+        return
+    name, t0 = handle
+    tracer.complete(name, t0, time.monotonic_ns() - t0)
+
+
+def complete(name, t0_ns, dur_ns):
+    """Records an already-measured span into the ambient tracer."""
+    tracer = _tracer
+    if tracer is not None:
+        tracer.complete(name, t0_ns, dur_ns)
+
+
+def trace_steps(iterable, step_name="train_step",
+                wait_name="data_wait"):
+    """Wraps a step feeder so every iteration becomes a `train_step`
+    span containing a `data_wait` span.
+
+    The generator protocol gives the exact cut points for free:
+    `data_wait` covers blocking on the upstream feeder (`next(it)`),
+    and the `train_step` span closes when the CONSUMER asks for the
+    next item — i.e. after its dispatch + log-append body ran — so
+    consecutive train_step spans tile the loop's wall time. A consumer
+    `break` raises GeneratorExit at the yield; the finally completes
+    the in-flight span before the generator closes.
+
+    Callers gate on `enabled()` and pass the feeder through untouched
+    when tracing is off, keeping the disabled hot loop unchanged.
+    """
+    tracer = _tracer
+    if tracer is None:
+        yield from iterable
+        return
+    it = iter(iterable)
+    while True:
+        t0 = time.monotonic_ns()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        tracer.complete(wait_name, t0, time.monotonic_ns() - t0)
+        try:
+            yield item
+        finally:
+            tracer.complete(step_name, t0, time.monotonic_ns() - t0)

@@ -1,0 +1,474 @@
+"""graftscope: unified telemetry — metrics registry + lifecycle.
+
+PRs 1-5 left the runtime with raw counters (`runtime.transfer_stats` /
+`compile_stats`), a JSONL event log, the graftsan observer seam, and
+jax-profiler wrappers — numbers, but no layer that turns them into
+answerable questions ("where did this step's 40 ms go?", "what is
+decode p99?"). This module is that layer:
+
+- a **metrics registry**: Counter / Gauge / Histogram (exponential
+  buckets with p50/p95/p99 readout) under one lock-per-metric design;
+- **adapters**: a runtime observer (stacked NEXT TO graftsan through
+  the widened `runtime.add_observer` seam) turns every H2D/D2H/compile
+  record into counter movement; a span listener turns every completed
+  graftscope span (monitoring/spans.py) into a latency observation —
+  step latency, data wait, dispatch, D2H fetch — and `generate()` /
+  beam / speculative feed a per-token decode-latency histogram (the
+  precursor to serving p99); an MFU gauge derives model-flops-per-step
+  (jit cost analysis) / chip peak;
+- **lifecycle**: `CLOUD_TPU_TELEMETRY=1` makes Trainer entry points
+  run under `env_scope()` — ambient enablement on first entry, a
+  bounded-queue background flush (monitoring/export.py) per epoch, and
+  a blocking flush at scope exit so `<dir>/trace.json`,
+  `<dir>/metrics.prom` and `<dir>/telemetry.jsonl` are on disk when
+  fit() returns.
+
+Zero-cost discipline: with telemetry off nothing is installed — no
+runtime observer, no span tracer, no thread; every integration point
+is a None/env check (the graftsan seam contract, unchanged).
+
+Env contract:
+    CLOUD_TPU_TELEMETRY        1|on  -> Trainer entry points enable
+    CLOUD_TPU_TELEMETRY_DIR    output directory (default ./telemetry)
+    CLOUD_TPU_PEAK_TFLOPS      chip peak for the MFU gauge (default
+                               197, the v5e bf16 peak bench.py uses)
+"""
+
+import bisect
+import contextlib
+import logging
+import os
+import threading
+
+from cloud_tpu.monitoring import spans
+from cloud_tpu.parallel import runtime
+
+logger = logging.getLogger("cloud_tpu")
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "Telemetry",
+           "enable", "disable", "get", "enabled", "env_enabled",
+           "env_scope"]
+
+#: v5e bf16 peak, TFLOPs — the same constant bench.py's pct_peak uses,
+#: so the MFU gauge and the bench census agree on the denominator.
+DEFAULT_PEAK_TFLOPS = 197.0
+
+#: Span name -> histogram metric fed by the span listener.
+SPAN_HISTOGRAMS = {
+    "train_step": "cloud_tpu_step_latency_seconds",
+    "data_wait": "cloud_tpu_data_wait_seconds",
+    "dispatch": "cloud_tpu_dispatch_seconds",
+    "d2h_fetch": "cloud_tpu_d2h_fetch_seconds",
+    "checkpoint_snapshot": "cloud_tpu_checkpoint_snapshot_seconds",
+    "async_reader_drain": "cloud_tpu_async_reader_drain_seconds",
+    "decode": "cloud_tpu_decode_seconds",
+}
+
+DECODE_TOKEN_HISTOGRAM = "cloud_tpu_decode_token_latency_seconds"
+MFU_GAUGE = "cloud_tpu_mfu_pct_peak"
+
+
+class Counter:
+    """Monotonic counter (int)."""
+
+    __slots__ = ("name", "_mu", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._mu = threading.Lock()
+        self._value = 0
+
+    def inc(self, delta=1):
+        with self._mu:
+            self._value += int(delta)
+
+    @property
+    def value(self):
+        with self._mu:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins float."""
+
+    __slots__ = ("name", "_mu", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._mu = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value):
+        with self._mu:
+            self._value = float(value)
+
+    @property
+    def value(self):
+        with self._mu:
+            return self._value
+
+
+class Histogram:
+    """Exponential-bucket histogram with percentile readout.
+
+    Bucket upper bounds are `start * factor**i` for i in [0, buckets);
+    observations above the last bound land in the +Inf bucket. The
+    defaults (1 µs .. ~72 min at factor 2) cover every latency this
+    framework measures — a step dispatch, a tunnel round trip, a cold
+    compile — at ≤2x relative bucket error, which is what a p99 read
+    off bucket interpolation inherits.
+    """
+
+    __slots__ = ("name", "_mu", "bounds", "_counts", "_sum", "_count",
+                 "_max")
+
+    def __init__(self, name, start=1e-6, factor=2.0, buckets=32):
+        self.name = name
+        self._mu = threading.Lock()
+        bounds = []
+        bound = float(start)
+        for _ in range(int(buckets)):
+            bounds.append(bound)
+            bound *= float(factor)
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # [+Inf overflow last]
+        self._sum = 0.0
+        self._count = 0
+        self._max = 0.0
+
+    def observe(self, value, count=1):
+        """Records `count` observations of `value` (a batched decode
+        records its per-token latency once per generated token)."""
+        value = float(value)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._mu:
+            self._counts[idx] += count
+            self._sum += value * count
+            self._count += count
+            if value > self._max:
+                self._max = value
+
+    @property
+    def count(self):
+        with self._mu:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._mu:
+            return self._sum
+
+    def percentile(self, p):
+        """Approximate p-th percentile (0-100) by linear interpolation
+        inside the bucket holding that rank; 0.0 when empty. The +Inf
+        bucket reports the largest observed value."""
+        with self._mu:
+            counts = list(self._counts)
+            total = self._count
+            largest = self._max
+        if total <= 0:
+            return 0.0
+        rank = (p / 100.0) * total
+        cumulative = 0
+        for idx, bucket_count in enumerate(counts):
+            previous = cumulative
+            cumulative += bucket_count
+            if cumulative >= rank and bucket_count:
+                if idx >= len(self.bounds):
+                    return largest
+                upper = self.bounds[idx]
+                lower = self.bounds[idx - 1] if idx else 0.0
+                fraction = (rank - previous) / bucket_count
+                return lower + (upper - lower) * min(fraction, 1.0)
+        return largest
+
+    def snapshot(self):
+        with self._mu:
+            counts = list(self._counts)
+            total = self._count
+            value_sum = self._sum
+        return {
+            "bounds": list(self.bounds),
+            "counts": counts,
+            "count": total,
+            "sum": value_sum,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+
+class Registry:
+    """Name-keyed metric store; get-or-create accessors."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self._histograms = {}
+
+    def counter(self, name):
+        with self._mu:
+            metric = self._counters.get(name)
+            if metric is None:
+                metric = self._counters[name] = Counter(name)
+            return metric
+
+    def gauge(self, name):
+        with self._mu:
+            metric = self._gauges.get(name)
+            if metric is None:
+                metric = self._gauges[name] = Gauge(name)
+            return metric
+
+    def histogram(self, name, **kwargs):
+        with self._mu:
+            metric = self._histograms.get(name)
+            if metric is None:
+                metric = self._histograms[name] = Histogram(name,
+                                                            **kwargs)
+            return metric
+
+    def snapshot(self):
+        """Plain-data view for exporters: {"counters": {name: int},
+        "gauges": {name: float}, "histograms": {name: {...}}}."""
+        with self._mu:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {n: c.value for n, c in counters.items()},
+            "gauges": {n: g.value for n, g in gauges.items()},
+            "histograms": {n: h.snapshot()
+                           for n, h in histograms.items()},
+        }
+
+
+class _RuntimeObserver:
+    """The adapter on the widened runtime observer seam: every
+    transfer/compile record becomes counter movement. Stacks with a
+    graftsan Sanitizer through `runtime.add_observer` fanout."""
+
+    def __init__(self, registry):
+        self._h2d_transfers = registry.counter(
+            "cloud_tpu_h2d_transfers_total")
+        self._h2d_bytes = registry.counter("cloud_tpu_h2d_bytes_total")
+        self._d2h_fetches = registry.counter(
+            "cloud_tpu_d2h_fetches_total")
+        self._d2h_bytes = registry.counter("cloud_tpu_d2h_bytes_total")
+        self._traces = registry.counter("cloud_tpu_traces_total")
+        self._compiles = registry.counter("cloud_tpu_compiles_total")
+        self._cache_hits = registry.counter(
+            "cloud_tpu_compile_cache_hits_total")
+        self._cache_misses = registry.counter(
+            "cloud_tpu_compile_cache_misses_total")
+
+    def on_h2d(self, transfers, nbytes):
+        self._h2d_transfers.inc(transfers)
+        self._h2d_bytes.inc(nbytes)
+
+    def on_d2h(self, nbytes, tree):
+        self._d2h_fetches.inc(1)
+        self._d2h_bytes.inc(nbytes)
+
+    def on_compile(self, n_traces, n_compiles, cache_hits):
+        self._traces.inc(n_traces)
+        self._compiles.inc(n_compiles)
+        self._cache_hits.inc(cache_hits)
+
+    def on_cache_miss(self):
+        self._cache_misses.inc(1)
+
+    def on_epoch(self, epoch):
+        pass
+
+    def on_donation(self, args):
+        pass
+
+
+class Telemetry:
+    """One enabled telemetry session: registry + tracer + exporters.
+
+    Use the module-level `enable()`/`env_scope()` for the ambient
+    singleton; direct construction is for tests that want an isolated
+    instance.
+    """
+
+    def __init__(self, out_dir, peak_tflops=None):
+        self.out_dir = str(out_dir)
+        self.registry = Registry()
+        self.tracer = None
+        if peak_tflops is None:
+            peak_tflops = float(os.environ.get(
+                "CLOUD_TPU_PEAK_TFLOPS", DEFAULT_PEAK_TFLOPS))
+        self.peak_flops = peak_tflops * 1e12
+        self._observer = None
+        self._worker = None
+        self._exporters = ()
+        self._step_flops = None
+        self._active = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def enable(self):
+        """Installs the span tracer + runtime observer and starts the
+        background flush worker. Idempotent."""
+        if self._active:
+            return self
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.tracer = spans.install()
+        self.tracer.add_listener(self._on_span)
+        self._observer = _RuntimeObserver(self.registry)
+        runtime.add_observer(self._observer)
+        # The headline series exist from t=0 (a textfile scrape between
+        # enable and the first epoch still sees them).
+        self.registry.gauge(MFU_GAUGE).set(0.0)
+        self.registry.histogram("cloud_tpu_step_latency_seconds")
+        self.registry.histogram(DECODE_TOKEN_HISTOGRAM)
+        from cloud_tpu.monitoring import export
+        self._exporters = export.default_exporters(self.out_dir)
+        self._worker = export.FlushWorker(self._do_flush)
+        self._active = True
+        return self
+
+    def disable(self):
+        """Final flush, then tears every hook down. Idempotent."""
+        if not self._active:
+            return
+        self._active = False
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.close(flush=True)
+        if self._observer is not None:
+            runtime.remove_observer(self._observer)
+            self._observer = None
+        spans.uninstall()
+
+    @property
+    def active(self):
+        return self._active
+
+    # -- adapters ------------------------------------------------------
+
+    def _on_span(self, name, t0_ns, dur_ns, tid):
+        metric = SPAN_HISTOGRAMS.get(name)
+        if metric is not None:
+            self.registry.histogram(metric).observe(dur_ns / 1e9)
+
+    def set_step_flops(self, flops):
+        """Model flops for ONE train step (jit cost analysis), the MFU
+        numerator. 0/None disables the gauge update."""
+        self._step_flops = float(flops) if flops else None
+
+    @property
+    def step_flops(self):
+        return self._step_flops
+
+    def record_epoch(self, steps, examples, elapsed_secs):
+        """Per-epoch rollup from the Trainer boundary: throughput
+        counters, the MFU gauge, and one (lossy, non-blocking) flush."""
+        if steps > 0:
+            self.registry.counter("cloud_tpu_training_steps_total").inc(
+                steps)
+            self.registry.counter(
+                "cloud_tpu_training_examples_total").inc(examples)
+            elapsed_secs = max(float(elapsed_secs), 1e-9)
+            self.registry.gauge("cloud_tpu_steps_per_sec").set(
+                steps / elapsed_secs)
+            if self._step_flops:
+                flops_per_sec = self._step_flops * steps / elapsed_secs
+                self.registry.gauge(MFU_GAUGE).set(
+                    100.0 * flops_per_sec / self.peak_flops)
+        self.flush()
+
+    def observe_decode(self, n_tokens, elapsed_secs):
+        """Per-token decode latency: one observation per generated
+        token at the call's mean per-token latency (all tokens of one
+        scan share their dispatch's wall time)."""
+        n_tokens = int(n_tokens)
+        if n_tokens <= 0:
+            return
+        self.registry.histogram(DECODE_TOKEN_HISTOGRAM).observe(
+            float(elapsed_secs) / n_tokens, count=n_tokens)
+
+    # -- export --------------------------------------------------------
+
+    def flush(self, wait=False):
+        """Requests an export pass on the background worker. Non-wait
+        requests are lossy when one is already queued (coalesced);
+        wait=True blocks until a full pass completed."""
+        worker = self._worker
+        if worker is None:
+            self._do_flush()
+            return
+        worker.request(wait=wait)
+
+    def _do_flush(self):
+        for exporter in self._exporters:
+            try:
+                exporter.export(self)
+            except Exception:
+                logger.debug("telemetry exporter %r failed",
+                             exporter, exc_info=True)
+
+
+# -- ambient singleton + env contract -----------------------------------
+
+_telemetry = None
+_enable_lock = threading.Lock()
+
+
+def env_enabled():
+    """The CLOUD_TPU_TELEMETRY env contract (same truthiness grammar
+    as CLOUD_TPU_SANITIZE)."""
+    value = os.environ.get("CLOUD_TPU_TELEMETRY", "").strip().lower()
+    return value not in ("", "0", "off", "false", "none")
+
+
+def enable(out_dir=None):
+    """Enables the ambient telemetry singleton (idempotent). `out_dir`
+    defaults to CLOUD_TPU_TELEMETRY_DIR, then ./telemetry."""
+    global _telemetry
+    with _enable_lock:
+        if _telemetry is None:
+            if out_dir is None:
+                out_dir = (os.environ.get("CLOUD_TPU_TELEMETRY_DIR")
+                           or os.path.join(os.getcwd(), "telemetry"))
+            _telemetry = Telemetry(out_dir)
+        return _telemetry.enable()
+
+
+def disable():
+    """Tears the ambient singleton down (test isolation)."""
+    global _telemetry
+    with _enable_lock:
+        tele, _telemetry = _telemetry, None
+    if tele is not None:
+        tele.disable()
+
+
+def get():
+    """The ambient Telemetry, or None when disabled."""
+    return _telemetry
+
+
+def enabled():
+    return _telemetry is not None and _telemetry.active
+
+
+@contextlib.contextmanager
+def env_scope():
+    """Library entry-point scope (Trainer.fit/evaluate): enables the
+    ambient singleton when CLOUD_TPU_TELEMETRY asks for it, and
+    guarantees a completed (blocking) flush at scope exit so the
+    trace/textfile artifacts exist the moment the entry point returns.
+    Enablement is ambient, not scoped — nested fits reuse the same
+    session and tear nothing down (use `disable()` for that)."""
+    if not env_enabled():
+        yield None
+        return
+    tele = enable()
+    try:
+        yield tele
+    finally:
+        tele.flush(wait=True)

@@ -384,23 +384,103 @@ _transfer_stats = {"h2d_transfers": 0, "h2d_bytes": 0,
 # label is advisory context for attribution, never control flow.
 
 _observer = None
+_observers = ()
 _phase = threading.local()
 
 
-def set_observer(observer):
-    """Installs `observer` (or None to remove). Returns the previous
-    observer so scoped installers can restore it. The observer sees
-    `on_h2d(transfers, nbytes)`, `on_d2h(nbytes)`,
-    `on_compile(n_traces, n_compiles, cache_hits)` and
-    `on_epoch(epoch)` — all best-effort, called inline at record time
-    on whatever thread recorded."""
+class _FanoutObserver:
+    """Dispatch target when more than one observer is installed
+    (graftsan + graftscope stacking). Forwards each event to every
+    target that implements it; a missing method on one target never
+    hides the event from the others. Hot-path cost with a single
+    observer is unchanged: the fanout only exists with >= 2."""
+
+    __slots__ = ("targets",)
+
+    def __init__(self, targets):
+        self.targets = tuple(targets)
+
+    def _fan(self, method, *args):
+        for target in self.targets:
+            fn = getattr(target, method, None)
+            if fn is not None:
+                fn(*args)
+
+    def on_h2d(self, transfers, nbytes):
+        self._fan("on_h2d", transfers, nbytes)
+
+    def on_d2h(self, nbytes, tree):
+        self._fan("on_d2h", nbytes, tree)
+
+    def on_compile(self, n_traces, n_compiles, cache_hits):
+        self._fan("on_compile", n_traces, n_compiles, cache_hits)
+
+    def on_cache_miss(self):
+        self._fan("on_cache_miss")
+
+    def on_epoch(self, epoch):
+        self._fan("on_epoch", epoch)
+
+    def on_donation(self, args):
+        self._fan("on_donation", args)
+
+
+def _rebuild_dispatch():
+    """Recomputes the fast dispatch target `_observer` from the
+    installed set: None (record sites stay one None-check), the sole
+    observer (direct calls, no indirection), or a fanout."""
     global _observer
+    if not _observers:
+        _observer = None
+    elif len(_observers) == 1:
+        _observer = _observers[0]
+    else:
+        _observer = _FanoutObserver(_observers)
+
+
+def add_observer(observer):
+    """Adds `observer` to the installed set (idempotent). Observers
+    see `on_h2d(transfers, nbytes)`, `on_d2h(nbytes, tree)`,
+    `on_compile(n_traces, n_compiles, cache_hits)`, `on_cache_miss()`,
+    `on_epoch(epoch)`, `on_donation(args)` — all best-effort, called
+    inline at record time on whatever thread recorded; any subset of
+    those methods may be implemented when stacked. Returns `observer`."""
+    global _observers
+    if observer is not None and observer not in _observers:
+        _observers = _observers + (observer,)
+        _rebuild_dispatch()
+    return observer
+
+
+def remove_observer(observer):
+    """Removes `observer` from the installed set (no-op if absent)."""
+    global _observers
+    if observer in _observers:
+        _observers = tuple(o for o in _observers if o is not observer)
+        _rebuild_dispatch()
+
+
+def observers():
+    """Snapshot of the installed observer set (install order)."""
+    return _observers
+
+
+def set_observer(observer):
+    """Legacy single-observer API: replaces the WHOLE installed set
+    with `observer` (or clears it for None). Returns the previous
+    dispatch target so scoped installers can restore it. New code —
+    anything that must coexist with another observer — uses
+    `add_observer`/`remove_observer` instead."""
+    global _observers
     previous = _observer
-    _observer = observer
+    _observers = (observer,) if observer is not None else ()
+    _rebuild_dispatch()
     return previous
 
 
 def get_observer():
+    """The current dispatch target: None, the sole observer, or the
+    internal fanout when several are stacked."""
     return _observer
 
 
@@ -483,13 +563,17 @@ def device_fetch(tree):
     """The sanctioned instrumented readback: record, then device_get.
 
     All Trainer/bench device->host reads route through here so the
-    d2h counters stay an exhaustive census of fetch sites. Returns
+    d2h counters stay an exhaustive census of fetch sites — and so one
+    graftscope span ("d2h_fetch") times every round trip. Returns
     `jax.device_get(tree)` (host numpy leaves, same structure).
     """
     import jax
 
     record_d2h(tree)
-    return jax.device_get(tree)
+    from cloud_tpu.monitoring import spans
+
+    with spans.span("d2h_fetch"):
+        return jax.device_get(tree)
 
 
 def transfer_stats():

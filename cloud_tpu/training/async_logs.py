@@ -125,7 +125,13 @@ class AsyncMetricReader:
                 return
             device_logs, future = item
             try:
-                host = runtime.device_fetch(device_logs)
+                from cloud_tpu.monitoring import spans
+
+                # graftscope: one span per off-thread drain — this is
+                # the time the reader thread spends resolving an
+                # interval, invisible to the step loop by design.
+                with spans.span("async_reader_drain"):
+                    host = runtime.device_fetch(device_logs)
                 future.set_result({k: float(v)
                                    for k, v in host.items()})
             except BaseException as exc:  # propagate, never swallow

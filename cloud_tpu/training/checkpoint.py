@@ -86,9 +86,15 @@ def _host_snapshot(state):
     if leaves and all(l.is_fully_addressable for l in leaves):
         # Phase label for the graftsan sanitizer: this coalesced fetch
         # is the sanctioned snapshot copy, whatever thread saves from.
+        from cloud_tpu.monitoring import spans
+
         previous = runtime.set_phase("checkpoint")
         try:
-            return runtime.device_fetch(state)
+            # graftscope: the snapshot copy is its own span so the
+            # step-time breakdown can separate checkpoint stalls from
+            # ordinary boundary fetches.
+            with spans.span("checkpoint_snapshot"):
+                return runtime.device_fetch(state)
         finally:
             runtime.set_phase(previous)
     return state

@@ -32,6 +32,7 @@ import optax
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from cloud_tpu.monitoring import spans as spans_lib
 from cloud_tpu.parallel import runtime
 from cloud_tpu.parallel import sharding as sharding_lib
 from cloud_tpu.training import async_logs as async_logs_lib
@@ -57,6 +58,28 @@ def _env_sanitized(method):
             return method(self, *args, **kwargs)
         from cloud_tpu.analysis import sanitizer
         with sanitizer.env_scope():
+            return method(self, *args, **kwargs)
+    return wrapper
+
+
+def _env_telemetry(method):
+    """Runs a Trainer entry point under a graftscope telemetry scope.
+
+    `CLOUD_TPU_TELEMETRY=1` enables the ambient telemetry session
+    (span tracer + metrics registry + exporters, see
+    cloud_tpu.monitoring.telemetry) and guarantees a completed flush
+    when the entry point returns, so trace.json / metrics.prom exist
+    the moment fit() does. Unset, the wrapper is a plain delegation —
+    no import, no tracer, no observer hook (the graftsan zero-cost
+    discipline). Stacks with `_env_sanitized`: both observers ride the
+    widened runtime fanout seam.
+    """
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        if not os.environ.get("CLOUD_TPU_TELEMETRY"):
+            return method(self, *args, **kwargs)
+        from cloud_tpu.monitoring import telemetry
+        with telemetry.env_scope():
             return method(self, *args, **kwargs)
     return wrapper
 
@@ -209,6 +232,23 @@ def _emit_runtime_metrics(steps, examples, elapsed_secs):
             monitoring.STEP_TIME_BOUNDS)
     except Exception:  # monitoring must never break training
         logger.debug("metric emission failed", exc_info=True)
+
+
+def _emit_telemetry_epoch(steps, examples, elapsed_secs):
+    """Feeds the graftscope registry's per-epoch rollup (throughput
+    counters + MFU gauge + one non-blocking flush). `sys.modules.get`
+    keeps the disabled path import-free: if telemetry was never
+    imported, it is certainly not enabled."""
+    telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+    if telemetry is None:
+        return
+    tele = telemetry.get()
+    if tele is None or not tele.active:
+        return
+    try:
+        tele.record_epoch(steps, examples, elapsed_secs)
+    except Exception:  # telemetry must never break training
+        logger.debug("telemetry epoch rollup failed", exc_info=True)
 
 
 import typing
@@ -1438,8 +1478,37 @@ class Trainer:
                 state_struct, self._batch_struct(sample_x))
         return runtime.compile_stats()
 
+    def _maybe_capture_step_flops(self, fn, n_steps, *args):
+        """Captures model flops per TRAIN STEP for the graftscope MFU
+        gauge, once per enabled telemetry session.
+
+        Uses jit cost analysis on a lowering of the step executable
+        (`fn.lower(*args).cost_analysis()['flops']` — no XLA compile),
+        divided by `n_steps` for grouped/resident executables that run
+        several steps per dispatch. Called at the FIRST dispatch of a
+        fit, before the call consumes its donated buffers; the extra
+        trace lands in epoch 0, ahead of the retrace-sentinel baseline.
+        No-ops (one dict lookup) when telemetry is off.
+        """
+        telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+        if telemetry is None:
+            return
+        tele = telemetry.get()
+        if tele is None or not tele.active or tele.step_flops:
+            return
+        try:
+            analysis = fn.lower(*args).cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0] if analysis else {}
+            flops = float(analysis.get("flops", 0.0) or 0.0)
+            if flops > 0:
+                tele.set_step_flops(flops / max(int(n_steps), 1))
+        except Exception:  # telemetry must never break training
+            logger.debug("step-flops capture failed", exc_info=True)
+
     # -- public API -----------------------------------------------------
 
+    @_env_telemetry
     @_env_sanitized
     def fit(self,
             x=None,
@@ -1830,6 +1899,12 @@ class Trainer:
             # _post_epoch_logs flips the label back to "boundary" where
             # the per-epoch coalesced fetch is sanctioned.
             runtime.set_phase("step")
+            # graftscope: the whole step-loop section is one "step"
+            # span; each feeder iteration becomes a "train_step" span
+            # containing "data_wait" + "dispatch". begin() is None and
+            # trace_steps is skipped when telemetry is off, so the
+            # disabled hot loop is unchanged.
+            step_section = spans_lib.begin("step")
             spe = self.steps_per_execution
             multi_step = getattr(self, "_jit_multi_step", None)
             if spe > 1 and multi_step is not None:
@@ -1840,13 +1915,20 @@ class Trainer:
                     size=prefetch,
                     feed=lambda item: unpack(item) + (
                         self._feed_grouped(item),))
+                if spans_lib.enabled():
+                    feeder = spans_lib.trace_steps(feeder)
                 first = True
                 for kind, batch_examples, w_sum, fed in feeder:
                     if self._abort_epoch:
                         break
                     examples += batch_examples
                     if kind == "multi":
-                        self.state, logs = multi_step(self.state, fed)
+                        if first and epoch == initial_epoch:
+                            self._maybe_capture_step_flops(
+                                multi_step, spe, self.state, fed)
+                        with spans_lib.span("dispatch"):
+                            self.state, logs = multi_step(self.state,
+                                                          fed)
                         if "_batch_weight" in logs:
                             # The group log already carries the GROUP
                             # weight sum: append once (duplicating
@@ -1867,13 +1949,16 @@ class Trainer:
                         count += spe
                     elif kind == "padded":
                         tail_step = self._tail_step_fn(weighted, cast)
-                        self.state, logs = tail_step(self.state, fed)
+                        with spans_lib.span("dispatch"):
+                            self.state, logs = tail_step(self.state,
+                                                         fed)
                         step_logs.append(self._fix_tail_logs(
                             logs, weighted, w_sum))
                         count += 1
                     else:
-                        self.state, logs = self._jit_train_step(
-                            self.state, fed)
+                        with spans_lib.span("dispatch"):
+                            self.state, logs = self._jit_train_step(
+                                self.state, fed)
                         step_logs.append(logs)
                         count += 1
                     if (first and epoch == initial_epoch
@@ -1889,6 +1974,7 @@ class Trainer:
                             "per-example values.".format(
                                 sorted(self._train_scalar_unmasked)))
                     first = False
+                spans_lib.end(step_section)
                 if not (self._abort_epoch and count == 0):
                     # A zero-step aborted epoch has no metrics; an
                     # epoch-end with only steps_per_sec would desync
@@ -1928,17 +2014,24 @@ class Trainer:
                 feed=lambda item: unpack(item) + (
                     self._feed(item[2][0] if item[0] == "padded"
                                else item[2]),))
+            if spans_lib.enabled():
+                feeder = spans_lib.trace_steps(feeder)
             for kind, batch_examples, w_sum, batch in feeder:
                 if self._abort_epoch:
                     break
                 examples += batch_examples
                 if kind == "padded":
                     tail_step = self._tail_step_fn(weighted, cast)
-                    self.state, logs = tail_step(self.state, batch)
+                    with spans_lib.span("dispatch"):
+                        self.state, logs = tail_step(self.state, batch)
                     logs = self._fix_tail_logs(logs, weighted, w_sum)
                 else:
-                    self.state, logs = self._jit_train_step(self.state,
-                                                            batch)
+                    if count == 0 and epoch == initial_epoch:
+                        self._maybe_capture_step_flops(
+                            self._jit_train_step, 1, self.state, batch)
+                    with spans_lib.span("dispatch"):
+                        self.state, logs = self._jit_train_step(
+                            self.state, batch)
                 if (count == 0 and epoch == initial_epoch
                         and getattr(self, "_train_scalar_unmasked", None)):
                     # Populated during the trace that just ran: a
@@ -1956,6 +2049,7 @@ class Trainer:
                 # device step); convert once per epoch below.
                 step_logs.append(logs)
                 count += 1
+            spans_lib.end(step_section)
             if not (self._abort_epoch and count == 0):
                 # Same zero-step-abort guard as the multi-step path.
                 self._post_epoch_logs(step_logs, count, examples, t0,
@@ -2049,13 +2143,25 @@ class Trainer:
             # Same graftsan step label as _fit_epochs: executable calls
             # only between here and _post_epoch_logs' "boundary".
             runtime.set_phase("step")
+            # graftscope: same span contract as _fit_epochs — the
+            # resident loop has no data wait (batches are drawn
+            # in-graph), so each call is one train_step span whose
+            # body is all dispatch.
+            step_section = spans_lib.begin("step")
             calls = [(run_group, spe)] * n_groups
             if leftover:
                 calls.append((run_tail, leftover))
             for run, n_steps in calls:
                 if self._abort_epoch:
                     break
-                self.state, logs = run(self.state, data, base, ep_idx)
+                if count == 0 and epoch == initial_epoch:
+                    self._maybe_capture_step_flops(
+                        run, n_steps, self.state, data, base, ep_idx)
+                train_handle = spans_lib.begin("train_step")
+                with spans_lib.span("dispatch"):
+                    self.state, logs = run(self.state, data, base,
+                                           ep_idx)
+                spans_lib.end(train_handle)
                 if "_batch_weight" in logs:
                     if n_steps > 1:
                         # Same group-entry semantics as the
@@ -2074,6 +2180,7 @@ class Trainer:
                         "per-example values.".format(
                             sorted(set().union(*scalar_sets))))
                 count += n_steps
+            spans_lib.end(step_section)
             if not (self._abort_epoch and count == 0):
                 self._post_epoch_logs(step_logs, count,
                                       count * resident.batch_size, t0,
@@ -2102,6 +2209,10 @@ class Trainer:
         # verbose printing) are sanctioned here — relabel the thread so
         # graftsan doesn't count them against the step loop.
         runtime.set_phase("boundary")
+        # graftscope: the boundary host work (aggregation, validation,
+        # callbacks, sentinel) is one "boundary" span, ended right
+        # before the method returns.
+        boundary_handle = spans_lib.begin("boundary")
         if step_logs and "_batch_weight" in step_logs[0]:
             # Weighted fit: epoch metrics re-weight each batch's
             # weighted mean by that batch's weight sum (exact over
@@ -2133,6 +2244,7 @@ class Trainer:
         elapsed = max(time.time() - t0, 1e-9)
         host_logs = {"steps_per_sec": count / elapsed}
         _emit_runtime_metrics(count, examples, elapsed)
+        _emit_telemetry_epoch(count, examples, elapsed)
 
         if validation_data is not None and self._abort_epoch:
             # Preemption abort: the eviction grace window is for the
@@ -2219,6 +2331,7 @@ class Trainer:
         # only after the warm-up epoch has finished, mirroring the
         # sentinel's own baseline timing above.
         runtime.notify_epoch(epoch)
+        spans_lib.end(boundary_handle)
 
     def summary(self, print_fn=None):
         """Keras `model.summary()` parity: per-top-level-module
@@ -2306,6 +2419,7 @@ class Trainer:
                                             step=step)
         return self.state
 
+    @_env_telemetry
     @_env_sanitized
     def evaluate(self, x, y=None, batch_size=32, verbose=True,
                  steps=None, prefetch=2, use_ema=False,

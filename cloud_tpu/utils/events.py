@@ -23,12 +23,15 @@ provided for tests and for the tuner-side parsing path.
 """
 
 import json
+import logging
 import os
 import socket
 import struct
 import time
 
 from cloud_tpu.utils import storage
+
+logger = logging.getLogger("cloud_tpu")
 
 _CRC_TABLE = []
 _WRITER_COUNT = 0
@@ -180,9 +183,29 @@ def log_job_event(kind, payload, path=None):
 
 
 def read_job_events(path):
-    """Parses a JSONL job-event file -> list of dicts (skips blanks)."""
-    data = storage.read_bytes(path).decode("utf-8")
-    return [json.loads(line) for line in data.splitlines() if line.strip()]
+    """Parses a JSONL job-event file -> list of dicts.
+
+    Skips blanks AND corrupt/partial lines (a writer that crashed
+    mid-append, or two unsynchronized appenders interleaving) with one
+    warning for the whole file — a single torn line must not poison
+    every later reader of an otherwise-healthy log.
+    """
+    data = storage.read_bytes(path).decode("utf-8", errors="replace")
+    records = []
+    corrupt = 0
+    for line in data.splitlines():
+        if not line.strip():
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            corrupt += 1
+    if corrupt:
+        logger.warning(
+            "read_job_events: skipped %d corrupt/partial JSON line(s) "
+            "in %s (crashed writer?); returning the %d parseable "
+            "record(s).", corrupt, path, len(records))
+    return records
 
 
 # -- Reader (tests + tuner-side readback) -------------------------------

@@ -15,8 +15,11 @@ reference's DirectoryWatcher re-polls GCS.
 """
 
 import json
+import logging
 
 from cloud_tpu.utils import storage
+
+logger = logging.getLogger("cloud_tpu")
 
 
 class MetricsWatcher:
@@ -34,17 +37,35 @@ class MetricsWatcher:
         self.path = path
         self._offset = 0
         self._partial = b""
+        self._warned_truncated = False
 
     def poll(self):
         """Returns the list of complete records appended since last poll.
 
         Missing files mean "not started yet" and return []. A trailing
         partial line (a concurrent writer mid-append) is buffered until
-        its newline arrives.
+        its newline arrives. A stream SHORTER than the recorded offset
+        means the object was truncated or rewritten (trial restart, log
+        rotation): the watcher re-reads from 0 — with one warning per
+        rotation — instead of silently yielding nothing forever.
         """
         if not storage.exists(self.path):
             return []
         data = storage.read_bytes(self.path)
+        if len(data) < self._offset:
+            if not self._warned_truncated:
+                logger.warning(
+                    "MetricsWatcher: %s shrank below the last read "
+                    "offset (%d -> %d bytes); stream was truncated or "
+                    "rewritten — re-reading from the start.",
+                    self.path, self._offset, len(data))
+                self._warned_truncated = True
+            self._offset = 0
+            self._partial = b""
+        elif len(data) > self._offset:
+            # Growth after a rotation re-arms the warning: each
+            # rotation event warns once, not once per watcher lifetime.
+            self._warned_truncated = False
         if len(data) <= self._offset:
             return []
         new = self._partial + data[self._offset:]

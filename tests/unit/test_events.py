@@ -109,9 +109,42 @@ class TestJobEventLog:
         for t in threads:
             t.join()
 
-        records = events.read_job_events(path)  # raises on a torn line
+        # A torn line would be skipped by the corrupt-line guard and
+        # show up here as a short count.
+        records = events.read_job_events(path)
         assert len(records) == n_threads * n_records
         for tag in range(n_threads):
             got = sorted(r["payload"]["i"] for r in records
                          if r["payload"]["tag"] == tag)
             assert got == list(range(n_records))
+
+    def test_corrupt_lines_skipped_with_one_warning(self, tmp_path,
+                                                    caplog):
+        # A writer that crashed mid-append leaves a torn line; readers
+        # of the otherwise-healthy log must get every parseable record
+        # and exactly one warning, not a ValueError.
+        import logging
+
+        path = str(tmp_path / "events.jsonl")
+        events.log_job_event("a", {"i": 0}, path=path)
+        with open(path, "a") as f:
+            f.write('{"time": 1.0, "kind": "torn", "payl\n')
+            f.write("not json at all\n")
+        events.log_job_event("b", {"i": 1}, path=path)
+        with caplog.at_level(logging.WARNING, logger="cloud_tpu"):
+            records = events.read_job_events(path)
+        assert [r["kind"] for r in records] == ["a", "b"]
+        warnings_seen = [r for r in caplog.records
+                         if "corrupt" in r.getMessage()]
+        assert len(warnings_seen) == 1
+        assert "2" in warnings_seen[0].getMessage()
+
+    def test_clean_file_reads_without_warning(self, tmp_path, caplog):
+        import logging
+
+        path = str(tmp_path / "events.jsonl")
+        events.log_job_event("a", {"i": 0}, path=path)
+        with caplog.at_level(logging.WARNING, logger="cloud_tpu"):
+            assert len(events.read_job_events(path)) == 1
+        assert not [r for r in caplog.records
+                    if "corrupt" in r.getMessage()]

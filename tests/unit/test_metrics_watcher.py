@@ -61,3 +61,41 @@ class TestMetricsWatcher:
         with open(path, "w") as f:
             f.write("\n" + json.dumps({"epoch": 0}) + "\n\n")
         assert MetricsWatcher(path).poll() == [{"epoch": 0}]
+
+    def test_rewritten_file_resets_offset_and_warns_once(self, tmp_path,
+                                                         caplog):
+        # A restarted trial rewrites its metrics file from scratch; the
+        # watcher's recorded offset then exceeds the object size. It
+        # must re-read from 0 (with ONE warning), not silently yield
+        # nothing forever.
+        import logging
+
+        path = str(tmp_path / "metrics.jsonl")
+        watcher = MetricsWatcher(path)
+        with open(path, "w") as f:
+            f.write(json.dumps({"epoch": 0, "loss": 2.0}) + "\n")
+            f.write(json.dumps({"epoch": 1, "loss": 1.5}) + "\n")
+        assert len(watcher.poll()) == 2
+        with open(path, "w") as f:  # rewrite: shorter than the offset
+            f.write(json.dumps({"epoch": 0, "loss": 9.0}) + "\n")
+        with caplog.at_level(logging.WARNING, logger="cloud_tpu"):
+            records = watcher.poll()
+            assert records == [{"epoch": 0, "loss": 9.0}]
+            # Stable afterwards: nothing new, no repeat warning.
+            assert watcher.poll() == []
+        truncation_warnings = [r for r in caplog.records
+                               if "shrank" in r.getMessage()]
+        assert len(truncation_warnings) == 1
+
+    def test_rewrite_discards_buffered_partial(self, tmp_path):
+        # The partial-line buffer belongs to the OLD stream; splicing
+        # it onto the rewritten file would fabricate a record.
+        path = str(tmp_path / "metrics.jsonl")
+        watcher = MetricsWatcher(path)
+        record = json.dumps({"epoch": 7, "loss": 2.0})
+        with open(path, "w") as f:
+            f.write(record + "\n" + record[:10])  # torn tail
+        assert len(watcher.poll()) == 1
+        with open(path, "w") as f:
+            f.write(json.dumps({"epoch": 0}) + "\n")
+        assert watcher.poll() == [{"epoch": 0}]

@@ -267,3 +267,63 @@ class TestNativeReleaseBuild:
                              timeout=120)
         assert run.returncode == 0, run.stderr[-2000:]
         assert "ALL MONITORING TESTS PASSED" in run.stdout
+
+
+class TestPyFallback:
+    """The pure-Python fallback registry — what every deployment
+    without the built .so actually runs. Forces `native._lib = None`
+    so these pass identically whether or not the library is built."""
+
+    @pytest.fixture(autouse=True)
+    def _force_fallback(self, monkeypatch):
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_fallback", native._PyFallback())
+        yield
+
+    def test_increment_flush_round_trip(self, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_MONITORING_PROJECT_ID", "proj")
+        native.counter_increment("/cloud_tpu/training/steps", 3)
+        native.counter_increment("/cloud_tpu/training/steps", 4)
+        native.gauge_set("/cloud_tpu/mem/hbm_used", 0.25)
+        native.histogram_observe(
+            "/cloud_tpu/training/step_time_usecs_histogram", 1500.0,
+            monitoring.STEP_TIME_BOUNDS)
+        payload = json.loads(native.snapshot_json())
+        assert payload["name"] == "projects/proj"
+        by_type = {s["metric"]["type"]: s for s in payload["timeSeries"]}
+        steps = by_type[
+            "custom.googleapis.com/cloud_tpu/training/steps"]
+        assert steps["metricKind"] == "CUMULATIVE"
+        assert steps["points"][0]["value"]["int64Value"] == 7
+        gauge = by_type["custom.googleapis.com/cloud_tpu/mem/hbm_used"]
+        assert gauge["points"][0]["value"]["doubleValue"] == 0.25
+        hist = by_type["custom.googleapis.com/cloud_tpu/training/"
+                       "step_time_usecs_histogram"]
+        dist = hist["points"][0]["value"]["distributionValue"]
+        assert dist["count"] == 1
+        assert dist["mean"] == 1500.0
+        assert (dist["bucketOptions"]["explicitBuckets"]["bounds"]
+                == monitoring.STEP_TIME_BOUNDS)
+
+    def test_empty_registry_snapshots_empty_string(self):
+        assert native.snapshot_json() == ""
+
+    def test_transport_hooks_report_unavailable(self):
+        # The transport-error path: without the native library there is
+        # no C exporter to route sends through — set_transport must
+        # say so (False) rather than silently dropping the callable,
+        # and the http probe must agree.
+        assert native.set_transport(lambda method, payload: True) is False
+        assert native.http_transport_available() is False
+        assert native.start_exporter() is False
+        assert native.export_count() == 0
+        native.flush()  # no-op, must not raise
+
+    def test_config_debug_string_names_fallback(self):
+        assert native.config_debug_string() == "python-fallback"
+
+    def test_reset_for_testing_clears_fallback(self):
+        native.counter_increment("/cloud_tpu/training/steps", 1)
+        assert native.snapshot_json() != ""
+        native.reset_for_testing()
+        assert native.snapshot_json() == ""
