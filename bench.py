@@ -137,6 +137,12 @@ WORKER_TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", 480))
 # import error) must not be relaunched in a tight loop all window.
 MAX_MEASUREMENTS = int(os.environ.get("BENCH_ATTEMPTS", 5))
 RETRY_DELAY_S = float(os.environ.get("BENCH_RETRY_DELAY", 10))
+# Consecutive probe failures before the run declares the backend down
+# and emits a fast clean `skipped` record. Round-5 lesson inverted:
+# waiting out the window only pays when the backend has answered at
+# least once this run (a flap); a backend that NEVER answers gets a
+# typed skip in ~3 probe periods, not an 11-hour stale re-serve.
+PROBE_ATTEMPTS = _env_int("BENCH_PROBE_ATTEMPTS", 3)
 
 METRIC = "resnet50_train_images_per_sec_per_chip"
 
@@ -213,32 +219,28 @@ def _metric_name():
 def _probe_backend(timeout=None):
     """Compile-and-run a trivial jit in a fresh bounded process.
 
-    Returns (ok, diagnosis). A healthy backend answers in a few seconds
-    (first-compile overhead aside); a stalled tunnel hits the timeout
-    without ever returning — which must not take the harness down with
-    it, hence the subprocess.
+    Returns (ok, diagnosis). Thin wrapper over the shared
+    `runtime.probe_backend` (the same probe the graftwatch stall
+    handler runs, so bench and watchdog diagnose a dead tunnel with
+    identical words); this shim only adds the harness's concerns —
+    the BENCH_FORCE_CPU contract and registering the child with the
+    SIGTERM handler's `_INFLIGHT` slot so early termination kills it.
     """
     timeout = PROBE_TIMEOUT_S if timeout is None else timeout
-    # A site hook can pin JAX_PLATFORMS to the tunnel, so the CPU
-    # override (used by CI to test this harness end-to-end) must be an
-    # explicit config update, not an env var.
-    code = ("import os, jax; "
-            "os.environ.get('BENCH_FORCE_CPU') == '1' and "
-            "jax.config.update('jax_platforms', 'cpu'); "
-            "x = jax.jit(lambda v: v + 1)(1.0); x.block_until_ready(); "
-            "print('PROBE_OK', jax.default_backend(), len(jax.devices()))")
+
+    def register(proc):
+        global _INFLIGHT
+        _INFLIGHT = proc
+
     try:
-        proc = _bounded_run([sys.executable, "-c", code], timeout)
-    except subprocess.TimeoutExpired:
-        return False, "backend probe hung past {:.0f}s".format(timeout)
-    except OSError as e:
-        return False, "backend probe failed to launch: {}".format(e)
-    for line in proc.stdout.splitlines():
-        if line.startswith("PROBE_OK"):
-            return True, line.strip()
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-    return False, "backend init failed: {}".format(tail[-1] if tail else
-                                                   "rc={}".format(proc.returncode))
+        from cloud_tpu.parallel import runtime as _runtime
+    except Exception as e:  # partial checkout: diagnose, don't crash
+        return False, ("backend probe unavailable (cloud_tpu import "
+                       "failed: {})".format(e))
+    return _runtime.probe_backend(
+        deadline=timeout,
+        force_cpu=os.environ.get("BENCH_FORCE_CPU") == "1",
+        register=register)
 
 
 def _run_worker(timeout=None):
@@ -517,6 +519,29 @@ def _emit_fallback(last_err, extra=None):
     _print_record(record)
 
 
+def _emit_skipped(diagnosis, probes):
+    """The probe-failure exit: a fast, clean, typed skip.
+
+    Distinct from `_emit_fallback`'s stale re-serve on purpose: a
+    stale record answers "the measurement broke mid-run, serve the
+    last green" — but when the backend never answered a single probe
+    there IS no measurement to be stale about, and re-serving an old
+    green taught consumers to read numbers through an 11-hour outage
+    (the round-5 lesson). A skip says so in its own fields: value 0.0,
+    `skipped: true`, the probe diagnosis, never `stale`.
+    """
+    _print_record({
+        "metric": _metric_name(),
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+        "skipped": True,
+        "skip_reason": diagnosis,
+        "probes": probes,
+        "requested_config": _requested_config(),
+    })
+
+
 def main():
     start = time.monotonic()
 
@@ -525,6 +550,8 @@ def main():
 
     last_err = "no attempts made"
     probes = 0
+    probe_failures = 0  # consecutive, reset by any successful probe
+    backend_seen = False  # any probe answered this run
     measurements = 0
 
     # A driver whose outer `timeout` is SHORTER than BENCH_DEADLINE
@@ -542,9 +569,15 @@ def main():
             except OSError:
                 pass
         if not _EMITTED:
-            _emit_fallback(
-                last_err + " (terminated by outer timeout at "
-                "t+{:.0f}s)".format(time.monotonic() - start))
+            reason = (last_err + " (terminated by outer timeout at "
+                      "t+{:.0f}s)".format(time.monotonic() - start))
+            if probes and not backend_seen:
+                # The backend never answered a single probe: the honest
+                # record is a typed skip, not a stale re-serve of a
+                # green the outage had nothing to do with.
+                _emit_skipped(reason, probes)
+            else:
+                _emit_fallback(reason)
         os._exit(0)
 
     try:
@@ -584,10 +617,20 @@ def main():
             probes, time.monotonic() - start, diag), file=sys.stderr)
         if not ok:
             last_err = diag
+            probe_failures += 1
+            if not backend_seen and probe_failures >= PROBE_ATTEMPTS:
+                # The backend never answered this run: emit the typed
+                # skip NOW (fast, clean, never `stale`) instead of
+                # probing out the window. A backend that answered once
+                # is a flap — those keep the patient retry loop.
+                _emit_skipped(diag, probes)
+                return
             if remaining() <= 10:
                 break
             time.sleep(min(PROBE_INTERVAL_S, max(remaining() - 10, 0)))
             continue
+        backend_seen = True
+        probe_failures = 0
         if remaining() < 30:
             last_err = "backend healthy but <30s of budget left for " \
                        "measurement"
@@ -611,6 +654,11 @@ def main():
         # before re-probing so a deterministically-failing worker can't
         # spin the whole window.
         time.sleep(min(RETRY_DELAY_S, max(remaining() - 10, 0)))
+    if probes and not backend_seen:
+        # Same honesty as the PROBE_ATTEMPTS exit: the window closed
+        # with the backend never having answered — skip, don't stale.
+        _emit_skipped(last_err, probes)
+        return
     _emit_fallback(last_err, extra={
         "probes": probes, "measurement_attempts": measurements})
 

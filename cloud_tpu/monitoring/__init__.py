@@ -28,7 +28,8 @@ def __getattr__(name):
     # modules (telemetry/spans/export) stay unimported until someone
     # actually enables telemetry — the zero-cost-when-off discipline
     # starts at import time.
-    if name in ("profiler", "telemetry", "spans", "export"):
+    if name in ("profiler", "telemetry", "spans", "export", "watch",
+                "collect"):
         import importlib
         return importlib.import_module("cloud_tpu.monitoring." + name)
     raise AttributeError(name)

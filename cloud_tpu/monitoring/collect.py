@@ -1,0 +1,385 @@
+"""graftwatch collector: merge per-process telemetry into a fleet view.
+
+Each process of a multi-host job exports its OWN graftscope artifacts
+(`telemetry.jsonl` rollup lines, a Chrome `trace.json`) plus graftwatch
+liveness gauges — per-process truth, but the question a fleet operator
+asks is cross-host: which worker is the straggler, how far has
+step-time skewed, who stopped heartbeating, which log is torn. This
+CLI answers it offline, from files alone (rsync'd, gcsfuse'd, or
+artifact-downloaded — no live endpoints):
+
+    python -m cloud_tpu.monitoring.collect RUN_DIR... [--out DIR]
+
+Inputs: directories are scanned for `telemetry.jsonl` / `*.jsonl` job
+logs and `trace.json` traces (any depth); bare files work too. JSONL
+records are grouped by their (host, process_index) stamp — the
+utils/events identity contract — so N processes appending to N files
+OR to one shared file both collate correctly, and torn trailing lines
+(a crashed writer) are counted, not fatal.
+
+Outputs under --out:
+    fleet_report.json   per-process rollups + fleet verdict (skew,
+                        straggler, liveness, corrupt-line census)
+    trace.json          one merged Chrome trace: every process on its
+                        own labeled pid lane (Perfetto-ready)
+    fleet.prom          Prometheus textfile with {host=,process=}
+                        labels per series, plus fleet-level gauges
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+logger = logging.getLogger("cloud_tpu")
+
+__all__ = ["discover_inputs", "load_process_records", "merge_traces",
+           "fleet_report", "render_fleet_prometheus", "collect", "main"]
+
+STEP_HISTOGRAM = "cloud_tpu_step_latency_seconds"
+STEPS_PER_SEC = "cloud_tpu_steps_per_sec"
+
+_WATCH_GAUGES = (
+    "cloud_tpu_watch_alive",
+    "cloud_tpu_watch_heartbeat_age_seconds",
+    "cloud_tpu_watch_last_step_age_seconds",
+    "cloud_tpu_watch_last_step",
+)
+
+
+def discover_inputs(paths):
+    """Expands files/directories -> (jsonl_paths, trace_paths).
+
+    Directories are walked; `*.jsonl` files are telemetry/job logs,
+    `trace.json` (and `trace*.json`) files are Chrome traces. Bare
+    file arguments are classified the same way. Order is stable
+    (sorted within each directory) so lane assignment is
+    deterministic.
+    """
+    jsonl_paths, trace_paths = [], []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs.sort()
+                for name in sorted(files):
+                    full = os.path.join(root, name)
+                    if name.endswith(".jsonl"):
+                        jsonl_paths.append(full)
+                    elif (name == "trace.json"
+                          or (name.startswith("trace")
+                              and name.endswith(".json"))):
+                        trace_paths.append(full)
+        elif path.endswith(".jsonl"):
+            jsonl_paths.append(path)
+        elif path.endswith(".json"):
+            trace_paths.append(path)
+        else:
+            logger.warning("collect: skipping unrecognized input %s",
+                           path)
+    return jsonl_paths, trace_paths
+
+
+def _process_key(record):
+    """(host, process_index) identity of a JSONL record. Pre-PR-7
+    records carry no process stamp; they collapse onto index 0 of
+    their host (or "unknown") rather than being dropped."""
+    return (str(record.get("host", "unknown")),
+            int(record.get("process_index", 0) or 0))
+
+
+def load_process_records(jsonl_paths):
+    """Reads every JSONL input -> ({(host, index): [records]},
+    {path: corrupt_line_count}).
+
+    Records are grouped by writer identity, NOT by file: a shared log
+    with interleaved appenders and one-file-per-process layouts both
+    land in the same shape. Unreadable files are reported in the
+    corrupt census (count -1) instead of aborting the merge.
+    """
+    from cloud_tpu.utils import events
+
+    by_process = {}
+    corrupt = {}
+    for path in jsonl_paths:
+        try:
+            records, stats = events.read_job_events(path,
+                                                    with_stats=True)
+        except Exception as e:
+            logger.warning("collect: unreadable input %s (%s)", path, e)
+            corrupt[path] = -1
+            continue
+        if stats["corrupt_lines"]:
+            corrupt[path] = stats["corrupt_lines"]
+        for record in records:
+            by_process.setdefault(_process_key(record),
+                                  []).append(record)
+    return by_process, corrupt
+
+
+def _last_telemetry(records):
+    """The newest "telemetry" rollup in a record list (each flush line
+    supersedes the previous one — snapshots are cumulative)."""
+    last = None
+    for record in records:
+        if record.get("kind") == "telemetry":
+            last = record
+    return last
+
+
+def _process_rollup(key, records):
+    host, index = key
+    rollup = {
+        "host": host,
+        "process_index": index,
+        "events": len(records),
+        "event_kinds": sorted({str(r.get("kind")) for r in records}),
+    }
+    stalls = [r for r in records
+              if r.get("kind") == "graftwatch"
+              and isinstance(r.get("payload"), dict)
+              and r["payload"].get("event") == "stall"]
+    if stalls:
+        rollup["stalls"] = len(stalls)
+        rollup["last_stall"] = stalls[-1]["payload"]
+    telemetry = _last_telemetry(records)
+    if telemetry is None:
+        return rollup
+    payload = telemetry.get("payload") or {}
+    gauges = payload.get("gauges") or {}
+    counters = payload.get("counters") or {}
+    histograms = payload.get("histograms") or {}
+    step = histograms.get(STEP_HISTOGRAM) or {}
+    rollup["steps_per_sec"] = gauges.get(STEPS_PER_SEC)
+    rollup["step_latency"] = {
+        "count": step.get("count", 0),
+        "p50": step.get("p50"),
+        "p95": step.get("p95"),
+        "p99": step.get("p99"),
+    }
+    rollup["steps_total"] = counters.get("cloud_tpu_training_steps_total")
+    rollup["compiles_total"] = counters.get("cloud_tpu_compiles_total")
+    watch = {name: gauges[name] for name in _WATCH_GAUGES
+             if name in gauges}
+    if watch:
+        rollup["watch"] = watch
+    return rollup
+
+
+def fleet_report(by_process, corrupt=None):
+    """Per-process rollups + the fleet verdict.
+
+    Skew is (max p50 − min p50) / min p50 over processes that reported
+    a step-latency histogram; the straggler is the max-p50 process
+    (falling back to min steps/sec when no latencies exist). A process
+    whose watch gauges report alive=0 — or that logged a graftwatch
+    stall event — is listed dead regardless of its throughput numbers.
+    """
+    processes = {}
+    for key in sorted(by_process):
+        rollup = _process_rollup(key, by_process[key])
+        processes["{}/p{}".format(*key)] = rollup
+
+    with_p50 = {name: r["step_latency"]["p50"]
+                for name, r in processes.items()
+                if r.get("step_latency", {}).get("p50")}
+    fleet = {"process_count": len(processes)}
+    if with_p50:
+        slowest = max(with_p50, key=with_p50.get)
+        fastest = min(with_p50, key=with_p50.get)
+        low, high = with_p50[fastest], with_p50[slowest]
+        fleet["step_p50_min_seconds"] = low
+        fleet["step_p50_max_seconds"] = high
+        fleet["step_p50_skew_pct"] = (100.0 * (high - low) / low
+                                      if low > 0 else 0.0)
+        fleet["straggler"] = slowest
+        fleet["fastest"] = fastest
+    else:
+        with_rate = {name: r["steps_per_sec"]
+                     for name, r in processes.items()
+                     if r.get("steps_per_sec")}
+        if with_rate:
+            fleet["straggler"] = min(with_rate, key=with_rate.get)
+    dead = sorted(
+        name for name, r in processes.items()
+        if r.get("stalls")
+        or (r.get("watch", {}).get("cloud_tpu_watch_alive") == 0.0))
+    if dead:
+        fleet["dead"] = dead
+    report = {"format": "cloud_tpu.fleet_report.v1",
+              "processes": processes, "fleet": fleet}
+    if corrupt:
+        report["corrupt_inputs"] = dict(corrupt)
+    return report
+
+
+def _trace_label(trace, fallback):
+    """The process label an input trace declared for itself (the
+    spans.py process_name metadata), else `fallback`."""
+    for event in trace.get("traceEvents", ()):
+        if event.get("ph") == "M" and event.get("name") == "process_name":
+            name = (event.get("args") or {}).get("name")
+            if name:
+                return str(name)
+    return fallback
+
+
+def merge_traces(trace_paths):
+    """Merges per-process Chrome traces into one multi-lane trace.
+
+    Every input is re-stamped onto its own pid lane (dense ints in
+    input order) — two hosts that both exported process_index 0 must
+    not collide — old process metadata is dropped, and fresh
+    process_name/process_sort_index metadata labels each lane with the
+    name the input declared for itself. Unparseable inputs are skipped
+    with a warning (one corrupt rsync'd file must not kill the fleet
+    view).
+    """
+    merged = []
+    lanes = []
+    lane = 0
+    for path in trace_paths:
+        try:
+            with open(path) as f:
+                trace = json.load(f)
+        except (OSError, ValueError) as e:
+            logger.warning("collect: unreadable trace %s (%s)", path, e)
+            continue
+        label = _trace_label(
+            trace, os.path.basename(os.path.dirname(path)) or path)
+        lanes.append({"pid": lane, "label": label, "path": path})
+        merged.append({"ph": "M", "pid": lane, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": label}})
+        merged.append({"ph": "M", "pid": lane, "tid": 0,
+                       "name": "process_sort_index",
+                       "args": {"sort_index": lane}})
+        for event in trace.get("traceEvents", ()):
+            if (event.get("ph") == "M"
+                    and event.get("name") in ("process_name",
+                                              "process_sort_index")):
+                continue
+            event = dict(event)
+            event["pid"] = lane
+            merged.append(event)
+        lane += 1
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "metadata": {"lanes": lanes}}, lanes
+
+
+def _prom_number(value):
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    return repr(float(value))
+
+
+def render_fleet_prometheus(report):
+    """The fleet report as Prometheus textfile lines with
+    {host=,process=} labels per series (the single-registry renderer
+    in export.py has no label support — fleet exposition hand-writes
+    them) plus fleet-level summary gauges."""
+    lines = []
+
+    def emit(name, labels, value):
+        if value is None:
+            return
+        if labels:
+            body = ",".join('{}="{}"'.format(k, v)
+                            for k, v in labels.items())
+            lines.append("{}{{{}}} {}".format(name, body,
+                                              _prom_number(value)))
+        else:
+            lines.append("{} {}".format(name, _prom_number(value)))
+
+    for name in sorted(report["processes"]):
+        rollup = report["processes"][name]
+        labels = {"host": rollup["host"],
+                  "process": str(rollup["process_index"])}
+        emit("cloud_tpu_fleet_steps_per_sec", labels,
+             rollup.get("steps_per_sec"))
+        step = rollup.get("step_latency") or {}
+        for quantile in ("p50", "p95", "p99"):
+            emit("cloud_tpu_fleet_step_latency_seconds_" + quantile,
+                 labels, step.get(quantile))
+        for gauge in _WATCH_GAUGES:
+            emit("cloud_tpu_fleet_" + gauge[len("cloud_tpu_"):],
+                 labels, rollup.get("watch", {}).get(gauge))
+        emit("cloud_tpu_fleet_stalls_total", labels,
+             rollup.get("stalls", 0))
+    fleet = report["fleet"]
+    emit("cloud_tpu_fleet_process_count", None, fleet["process_count"])
+    emit("cloud_tpu_fleet_step_p50_skew_pct", None,
+         fleet.get("step_p50_skew_pct"))
+    emit("cloud_tpu_fleet_dead_processes", None,
+         len(fleet.get("dead", ())))
+    corrupt = report.get("corrupt_inputs") or {}
+    emit("cloud_tpu_fleet_corrupt_inputs", None, len(corrupt))
+    return "\n".join(lines) + "\n"
+
+
+def collect(inputs, out_dir):
+    """The full pass: discover -> group -> report -> merge -> write.
+    Returns the fleet report dict (with an extra "outputs" section
+    naming what was written)."""
+    jsonl_paths, trace_paths = discover_inputs(inputs)
+    by_process, corrupt = load_process_records(jsonl_paths)
+    report = fleet_report(by_process, corrupt)
+    os.makedirs(out_dir, exist_ok=True)
+    outputs = {}
+
+    report_path = os.path.join(out_dir, "fleet_report.json")
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    outputs["report"] = report_path
+
+    if trace_paths:
+        trace, lanes = merge_traces(trace_paths)
+        trace_path = os.path.join(out_dir, "trace.json")
+        with open(trace_path, "w") as f:
+            json.dump(trace, f)
+        outputs["trace"] = trace_path
+        outputs["lanes"] = len(lanes)
+
+    prom_path = os.path.join(out_dir, "fleet.prom")
+    with open(prom_path, "w") as f:
+        f.write(render_fleet_prometheus(report))
+    outputs["prom"] = prom_path
+
+    report["outputs"] = outputs
+    return report
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m cloud_tpu.monitoring.collect",
+        description="Merge per-process cloud_tpu telemetry into one "
+                    "fleet report + multi-lane trace.")
+    parser.add_argument("inputs", nargs="+",
+                        help="telemetry directories, *.jsonl logs, or "
+                             "trace.json files")
+    parser.add_argument("--out", default="fleet",
+                        help="output directory (default ./fleet)")
+    args = parser.parse_args(argv)
+    report = collect(args.inputs, args.out)
+    fleet = report["fleet"]
+    print("fleet: {} process(es)".format(fleet["process_count"]))
+    if "step_p50_skew_pct" in fleet:
+        print("step p50 skew: {:.1f}% (straggler: {})".format(
+            fleet["step_p50_skew_pct"], fleet["straggler"]))
+    for name in fleet.get("dead", ()):
+        print("DEAD: {}".format(name))
+    for path, count in sorted(
+            (report.get("corrupt_inputs") or {}).items()):
+        print("torn input: {} ({} corrupt line(s))".format(
+            path, "unreadable" if count < 0 else count))
+    for key in ("report", "trace", "prom"):
+        if key in report["outputs"]:
+            print("wrote {}".format(report["outputs"][key]))
+    return 0 if fleet["process_count"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,9 @@ telemetry smoke, and the telemetry histograms all key on them):
 """
 
 import json
+import os
+import socket
+import sys
 import threading
 import time
 
@@ -57,6 +60,33 @@ class _NoopSpan:
 
 
 _NOOP = _NoopSpan()
+
+
+def _process_identity():
+    """This process's (index, label) for trace metadata.
+
+    Index comes from the CLOUD_TPU_PROCESS_ID env contract first, then
+    from a jax that is ALREADY imported (`sys.modules.get` — this
+    module stays stdlib-only and must never pull jax in), else 0. The
+    label is what Perfetto shows on the process lane.
+    """
+    index = 0
+    value = os.environ.get("CLOUD_TPU_PROCESS_ID")
+    if value is not None:
+        try:
+            index = int(value)
+        except ValueError:
+            index = 0
+    else:
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                index = int(jax.process_index())
+            except Exception:
+                index = 0
+    label = "{}/p{} (pid {})".format(
+        socket.gethostname(), index, os.getpid())
+    return index, label
 
 
 class _Span:
@@ -142,23 +172,35 @@ class SpanTracer:
         Complete events ("ph":"X", microsecond ts/dur) on per-thread
         tracks; Perfetto nests them by time containment. Thread names
         ride as metadata events so tracks read "cloud-tpu-metric-
-        reader" instead of a bare tid.
+        reader" instead of a bare tid. The pid is this PROCESS's index
+        (CLOUD_TPU_PROCESS_ID / jax.process_index, not a hardcoded 1),
+        with process_name/process_sort_index metadata naming the lane
+        "host/pN (pid OSPID)" — so per-host traces merged by the fleet
+        collector land on distinct, labeled lanes instead of colliding.
         """
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
             epoch = self._epoch_ns
         names = {t.ident: t.name for t in threading.enumerate()}
-        trace_events = []
+        process_index, process_label = _process_identity()
+        trace_events = [
+            {"ph": "M", "pid": process_index, "tid": 0,
+             "name": "process_name",
+             "args": {"name": process_label}},
+            {"ph": "M", "pid": process_index, "tid": 0,
+             "name": "process_sort_index",
+             "args": {"sort_index": process_index}},
+        ]
         for tid in sorted({tid for _, tid, _, _ in events}):
             trace_events.append({
-                "ph": "M", "pid": 1, "tid": tid,
+                "ph": "M", "pid": process_index, "tid": tid,
                 "name": "thread_name",
                 "args": {"name": names.get(tid, "thread-{}".format(tid))},
             })
         for name, tid, t0_ns, dur_ns in events:
             trace_events.append({
-                "ph": "X", "pid": 1, "tid": tid, "name": name,
+                "ph": "X", "pid": process_index, "tid": tid, "name": name,
                 "ts": (t0_ns - epoch) / 1e3,
                 "dur": dur_ns / 1e3,
             })

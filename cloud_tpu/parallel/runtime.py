@@ -317,6 +317,96 @@ def _env_int(name):
     return int(value) if value is not None else None
 
 
+class BackendUnavailable(RuntimeError):
+    """The accelerator backend stopped answering.
+
+    The typed failure ROADMAP item 4 asks for: a dispatch hang, a dead
+    tunnel, or a failed device probe becomes THIS within a bounded
+    deadline — not a 30-minute outer timeout with no artifact. Raised
+    by `probe-driven` callers (graftwatch's stall handler, bench.py's
+    probe loop consumers); carries the probe diagnosis, the deadline
+    that was exceeded, and the flight-recorder path when one was
+    written.
+    """
+
+    def __init__(self, message="accelerator backend unavailable",
+                 diagnosis=None, deadline=None, blackbox=None):
+        super().__init__(message)
+        self.diagnosis = diagnosis
+        self.deadline = deadline
+        self.blackbox = blackbox
+
+
+#: Default probe bound, seconds: a healthy backend answers a 1-op jit
+#: in a few seconds (cold import included); a stalled tunnel eats the
+#: whole bound without returning.
+PROBE_DEADLINE_S = 60.0
+
+
+def probe_backend(deadline=None, force_cpu=False, register=None):
+    """Compile-and-run a trivial jit in a fresh deadline-bounded process.
+
+    Hoisted out of bench.py (round-5 lesson: the harness's private
+    probe was the only deadline-bounded device check in the tree) so
+    the Trainer's watchdog, bench.py, and future elastic-training retry
+    policies share ONE probe. Returns (ok, diagnosis) — it never
+    raises and never hangs past `deadline`: a backend whose init or
+    dispatch stalls takes the CHILD process down, not the caller.
+
+    Args:
+        deadline: Seconds before the child is killed (default: the
+            CLOUD_TPU_PROBE_DEADLINE env var, then PROBE_DEADLINE_S).
+        force_cpu: Probe the CPU backend via an explicit in-child
+            config update (a site hook can pin JAX_PLATFORMS to the
+            tunnel, so the override must not be an env var the hook
+            would fight).
+        register: Optional callable receiving the spawned Popen (then
+            None once reaped) — bench.py's SIGTERM handler uses it so
+            an orphaned probe dies with the harness.
+    """
+    import subprocess
+    import sys as _sys
+
+    if deadline is None:
+        try:
+            deadline = float(os.environ.get("CLOUD_TPU_PROBE_DEADLINE",
+                                            PROBE_DEADLINE_S))
+        except ValueError:
+            deadline = PROBE_DEADLINE_S
+    env = dict(os.environ)
+    if force_cpu:
+        env["CLOUD_TPU_PROBE_CPU"] = "1"
+    code = ("import os, jax; "
+            "os.environ.get('CLOUD_TPU_PROBE_CPU') == '1' and "
+            "jax.config.update('jax_platforms', 'cpu'); "
+            "x = jax.jit(lambda v: v + 1)(1.0); x.block_until_ready(); "
+            "print('PROBE_OK', jax.default_backend(), len(jax.devices()))")
+    try:
+        proc = subprocess.Popen([_sys.executable, "-c", code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True,
+                                env=env)
+    except OSError as e:
+        return False, "backend probe failed to launch: {}".format(e)
+    if register is not None:
+        register(proc)
+    try:
+        stdout, stderr = proc.communicate(timeout=deadline)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        return False, "backend probe hung past {:g}s".format(deadline)
+    finally:
+        if register is not None:
+            register(None)
+    for line in stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            return True, line.strip()
+    tail = (stderr or stdout or "").strip().splitlines()
+    return False, "backend init failed: {}".format(
+        tail[-1] if tail else "rc={}".format(proc.returncode))
+
+
 def is_initialized():
     return _context is not None
 

@@ -33,6 +33,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from cloud_tpu.monitoring import spans as spans_lib
+from cloud_tpu.monitoring import watch as watch_lib
 from cloud_tpu.parallel import runtime
 from cloud_tpu.parallel import sharding as sharding_lib
 from cloud_tpu.training import async_logs as async_logs_lib
@@ -80,6 +81,31 @@ def _env_telemetry(method):
             return method(self, *args, **kwargs)
         from cloud_tpu.monitoring import telemetry
         with telemetry.env_scope():
+            return method(self, *args, **kwargs)
+    return wrapper
+
+
+def _env_watched(method):
+    """Runs a Trainer entry point under a graftwatch watchdog scope.
+
+    `CLOUD_TPU_WATCH=1` installs the heartbeat watchdog
+    (cloud_tpu.monitoring.watch): the step loop beats it, a monitor
+    thread converts a stall past CLOUD_TPU_WATCH_DEADLINE into a typed
+    `runtime.BackendUnavailable` plus a `blackbox.json` flight
+    recorder, and liveness gauges ride the telemetry registry when one
+    is active. Unset, the wrapper is a plain delegation — no import,
+    no thread, no hook (the graftsan zero-cost discipline, test-
+    pinned). Stacked OUTERMOST so a stall inside the telemetry scope
+    still flushes artifacts on the way out, and so the crash blackbox
+    sees the sanitizer/telemetry state before their teardown. A nested
+    entry point (fit's validation evaluate) rides the outer watchdog.
+    """
+    @functools.wraps(method)
+    def wrapper(self, *args, **kwargs):
+        if not os.environ.get("CLOUD_TPU_WATCH"):
+            return method(self, *args, **kwargs)
+        from cloud_tpu.monitoring import watch
+        with watch.env_scope():
             return method(self, *args, **kwargs)
     return wrapper
 
@@ -1508,6 +1534,7 @@ class Trainer:
 
     # -- public API -----------------------------------------------------
 
+    @_env_watched
     @_env_telemetry
     @_env_sanitized
     def fit(self,
@@ -1973,6 +2000,9 @@ class Trainer:
                             "fn(outputs, y, mask=...) or return "
                             "per-example values.".format(
                                 sorted(self._train_scalar_unmasked)))
+                    # graftwatch: one completed dispatch = one beat
+                    # (one global load + None check when unwatched).
+                    watch_lib.notify_step()
                     first = False
                 spans_lib.end(step_section)
                 if not (self._abort_epoch and count == 0):
@@ -2049,6 +2079,8 @@ class Trainer:
                 # device step); convert once per epoch below.
                 step_logs.append(logs)
                 count += 1
+                # graftwatch: one completed dispatch = one beat.
+                watch_lib.notify_step()
             spans_lib.end(step_section)
             if not (self._abort_epoch and count == 0):
                 # Same zero-step-abort guard as the multi-step path.
@@ -2180,6 +2212,8 @@ class Trainer:
                         "per-example values.".format(
                             sorted(set().union(*scalar_sets))))
                 count += n_steps
+                # graftwatch: one completed dispatch = one beat.
+                watch_lib.notify_step()
             spans_lib.end(step_section)
             if not (self._abort_epoch and count == 0):
                 self._post_epoch_logs(step_logs, count,
@@ -2209,6 +2243,10 @@ class Trainer:
         # verbose printing) are sanctioned here — relabel the thread so
         # graftsan doesn't count them against the step loop.
         runtime.set_phase("boundary")
+        # graftwatch: boundary host work (validation, checkpoint, the
+        # coalesced fetch) is progress too — beat so a long validation
+        # pass isn't mistaken for a stalled step loop.
+        watch_lib.heartbeat()
         # graftscope: the boundary host work (aggregation, validation,
         # callbacks, sentinel) is one "boundary" span, ended right
         # before the method returns.
@@ -2419,6 +2457,7 @@ class Trainer:
                                             step=step)
         return self.state
 
+    @_env_watched
     @_env_telemetry
     @_env_sanitized
     def evaluate(self, x, y=None, batch_size=32, verbose=True,
@@ -2526,6 +2565,9 @@ class Trainer:
         totals, weight = {}, 0.0
         for agg, padded, fed in feeder:
             logs = dict(self._jit_eval_step(eval_state, fed))
+            # graftwatch: an eval batch is liveness (but not a train
+            # step — it beats without advancing the step census).
+            watch_lib.heartbeat()
             batch_w = logs.pop("_batch_weight")
             if weighted_eval:
                 # The host-side `agg` summed only this process's local

@@ -27,6 +27,7 @@ import logging
 import os
 import socket
 import struct
+import sys
 import time
 
 from cloud_tpu.utils import storage
@@ -158,6 +159,26 @@ class EventFileWriter:
 # -- Structured job events (JSONL side channel) -------------------------
 
 
+def _process_index():
+    """This process's index in a multi-process job: the
+    CLOUD_TPU_PROCESS_ID env contract first, a jax that is ALREADY
+    imported second (`sys.modules.get` — logging an event must never
+    pull jax in), else 0."""
+    value = os.environ.get("CLOUD_TPU_PROCESS_ID")
+    if value is not None:
+        try:
+            return int(value)
+        except ValueError:
+            return 0
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            return 0
+    return 0
+
+
 def log_job_event(kind, payload, path=None):
     """Appends one structured job event as a JSONL line.
 
@@ -170,25 +191,36 @@ def log_job_event(kind, payload, path=None):
     unconditionally. Local and gs:// paths both work (appends ride
     `storage.append_bytes`).
 
+    Every record carries the writer's identity and both clocks: host +
+    pid + process_index so the fleet collector can tell two workers'
+    events apart (they used to be indistinguishable), wall time for
+    humans, and a monotonic stamp for intra-process ordering/ages that
+    survives NTP steps.
+
     Returns the path written to, or None when logging is disabled.
     """
     path = path or os.environ.get("CLOUD_TPU_EVENT_LOG")
     if not path:
         return None
-    record = {"time": time.time(), "host": socket.gethostname(),
+    record = {"time": time.time(), "monotonic": time.monotonic(),
+              "host": socket.gethostname(), "pid": os.getpid(),
+              "process_index": _process_index(),
               "kind": kind, "payload": payload}
     storage.append_bytes(
         path, (json.dumps(record, sort_keys=True) + "\n").encode("utf-8"))
     return path
 
 
-def read_job_events(path):
+def read_job_events(path, with_stats=False):
     """Parses a JSONL job-event file -> list of dicts.
 
     Skips blanks AND corrupt/partial lines (a writer that crashed
     mid-append, or two unsynchronized appenders interleaving) with one
     warning for the whole file — a single torn line must not poison
-    every later reader of an otherwise-healthy log.
+    every later reader of an otherwise-healthy log. With
+    `with_stats=True` returns (records, {"corrupt_lines": n}) so the
+    fleet collector can report torn files instead of silently eating
+    them.
     """
     data = storage.read_bytes(path).decode("utf-8", errors="replace")
     records = []
@@ -205,6 +237,8 @@ def read_job_events(path):
             "read_job_events: skipped %d corrupt/partial JSON line(s) "
             "in %s (crashed writer?); returning the %d parseable "
             "record(s).", corrupt, path, len(records))
+    if with_stats:
+        return records, {"corrupt_lines": corrupt}
     return records
 
 

@@ -1,0 +1,193 @@
+"""Fleet collector: per-process grouping, skew/straggler, trace merge.
+
+The PR 7 acceptance contract: `collect` merges >=2 per-process
+telemetry sets into one fleet report with per-host step-time skew, the
+merged trace.json shows distinct per-process lanes, and a torn file
+(crashed writer) is counted — never fatal, never silently eaten.
+"""
+
+import json
+import os
+
+import pytest
+
+from cloud_tpu.monitoring import collect
+from cloud_tpu.utils import events
+
+
+def _fabricate(root, index, host, p50, steps_per_sec, alive=1.0,
+               torn=False, monkeypatch=None):
+    """One process's telemetry dir: a telemetry.jsonl written through
+    the REAL log_job_event (so the identity stamps are the production
+    ones) plus a per-process trace.json."""
+    directory = os.path.join(str(root), "proc{}".format(index))
+    os.makedirs(directory)
+    path = os.path.join(directory, "telemetry.jsonl")
+    monkeypatch.setenv("CLOUD_TPU_PROCESS_ID", str(index))
+    import socket
+    monkeypatch.setattr(socket, "gethostname", lambda: host)
+    events.log_job_event("telemetry", {
+        "counters": {"cloud_tpu_training_steps_total": 100,
+                     "cloud_tpu_compiles_total": 4},
+        "gauges": {"cloud_tpu_steps_per_sec": steps_per_sec,
+                   "cloud_tpu_watch_alive": alive},
+        "histograms": {"cloud_tpu_step_latency_seconds": {
+            "count": 100, "sum": p50 * 100,
+            "p50": p50, "p95": p50 * 2, "p99": p50 * 3}},
+    }, path=path)
+    if torn:
+        with open(path, "a") as f:
+            f.write('{"kind": "telemetry", "payl')
+    trace = {"traceEvents": [
+        {"ph": "M", "pid": index, "tid": 0, "name": "process_name",
+         "args": {"name": "{}/p{}".format(host, index)}},
+        {"ph": "M", "pid": index, "tid": 0,
+         "name": "process_sort_index", "args": {"sort_index": index}},
+        {"ph": "M", "pid": index, "tid": 7, "name": "thread_name",
+         "args": {"name": "MainThread"}},
+        {"ph": "X", "pid": index, "tid": 7, "name": "train_step",
+         "ts": 0.0, "dur": p50 * 1e6},
+    ], "displayTimeUnit": "ms"}
+    with open(os.path.join(directory, "trace.json"), "w") as f:
+        json.dump(trace, f)
+    return directory
+
+
+@pytest.fixture()
+def fleet_dirs(tmp_path, monkeypatch):
+    """Three fabricated processes: a fast one, a straggler whose log
+    has a torn trailing line, and a dead one (watch alive=0)."""
+    dirs = [
+        _fabricate(tmp_path, 0, "hostA", 0.010, 100.0,
+                   monkeypatch=monkeypatch),
+        _fabricate(tmp_path, 1, "hostB", 0.013, 77.0, torn=True,
+                   monkeypatch=monkeypatch),
+        _fabricate(tmp_path, 2, "hostC", 0.010, 99.0, alive=0.0,
+                   monkeypatch=monkeypatch),
+    ]
+    monkeypatch.delenv("CLOUD_TPU_PROCESS_ID", raising=False)
+    return dirs
+
+
+class TestFleetReport:
+    def test_merges_three_processes_with_skew_and_straggler(
+            self, fleet_dirs, tmp_path):
+        out = str(tmp_path / "fleet")
+        report = collect.collect(fleet_dirs, out)
+        assert report["fleet"]["process_count"] == 3
+        assert set(report["processes"]) == {
+            "hostA/p0", "hostB/p1", "hostC/p2"}
+        # (13ms - 10ms) / 10ms = 30% skew; hostB is the straggler.
+        assert report["fleet"]["step_p50_skew_pct"] == pytest.approx(
+            30.0)
+        assert report["fleet"]["straggler"] == "hostB/p1"
+        assert report["fleet"]["fastest"] in ("hostA/p0", "hostC/p2")
+
+    def test_dead_process_listed_regardless_of_throughput(
+            self, fleet_dirs, tmp_path):
+        report = collect.collect(fleet_dirs, str(tmp_path / "fleet"))
+        assert report["fleet"]["dead"] == ["hostC/p2"]
+
+    def test_torn_file_counted_not_fatal(self, fleet_dirs, tmp_path):
+        report = collect.collect(fleet_dirs, str(tmp_path / "fleet"))
+        ((path, count),) = report["corrupt_inputs"].items()
+        assert path.endswith("proc1/telemetry.jsonl")
+        assert count == 1
+        # The torn process still contributed its parseable record.
+        assert "hostB/p1" in report["processes"]
+
+    def test_per_process_rollup_fields(self, fleet_dirs, tmp_path):
+        report = collect.collect(fleet_dirs, str(tmp_path / "fleet"))
+        rollup = report["processes"]["hostA/p0"]
+        assert rollup["steps_per_sec"] == pytest.approx(100.0)
+        assert rollup["step_latency"]["p50"] == pytest.approx(0.010)
+        assert rollup["steps_total"] == 100
+        assert rollup["compiles_total"] == 4
+        assert rollup["watch"]["cloud_tpu_watch_alive"] == 1.0
+
+    def test_shared_log_groups_by_identity_not_file(self, tmp_path,
+                                                    monkeypatch):
+        """N processes appending to ONE shared file collate exactly
+        like one-file-per-process (the identity stamp is the key)."""
+        import socket
+        path = str(tmp_path / "shared.jsonl")
+        for index in range(2):
+            monkeypatch.setenv("CLOUD_TPU_PROCESS_ID", str(index))
+            monkeypatch.setattr(socket, "gethostname",
+                                lambda: "sharedhost")
+            events.log_job_event("telemetry", {
+                "gauges": {"cloud_tpu_steps_per_sec": 50.0 + index},
+            }, path=path)
+        by_process, corrupt = collect.load_process_records([path])
+        assert set(by_process) == {("sharedhost", 0),
+                                   ("sharedhost", 1)}
+        assert not corrupt
+
+    def test_outputs_written(self, fleet_dirs, tmp_path):
+        out = str(tmp_path / "fleet")
+        report = collect.collect(fleet_dirs, out)
+        assert os.path.exists(report["outputs"]["report"])
+        assert os.path.exists(report["outputs"]["prom"])
+        prom = open(report["outputs"]["prom"]).read()
+        assert ('cloud_tpu_fleet_steps_per_sec{host="hostB",'
+                'process="1"} 77.0') in prom
+        assert "cloud_tpu_fleet_step_p50_skew_pct" in prom
+        assert "cloud_tpu_fleet_dead_processes 1" in prom
+
+
+class TestTraceMerge:
+    def test_distinct_labeled_lanes(self, fleet_dirs, tmp_path):
+        out = str(tmp_path / "fleet")
+        report = collect.collect(fleet_dirs, out)
+        trace = json.load(open(report["outputs"]["trace"]))
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert {e["pid"] for e in xs} == {0, 1, 2}
+        names = [e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert names == ["hostA/p0", "hostB/p1", "hostC/p2"]
+
+    def test_colliding_input_pids_get_distinct_lanes(self, tmp_path):
+        """Two hosts that both exported process_index 0 (the exact
+        collision the spans.py pid fix is about when files are merged
+        without re-stamping) must land on different lanes."""
+        paths = []
+        for i, host in enumerate(("alpha", "beta")):
+            path = str(tmp_path / "trace{}.json".format(i))
+            json.dump({"traceEvents": [
+                {"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
+                 "args": {"name": "{}/p0".format(host)}},
+                {"ph": "X", "pid": 0, "tid": 1, "name": "train_step",
+                 "ts": 0.0, "dur": 5.0}]}, open(path, "w"))
+            paths.append(path)
+        merged, lanes = collect.merge_traces(paths)
+        xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+        assert {e["pid"] for e in xs} == {0, 1}
+        assert [lane["label"] for lane in lanes] == ["alpha/p0",
+                                                     "beta/p0"]
+
+    def test_unreadable_trace_skipped(self, tmp_path):
+        good = str(tmp_path / "trace_good.json")
+        json.dump({"traceEvents": []}, open(good, "w"))
+        bad = str(tmp_path / "trace_bad.json")
+        open(bad, "w").write("{not json")
+        merged, lanes = collect.merge_traces([bad, good])
+        assert len(lanes) == 1
+
+
+class TestCLI:
+    def test_main_end_to_end(self, fleet_dirs, tmp_path, capsys):
+        out = str(tmp_path / "fleet")
+        rc = collect.main(fleet_dirs + ["--out", out])
+        assert rc == 0
+        stdout = capsys.readouterr().out
+        assert "3 process(es)" in stdout
+        assert "straggler: hostB/p1" in stdout
+        assert "DEAD: hostC/p2" in stdout
+        assert "torn input" in stdout
+
+    def test_main_empty_inputs_exit_code(self, tmp_path):
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        rc = collect.main([str(empty), "--out",
+                           str(tmp_path / "fleet")])
+        assert rc == 1

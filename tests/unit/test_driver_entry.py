@@ -147,11 +147,12 @@ class BenchJsonContractTest(unittest.TestCase):
             cwd=REPO_ROOT)
         return self._extract_single_json(proc.stdout, proc.stderr)
 
-    def test_unreachable_backend_emits_error_json(self):
+    def test_unreachable_backend_emits_clean_skip_json(self):
         # A probe that can never finish in 0.2s + a 3s overall budget:
-        # the full-window probe loop must still exit with the error
-        # JSON. The last-green cache is pointed at a nonexistent path
-        # so the committed seed record doesn't satisfy the fallback.
+        # the backend never answers, so the record is a typed skip
+        # (skipped + skip_reason), emitted fast — not an error after
+        # probing out the window. The last-green cache is pointed at a
+        # nonexistent path so the committed seed record can't leak in.
         record = self._run_bench({
             "BENCH_PROBE_TIMEOUT": "0.2",
             "BENCH_PROBE_INTERVAL": "0.1",
@@ -161,13 +162,20 @@ class BenchJsonContractTest(unittest.TestCase):
         })
         self.assertEqual(record["value"], 0.0)
         self.assertEqual(record["vs_baseline"], 0.0)
-        self.assertIn("error", record)
+        self.assertTrue(record["skipped"])
+        self.assertIn("skip_reason", record)
+        self.assertGreaterEqual(record["probes"], 1)
+        self.assertNotIn("stale", record)
         self.assertEqual(record["metric"],
                          "resnet50_train_images_per_sec_per_chip")
 
-    def test_unreachable_backend_serves_stale_green(self):
-        # With a cached green TPU record, persistent tunnel failure
-        # emits that record marked stale instead of an empty error.
+    def test_unreachable_backend_never_serves_stale_green(self):
+        # Round-5 regression, inverted on purpose: a backend that never
+        # answered a single probe has nothing to do with the cached
+        # green record, so the harness must NOT re-serve it stale — the
+        # honest record is the typed skip. (A backend that answered
+        # once and then flapped still gets the stale re-serve; that
+        # path is pinned in test_bench_harness.py.)
         cache = os.path.join(tempfile.mkdtemp(), "last_green.json")
         green = {"metric": "resnet50_train_images_per_sec_per_chip",
                  "value": 1234.5, "unit": "images/sec",
@@ -180,14 +188,16 @@ class BenchJsonContractTest(unittest.TestCase):
             "BENCH_DEADLINE": "3",
             "BENCH_LAST_GREEN": cache,
         })
-        self.assertEqual(record["value"], 1234.5)
-        self.assertTrue(record["stale"])
-        self.assertIn("stale_reason", record)
+        self.assertEqual(record["value"], 0.0)
+        self.assertTrue(record["skipped"])
+        self.assertNotIn("stale", record)
 
     def test_outer_timeout_sigterm_still_emits_json(self):
         # A driver whose outer timeout is shorter than BENCH_DEADLINE
-        # SIGTERMs the process; the harness must still print its
-        # fallback JSON (and kill any in-flight child) before dying.
+        # SIGTERMs the process; the harness must still print exactly
+        # one JSON record (and kill any in-flight child) before dying.
+        # The backend never answered, so that record is the typed skip
+        # naming the termination — not a stale re-serve.
         import signal
         import time as time_mod
 
@@ -212,7 +222,8 @@ class BenchJsonContractTest(unittest.TestCase):
                 proc.kill()
         record = self._extract_single_json(stdout, stderr)
         self.assertEqual(record["value"], 0.0)
-        self.assertIn("terminated by outer timeout", record["error"])
+        reason = record.get("skip_reason") or record.get("error", "")
+        self.assertIn("terminated by outer timeout", reason)
 
 
 if __name__ == "__main__":

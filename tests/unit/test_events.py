@@ -148,3 +148,57 @@ class TestJobEventLog:
             assert len(events.read_job_events(path)) == 1
         assert not [r for r in caplog.records
                     if "corrupt" in r.getMessage()]
+
+    def test_read_with_stats_counts_torn_lines(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        events.log_job_event("a", {"i": 0}, path=path)
+        with open(path, "a") as f:
+            f.write('{"kind": "torn", "payl\n')
+        records, stats = events.read_job_events(path, with_stats=True)
+        assert [r["kind"] for r in records] == ["a"]
+        assert stats == {"corrupt_lines": 1}
+
+
+class TestJobEventStamps:
+    """PR 7 identity contract: every record says WHO wrote it (host +
+    pid + process_index) and WHEN on both clocks — the fleet collector
+    groups on these, and two workers' events were indistinguishable
+    without them."""
+
+    def test_record_carries_identity_and_both_clocks(self, tmp_path,
+                                                     monkeypatch):
+        import os
+        import socket
+
+        monkeypatch.delenv("CLOUD_TPU_PROCESS_ID", raising=False)
+        path = str(tmp_path / "events.jsonl")
+        events.log_job_event("k", {"a": 1}, path=path)
+        (record,) = events.read_job_events(path)
+        assert record["host"] == socket.gethostname()
+        assert record["pid"] == os.getpid()
+        assert record["process_index"] == 0
+        assert record["time"] > 0
+        assert record["monotonic"] > 0
+
+    def test_process_index_honors_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_PROCESS_ID", "3")
+        path = str(tmp_path / "events.jsonl")
+        events.log_job_event("k", {}, path=path)
+        (record,) = events.read_job_events(path)
+        assert record["process_index"] == 3
+
+    def test_malformed_env_index_degrades_to_zero(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_PROCESS_ID", "not-a-number")
+        path = str(tmp_path / "events.jsonl")
+        events.log_job_event("k", {}, path=path)
+        (record,) = events.read_job_events(path)
+        assert record["process_index"] == 0
+
+    def test_monotonic_orders_records_within_process(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        for i in range(3):
+            events.log_job_event("k", {"i": i}, path=path)
+        records = events.read_job_events(path)
+        stamps = [r["monotonic"] for r in records]
+        assert stamps == sorted(stamps)

@@ -92,13 +92,48 @@ class TestSpanTracer:
         xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
         metas = [e for e in trace["traceEvents"] if e["ph"] == "M"]
         assert {e["name"] for e in xs} == {"outer", "inner"}
-        assert all(e["name"] == "thread_name" for e in metas)
+        assert {e["name"] for e in metas} == {
+            "thread_name", "process_name", "process_sort_index"}
         inner = next(e for e in xs if e["name"] == "inner")
         outer = next(e for e in xs if e["name"] == "outer")
         # Time containment is how the viewers nest.
         assert outer["ts"] <= inner["ts"]
         assert (inner["ts"] + inner["dur"]
                 <= outer["ts"] + outer["dur"] + 1e-3)
+
+    def test_chrome_trace_process_lane_identity(self, monkeypatch):
+        """PR 7: the pid is the PROCESS INDEX (CLOUD_TPU_PROCESS_ID
+        contract), never a hardcoded 1, and process_name metadata
+        labels the lane host/pN (pid OSPID) — merged multi-host traces
+        must land on distinct, labeled Perfetto lanes."""
+        import os
+        import socket
+
+        monkeypatch.setenv("CLOUD_TPU_PROCESS_ID", "3")
+        tracer = spans.SpanTracer()
+        with tracer.span("work"):
+            pass
+        trace = tracer.chrome_trace()
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert all(e["pid"] == 3 for e in xs)
+        pname = next(e for e in trace["traceEvents"]
+                     if e.get("name") == "process_name")
+        assert pname["pid"] == 3
+        assert pname["args"]["name"] == "{}/p3 (pid {})".format(
+            socket.gethostname(), os.getpid())
+        sort = next(e for e in trace["traceEvents"]
+                    if e.get("name") == "process_sort_index")
+        assert sort["args"]["sort_index"] == 3
+
+    def test_chrome_trace_default_lane_is_process_zero(self,
+                                                       monkeypatch):
+        monkeypatch.delenv("CLOUD_TPU_PROCESS_ID", raising=False)
+        tracer = spans.SpanTracer()
+        with tracer.span("work"):
+            pass
+        trace = tracer.chrome_trace()
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert all(e["pid"] == 0 for e in xs)
 
     def test_write_round_trips_json(self, tmp_path):
         tracer = spans.SpanTracer()
