@@ -1014,6 +1014,19 @@ def worker():
         # Another measurement driver may have shared the chip during
         # this run (the chip-lock wait timed out upstream).
         record["lock_contended"] = True
+    # graftguard provenance: a record produced by a run that survived
+    # faults is not the same measurement as a clean one — retries mean
+    # the wall clock includes backoff and re-entry. Only stamped when
+    # the resilience module is live AND saw at least one fault
+    # (sys.modules.get keeps the common no-fault bench import-free).
+    _resilience = sys.modules.get("cloud_tpu.training.resilience")
+    if _resilience is not None:
+        _gstats = _resilience.guard_stats()
+        if _gstats["faults"]:
+            record["guard_faults"] = _gstats["faults"]
+            record["guard_retries"] = _gstats["retries"]
+            record["guard_rollbacks"] = _gstats["rollbacks"]
+            record["guard_last_fault"] = _gstats["last_fault"]
     if os.environ.get("BENCH_SKIP_KERNEL_PARITY", "0") != "1":
         # Emit the throughput record FIRST: if the kernel smoke hangs
         # the tunnel, the parent salvages this line from the killed

@@ -1,0 +1,256 @@
+"""graftchaos: deterministic fault injection for graftguard and CI.
+
+A chaos plan is a comma-separated list of `kind@step[:arg]` events,
+usually supplied via `CLOUD_TPU_CHAOS`; each fires EXACTLY ONCE at a
+configured global optimizer-step number, so a chaos run is fully
+reproducible — the point is a deterministic rig for the recovery path
+(training/resilience.py), not random monkey-testing. The fit loops
+call `pre_dispatch(step, n_steps)` before every dispatch;
+checkpoint.save calls `notify_checkpoint(path, step)` after every
+committed write.
+
+Kinds:
+
+  `hang@N[:seconds]`   Hang on the host before dispatching step N
+                       (default 3600 s), sleeping in 50 ms slices so
+                       graftwatch's async raise lands promptly — the
+                       watchdog converts the hang into a typed
+                       `BackendUnavailable`, exactly like a real
+                       wedged dispatch.
+  `preempt@N`          Raise `resilience.Preemption` before step N —
+                       the SIGTERM-grace-window interruption.
+  `fetch@N`            Raise `resilience.DataStall` before step N — a
+                       transient input-pipeline fetch error.
+  `nan@N`              Raise `resilience.NaNLoss` before step N — the
+                       rollback-with-fresh-rng path end to end.
+  `corrupt@N`          Truncate the largest file of the FIRST
+                       checkpoint saved at step >= N — a torn write
+                       the digest check (or orbax itself) must catch
+                       as `CheckpointCorrupt` on restore.
+
+Example: `CLOUD_TPU_CHAOS="hang@12:30,corrupt@9"` hangs the host 30 s
+before step 12 and tears the first checkpoint written at step >= 9 —
+the chaos-smoke CI scenario. Fired events emit "graftchaos" JSONL job
+events (CLOUD_TPU_EVENT_LOG) so post-hoc assertions can line injected
+faults up against graftguard's responses.
+"""
+
+import logging
+import os
+import time
+
+from cloud_tpu.training import resilience
+
+logger = logging.getLogger("cloud_tpu")
+
+KINDS = ("hang", "preempt", "fetch", "nan", "corrupt")
+
+#: Default hang duration, seconds — long enough that any sane
+#: graftwatch deadline fires first.
+DEFAULT_HANG_S = 3600.0
+
+
+class ChaosEvent:
+    """One `kind@step[:arg]` injection; fires at most once."""
+
+    __slots__ = ("kind", "step", "arg", "fired")
+
+    def __init__(self, kind, step, arg=None):
+        self.kind = kind
+        self.step = int(step)
+        self.arg = arg
+        self.fired = False
+
+    def spec(self):
+        return {"kind": self.kind, "step": self.step, "arg": self.arg,
+                "fired": self.fired}
+
+    def __repr__(self):
+        return "ChaosEvent({}@{}{})".format(
+            self.kind, self.step,
+            ":{}".format(self.arg) if self.arg is not None else "")
+
+
+def parse_spec(spec):
+    """Parses a `kind@step[:arg],...` spec string into ChaosEvents."""
+    events = []
+    for item in str(spec).split(","):
+        item = item.strip()
+        if not item:
+            continue
+        kind, sep, rest = item.partition("@")
+        kind = kind.strip()
+        if not sep or kind not in KINDS:
+            raise ValueError(
+                "Malformed chaos event {!r}: expected kind@step[:arg] "
+                "with kind in {}.".format(item, "/".join(KINDS)))
+        step_text, _, arg_text = rest.partition(":")
+        try:
+            step = int(step_text)
+            arg = float(arg_text) if arg_text else None
+        except ValueError:
+            raise ValueError(
+                "Malformed chaos event {!r}: step must be an int and "
+                "arg a float.".format(item))
+        events.append(ChaosEvent(kind, step, arg))
+    return events
+
+
+def _log_event(event, extra=None):
+    try:
+        from cloud_tpu.utils import events as events_lib
+
+        payload = event.spec()
+        if extra:
+            payload.update(extra)
+        events_lib.log_job_event("graftchaos", payload)
+    except Exception:
+        logger.debug("graftchaos: job event export failed", exc_info=True)
+
+
+class ChaosPlan:
+    """A set of one-shot injections, checked against the live step
+    counter by the fit loops and against committed checkpoint writes
+    by checkpoint.save."""
+
+    def __init__(self, events):
+        self.events = list(events)
+
+    @classmethod
+    def parse(cls, spec):
+        return cls(parse_spec(spec))
+
+    def remaining(self):
+        """Specs of events that have not fired yet."""
+        return [e.spec() for e in self.events if not e.fired]
+
+    def pre_dispatch(self, step, n_steps=1):
+        """Fires step-triggered events falling in [step, step + n_steps)
+        — the window the NEXT dispatch will execute. A grouped or
+        device-resident dispatch covers several steps per call, so the
+        injection lands at the nearest dispatch boundary at or before
+        its configured step (dispatch is the abort granularity)."""
+        if step is None:
+            return
+        due = [e for e in self.events
+               if not e.fired and e.kind != "corrupt"
+               and step <= e.step < step + n_steps]
+        for event in sorted(due, key=lambda e: e.step):
+            event.fired = True
+            self._fire(event)
+
+    def _fire(self, event):
+        _log_event(event)
+        if event.kind == "hang":
+            duration = DEFAULT_HANG_S if event.arg is None else event.arg
+            logger.warning(
+                "graftchaos: hanging %.1fs before step %d "
+                "(graftwatch should convert this to BackendUnavailable).",
+                duration, event.step)
+            end = time.monotonic() + duration
+            while time.monotonic() < end:
+                # Sliced sleep: the watchdog delivers its typed fault
+                # by async raise, which only lands between bytecode —
+                # a single long sleep would absorb the whole hang.
+                time.sleep(0.05)
+            return
+        message = "graftchaos: injected {} before step {}".format(
+            event.kind, event.step)
+        logger.warning("%s", message)
+        if event.kind == "preempt":
+            raise resilience.Preemption(message)
+        if event.kind == "fetch":
+            raise resilience.DataStall(
+                message + " (transient fetch error)")
+        if event.kind == "nan":
+            raise resilience.NaNLoss(message)
+
+    def notify_checkpoint(self, path, step):
+        """Called by checkpoint.save after a committed write; fires any
+        pending `corrupt` event whose threshold the save reached."""
+        due = [e for e in self.events
+               if not e.fired and e.kind == "corrupt" and step >= e.step]
+        for event in due:
+            if self._truncate(path):
+                event.fired = True
+                _log_event(event, extra={"path": str(path),
+                                         "checkpoint_step": step})
+
+    @staticmethod
+    def _truncate(path):
+        """Truncates the largest file under checkpoint `path` to half
+        its size — a torn write. Returns False (event stays armed)
+        when there is nothing truncatable yet (e.g. an async save
+        still committing)."""
+        candidates = []
+        if os.path.isfile(path):
+            candidates.append((os.path.getsize(path), path))
+        elif os.path.isdir(path):
+            for root, _, names in os.walk(path):
+                for name in names:
+                    target = os.path.join(root, name)
+                    try:
+                        candidates.append((os.path.getsize(target), target))
+                    except OSError:
+                        continue
+        # Largest first, path as the deterministic tie-break.
+        candidates = [c for c in sorted(candidates,
+                                        key=lambda c: (-c[0], c[1]))
+                      if c[0] > 0]
+        if not candidates:
+            return False
+        size, target = candidates[0]
+        with open(target, "r+b") as f:
+            f.truncate(size // 2)
+        logger.warning("graftchaos: truncated %s (%d -> %d bytes).",
+                       target, size, size // 2)
+        return True
+
+
+# --------------------------------------------------------------------------
+# Module singleton: one plan per process, surviving in-process retries
+# (a fired event stays fired across graftguard re-entries).
+# --------------------------------------------------------------------------
+
+_plan = None
+_env_checked = False
+
+
+def install(spec):
+    """Installs (or with a falsy spec, clears) the active plan.
+    Replaces any existing plan and suppresses the one-time
+    CLOUD_TPU_CHAOS auto-install."""
+    global _plan, _env_checked
+    _env_checked = True
+    _plan = ChaosPlan.parse(spec) if spec else None
+    return _plan
+
+
+def uninstall():
+    """Clears the active plan (test isolation) and re-arms the
+    CLOUD_TPU_CHAOS auto-install."""
+    global _plan, _env_checked
+    _plan = None
+    _env_checked = False
+
+
+def active_plan():
+    """The installed plan, auto-installing from CLOUD_TPU_CHAOS on the
+    first ask (once — a consumed plan is not re-armed). None when
+    chaos is off."""
+    global _plan, _env_checked
+    if _plan is None and not _env_checked:
+        _env_checked = True
+        spec = os.environ.get("CLOUD_TPU_CHAOS")
+        if spec:
+            _plan = ChaosPlan.parse(spec)
+            logger.warning("graftchaos: active plan %s.",
+                           [e.spec() for e in _plan.events])
+    return _plan
+
+
+def notify_checkpoint(path, step):
+    """checkpoint.save's hook: forwards to the active plan, if any."""
+    plan = _plan
+    if plan is not None:
+        plan.notify_checkpoint(path, step)

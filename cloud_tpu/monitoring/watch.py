@@ -80,7 +80,7 @@ logger = logging.getLogger("cloud_tpu")
 
 __all__ = ["Watchdog", "write_blackbox", "install", "uninstall",
            "current", "enabled", "env_enabled", "env_scope",
-           "heartbeat", "notify_step", "check"]
+           "heartbeat", "notify_step", "notify_reentry", "check"]
 
 #: Spans / job events kept in the blackbox tail.
 BLACKBOX_SPAN_TAIL = 100
@@ -326,6 +326,14 @@ class Watchdog:
         self._last_beat = now
         self._last_step_time = now
         self._step_count = 0
+        # True until the first completed step of the CURRENT (re)entry
+        # into the watched scope: the generous startup deadline covers
+        # compile/restore; the tight stall deadline takes over once
+        # steps flow. `notify_reentry` re-arms it so a graftguard
+        # resume replaying restore+rebuild isn't judged by the step
+        # deadline (ISSUE 9 satellite: STARTUP_DEADLINE per (re)entry,
+        # not only the first).
+        self._in_startup = True
         self._started = now
         self._watched_tid = None
         self._pending = None
@@ -351,6 +359,7 @@ class Watchdog:
         now = time.monotonic()
         self._last_beat = now
         self._last_step_time = now
+        self._in_startup = True
         self._started = now
         self._stop.clear()
         self._step_exported = False
@@ -378,6 +387,7 @@ class Watchdog:
             self._step_count = int(step)
         else:
             self._step_count += 1
+        self._in_startup = False
         self._last_step_time = now
         self._last_beat = now
         if not self._step_exported:
@@ -397,6 +407,26 @@ class Watchdog:
         if pending is not None and not self._async_delivered:
             self._pending = None
             raise pending
+
+    def notify_reentry(self):
+        """Re-arms the watchdog for a fresh (re)entry into the watched
+        scope — graftguard calls this before every resume attempt.
+
+        Resets the beat clocks and clears any latched stall so the
+        generous STARTUP deadline (not the tight stall deadline)
+        governs until the resumed run completes its first step: the
+        re-entry legitimately spends that window on restore, rebuild,
+        and (cold-cache worst case) recompile.
+        """
+        now = time.monotonic()
+        self._last_beat = now
+        self._last_step_time = now
+        self._in_startup = True
+        self._pending = None
+        self._fired = False
+        self._fired_at = None
+        self._async_delivered = False
+        self._crash_dumped = False
 
     def take_pending(self):
         """Removes and returns the pending error (or None) — the scope
@@ -460,8 +490,8 @@ class Watchdog:
                         now - self._fired_at, _EXIT_FATAL)
                     os._exit(_EXIT_FATAL)
                 continue
-            deadline = (self.stall_deadline if self._step_count > 0
-                        else self.startup_deadline)
+            deadline = (self.startup_deadline if self._in_startup
+                        else self.stall_deadline)
             if beat_age > deadline:
                 self._on_stall(beat_age, deadline)
 
@@ -599,6 +629,14 @@ def check():
     w = _watchdog
     if w is not None:
         w.check()
+
+
+def notify_reentry():
+    """Re-arms the installed watchdog for a resume attempt (startup
+    deadline + cleared stall latch). No-op when disabled."""
+    w = _watchdog
+    if w is not None:
+        w.notify_reentry()
 
 
 @contextlib.contextmanager

@@ -327,7 +327,13 @@ class BackendUnavailable(RuntimeError):
     probe loop consumers); carries the probe diagnosis, the deadline
     that was exceeded, and the flight-recorder path when one was
     written.
+
+    `fault_kind` places it in graftguard's typed-fault taxonomy
+    (training/resilience.py) — the retry loop classifies every caught
+    fault by this attribute.
     """
+
+    fault_kind = "backend_unavailable"
 
     def __init__(self, message="accelerator backend unavailable",
                  diagnosis=None, deadline=None, blackbox=None):

@@ -12,5 +12,10 @@ from cloud_tpu.training.data import (ArrayDataset, DeviceResidentDataset,
                                      epoch_permutation, make_input_cast,
                                      prefetch_to_device)
 from cloud_tpu.training import schedules
+from cloud_tpu.training.resilience import (AutoCheckpoint,
+                                           CheckpointCorrupt, DataStall,
+                                           NaNLoss, Preemption,
+                                           TrainingFault, guard_stats,
+                                           resilient_fit)
 from cloud_tpu.training.trainer import (Trainer, TrainState,
                                         sparse_categorical_crossentropy)

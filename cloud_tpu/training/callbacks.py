@@ -163,12 +163,20 @@ class TerminateOnNaN(Callback):
     under `fit(async_logging=True)` reading `logs["loss"]` here
     resolves the epoch's one coalesced background fetch — the NaN
     check costs that single round trip per epoch and nothing more.
+
+    rollback=True turns the stop into a typed `resilience.NaNLoss`
+    fault instead: under graftguard (`fit(resume="auto")`) the run
+    ROLLS BACK to the last finite checkpoint and resumes with a fresh
+    data-order rng (same params, different batch sequence) rather than
+    dying — outside graftguard the typed fault simply propagates to
+    the caller.
     """
 
-    def __init__(self, monitor="loss"):
+    def __init__(self, monitor="loss", rollback=False):
         import math
 
         self.monitor = monitor
+        self.rollback = bool(rollback)
         self._isfinite = math.isfinite
 
     def on_epoch_end(self, epoch, logs):
@@ -178,6 +186,17 @@ class TerminateOnNaN(Callback):
         if not self._isfinite(float(value)):
             import logging
 
+            if self.rollback:
+                from cloud_tpu.training import resilience
+
+                logging.getLogger("cloud_tpu").warning(
+                    "epoch %d: %s is %r — raising NaNLoss for "
+                    "graftguard rollback.", epoch, self.monitor, value)
+                raise resilience.NaNLoss(
+                    "epoch {}: {} is {!r}".format(epoch, self.monitor,
+                                                  value),
+                    epoch=epoch, monitor=self.monitor,
+                    value=float(value))
             logging.getLogger("cloud_tpu").warning(
                 "epoch %d: %s is %r — terminating training.",
                 epoch, self.monitor, value)

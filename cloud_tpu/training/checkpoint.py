@@ -8,13 +8,25 @@ layout (`<dir>/<step>`) keeps the tuner's per-trial checkpoint convention
 (reference tuner/tuner.py:601-605).
 """
 
+import hashlib
+import json
+import logging
 import os
+import sys
 import threading
+import time
 
 import jax
 import orbax.checkpoint as ocp
 
 from cloud_tpu.utils import storage
+
+logger = logging.getLogger("cloud_tpu")
+
+#: Sidecar filename suffix: `<dir>/<step>.meta.json` rides next to the
+#: orbax step directory. `latest_step`'s digit scan never sees it, and
+#: orbax's own `force=True` directory replacement never touches it.
+METADATA_SUFFIX = ".meta.json"
 
 
 def _checkpointer():
@@ -108,7 +120,89 @@ def _normalize(directory):
     return os.path.abspath(directory)
 
 
-def save(directory, state, step=0, force=True, use_async=False):
+def tree_digest(tree):
+    """sha256 content digest of a pytree: structure plus every leaf's
+    shape, dtype, and bytes. Deterministic across processes (tree_flatten
+    order is canonical), so a restore can recompute and compare.
+
+    Returns None when any leaf is not fully addressable — a multi-host
+    shard can't be hashed locally, so those checkpoints carry no digest
+    (restore still works; it just skips verification).
+    """
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    digest = hashlib.sha256()
+    digest.update(repr(treedef).encode("utf-8"))
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return None
+        array = np.asarray(leaf)
+        digest.update(str(array.shape).encode("utf-8"))
+        digest.update(str(array.dtype).encode("utf-8"))
+        digest.update(array.tobytes())
+    return digest.hexdigest()
+
+
+def _metadata_path(directory, step):
+    return storage.join(_normalize(directory), str(step) + METADATA_SUFFIX)
+
+
+def _write_metadata(directory, step, digest, data_state):
+    """Atomically writes the `<step>.meta.json` sidecar.
+
+    Local writes go through a temp file + `os.replace` so a crash
+    mid-write can never leave a half-written sidecar that a later
+    `load_metadata` would misparse; GCS object writes are atomic
+    already.
+    """
+    record = {
+        "format": "cloud_tpu.checkpoint.meta.v1",
+        "step": int(step),
+        "digest": digest,
+        "data_state": data_state,
+        "time": time.time(),
+    }
+    path = _metadata_path(directory, step)
+    payload = (json.dumps(record, sort_keys=True) + "\n").encode("utf-8")
+    if storage.is_gcs_path(path):
+        storage.write_bytes(path, payload)
+        return path
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return path
+
+
+def load_metadata(directory, step):
+    """The sidecar metadata dict for `<directory>/<step>` (content
+    digest + graftguard `data_state`), or None for checkpoints written
+    before the sidecar existed (they restore fine, unverified)."""
+    try:
+        payload = storage.read_bytes(_metadata_path(directory, step))
+    except (OSError, ValueError):
+        return None
+    try:
+        return json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError):
+        logger.warning("Unreadable checkpoint metadata for step %s "
+                       "under %s; ignoring it.", step, directory)
+        return None
+
+
+def _chaos_notify(path, step):
+    # graftchaos checkpoint-truncation hook: fires only when the chaos
+    # module is already loaded with an installed plan (sys.modules.get
+    # keeps the normal save path import-free).
+    chaos = sys.modules.get("cloud_tpu.analysis.chaos")
+    if chaos is not None:
+        chaos.notify_checkpoint(path, step)
+
+
+def save(directory, state, step=0, force=True, use_async=False,
+         data_state=None):
     """Saves a pytree `state` under `<directory>/<step>`.
 
     use_async: Return as soon as the state is snapshotted (device
@@ -117,6 +211,11 @@ def save(directory, state, step=0, force=True, use_async=False):
     for large states on slow stores (gs://). Call
     `wait_until_finished()` before reading the checkpoint back or
     exiting the process.
+
+    data_state: Optional resumable data-stream position (graftguard:
+    `Trainer.current_data_state()`), stamped into the metadata sidecar
+    alongside the content digest so a restore can re-base the shuffle
+    stream mid-epoch.
     """
     path = storage.join(_normalize(directory), str(step))
     if use_async:
@@ -135,9 +234,16 @@ def save(directory, state, step=0, force=True, use_async=False):
         with _pending_lock:
             _pending_paths.add(path)
         checkpointer.save(path, snapshot, force=force)
+        # The digest hashes the host snapshot — the exact bytes the
+        # background thread is committing, not the live (donatable)
+        # device arrays.
+        _write_metadata(directory, step, tree_digest(snapshot), data_state)
+        _chaos_notify(path, step)
         return path
     with _checkpointer() as checkpointer:
         checkpointer.save(path, state, force=force)
+    _write_metadata(directory, step, tree_digest(state), data_state)
+    _chaos_notify(path, step)
     return path
 
 
@@ -150,7 +256,7 @@ def latest_step(directory):
     return max(steps) if steps else None
 
 
-def restore(directory, target, step=None):
+def restore(directory, target, step=None, verify=True):
     """Restores a pytree congruent with `target` from `<directory>/<step>`.
 
     Args:
@@ -158,6 +264,12 @@ def restore(directory, target, step=None):
         target: A pytree of arrays (or ShapeDtypeStructs) matching the
             saved structure; its shardings are respected on restore.
         step: Step to restore; default latest.
+        verify: Recompute the content digest and compare it against the
+            metadata sidecar's (when one was recorded). A mismatch — or
+            a deserialize failure inside orbax — raises the typed
+            `resilience.CheckpointCorrupt` so graftguard can quarantine
+            the step and fall back to the previous checkpoint, instead
+            of surfacing a cryptic tensorstore error.
     """
     directory = _normalize(directory)
     wait_until_finished()  # never read a checkpoint mid-write
@@ -169,5 +281,66 @@ def restore(directory, target, step=None):
     path = storage.join(directory, str(step))
     abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                       target)
-    with _checkpointer() as checkpointer:
-        return checkpointer.restore(path, abstract)
+    try:
+        with _checkpointer() as checkpointer:
+            restored = checkpointer.restore(path, abstract)
+    except Exception as e:
+        from cloud_tpu.training import resilience
+
+        raise resilience.CheckpointCorrupt(
+            "Checkpoint {} failed to deserialize ({}: {}).".format(
+                path, type(e).__name__, e),
+            path=path, step=step) from e
+    if verify:
+        meta = load_metadata(directory, step)
+        expected = None if meta is None else meta.get("digest")
+        if expected:
+            actual = tree_digest(restored)
+            if actual is not None and actual != expected:
+                from cloud_tpu.training import resilience
+
+                raise resilience.CheckpointCorrupt(
+                    "Checkpoint {} failed its content digest "
+                    "(expected {}..., got {}...).".format(
+                        path, expected[:12], actual[:12]),
+                    path=path, step=step)
+    return restored
+
+
+def quarantine(directory, step):
+    """Moves `<directory>/<step>` (and its metadata sidecar) aside as
+    `<step>.corrupt` so `latest_step` falls back to the previous
+    checkpoint — graftguard's answer to `CheckpointCorrupt`.
+
+    Local paths rename atomically; gs:// objects have no rename, so
+    quarantine is skipped there with a warning (the operator must move
+    the object out of the prefix by hand). Returns the quarantine path,
+    or None when nothing was moved.
+    """
+    norm = _normalize(directory)
+    src = storage.join(norm, str(step))
+    if storage.is_gcs_path(norm):
+        logger.warning(
+            "Cannot quarantine %s: gs:// has no rename. Move the "
+            "object aside manually so resume stops selecting it.", src)
+        return None
+    if not os.path.exists(src):
+        return None
+    dst = src + ".corrupt"
+    suffix = 0
+    while os.path.exists(dst):
+        suffix += 1
+        dst = "{}.corrupt{}".format(src, suffix)
+    try:
+        os.replace(src, dst)
+    except OSError:
+        logger.warning("Failed to quarantine %s.", src, exc_info=True)
+        return None
+    meta_src = src + METADATA_SUFFIX
+    if os.path.exists(meta_src):
+        try:
+            os.replace(meta_src, dst + METADATA_SUFFIX)
+        except OSError:
+            pass
+    logger.warning("Quarantined corrupt checkpoint %s -> %s.", src, dst)
+    return dst

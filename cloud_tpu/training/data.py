@@ -115,10 +115,23 @@ class ArrayDataset:
 
     def __iter__(self):
         """Yields global (x, y) numpy batches for one epoch."""
+        return self.iter_from(0)
+
+    def iter_from(self, start_step):
+        """Yields one epoch's global batches starting at batch index
+        `start_step` — the mid-epoch resume entry point (graftguard).
+
+        The permutation is the SAME one `__iter__` would draw for this
+        epoch (the threefry perm depends only on seed and the epoch
+        counter), re-based by skipping the first `start_step` batches,
+        so a resumed run continues the interrupted epoch's exact batch
+        sequence. Epoch-counter semantics match `__iter__`: the counter
+        advances at the first `next()`, not at generator creation.
+        """
         order = self._epoch_order()
         self._epoch += 1
         steps = self.steps_per_epoch
-        for step in range(steps):
+        for step in range(int(start_step), steps):
             idx = order[step * self.batch_size:(step + 1) * self.batch_size]
             if len(idx) < self.batch_size:
                 # Pad the tail by tiling the epoch order (robust even when
@@ -134,13 +147,16 @@ class ArrayDataset:
             else:
                 yield xb, self.y[idx]
 
-    def process_local_view(self, process_index=None, process_count=None):
+    def process_local_view(self, process_index=None, process_count=None,
+                           start_step=0):
         """Returns this process's shard of each global batch.
 
         Multi-host feeding: every process iterates the same global order
         (same seed) and takes its contiguous slice of each batch; the
         slices are reassembled into a global array by
-        `cloud_tpu.parallel.sharding.make_global_batch`.
+        `cloud_tpu.parallel.sharding.make_global_batch`. `start_step`
+        re-bases the epoch mid-stream (see `iter_from`) — every process
+        skips the same prefix, so the shards stay aligned on resume.
         """
         process_index = (jax.process_index()
                          if process_index is None else process_index)
@@ -154,7 +170,7 @@ class ArrayDataset:
         lo, hi = process_index * shard, (process_index + 1) * shard
 
         def _slices():
-            for batch in self:
+            for batch in self.iter_from(start_step):
                 yield jax.tree_util.tree_map(lambda a: a[lo:hi], batch)
         return _slices()
 

@@ -19,6 +19,7 @@ Example:
 
 import functools
 import inspect
+import itertools
 import logging
 import os
 import sys
@@ -550,6 +551,12 @@ class Trainer:
         # fit loop — a plain host bool, so the check costs nothing and
         # never syncs the device. request_stop() sets it.
         self._abort_epoch = False
+        # graftguard state: the live data-stream position (stamped into
+        # checkpoint metadata by AutoCheckpoint/rescue saves), the armed
+        # resume-latency probe, and the active chaos plan.
+        self._data_progress = None
+        self._resume_probe = None
+        self._chaos = None
 
     # -- state construction --------------------------------------------
 
@@ -981,7 +988,28 @@ class Trainer:
         runs are a contiguous `dynamic_slice` of the data. The fit
         loop guarantees a call never straddles an epoch boundary (the
         permutation is computed once per call).
+
+        Executables are cached per geometry (`_resident_run_cache`):
+        a re-entrant fit over the same dataset — graftguard's warm
+        resume, or back-to-back fits — reuses the compiled run instead
+        of re-tracing, which is what keeps a resumed resident fit at
+        zero new compiles (the retrace sentinel's invariant).
         """
+        key = (num_steps, steps_per_epoch, resident.batch_size,
+               resident.num_examples, resident.shuffle, resident.seed,
+               resident.kind, weighted,
+               None if resident.policy is None
+               else resident.policy.cache_key)
+        cache = getattr(self, "_resident_run_cache", None)
+        if cache is None:
+            cache = self._resident_run_cache = {}
+        cached = cache.get(key)
+        if cached is not None:
+            run, scalar_set = cached
+            # Restore the build-time scalar-metric set: the fit loop's
+            # first-step guard reads whatever the (cached) build saw.
+            self._train_scalar_unmasked = scalar_set
+            return run
         inner = self._make_train_step_body(
             weighted=weighted,
             widen=self._batch_widener(resident.policy, weighted))
@@ -1020,14 +1048,17 @@ class Trainer:
             return state, self._reduce_scan_logs(logs_seq)
 
         if self._mesh is None:
-            return runtime.instrumented_jit(run, donate_argnums=0)
-        return runtime.instrumented_jit(
-            run,
-            in_shardings=(self._state_sharding, resident.sharding,
-                          sharding_lib.replicated(self._mesh),
-                          sharding_lib.replicated(self._mesh)),
-            out_shardings=(self._state_sharding, None),
-            donate_argnums=0)
+            jitted = runtime.instrumented_jit(run, donate_argnums=0)
+        else:
+            jitted = runtime.instrumented_jit(
+                run,
+                in_shardings=(self._state_sharding, resident.sharding,
+                              sharding_lib.replicated(self._mesh),
+                              sharding_lib.replicated(self._mesh)),
+                out_shardings=(self._state_sharding, None),
+                donate_argnums=0)
+        cache[key] = (jitted, self._train_scalar_unmasked)
+        return jitted
 
     @staticmethod
     def _metric_accepts_mask(fn):
@@ -1129,24 +1160,34 @@ class Trainer:
             return sharding_lib.make_global_batch(batch, self._mesh)
         return sharding_lib.shard_batch(batch, self._mesh)
 
-    def _epoch_batches(self, dataset):
+    def _epoch_batches(self, dataset, start_step=0):
         """One epoch of host batches, process-local on multi-host pods.
 
         Dispatch on the protocol, not the class: ArrayDataset provides
         `process_local_view`, and wrappers (ThreadedDataset) forward it,
-        so pod sharding survives wrapping.
+        so pod sharding survives wrapping. `start_step` re-bases the
+        epoch mid-stream for graftguard resume: datasets exposing
+        `iter_from` skip WITHOUT materializing the prefix (the
+        permutation is just sliced further along); anything else pays
+        an islice drop of the first `start_step` batches.
         """
         if (jax.process_count() > 1
                 and hasattr(dataset, "process_local_view")):
+            if start_step:
+                return dataset.process_local_view(start_step=start_step)
             return dataset.process_local_view()
+        if start_step and hasattr(dataset, "iter_from"):
+            return dataset.iter_from(start_step)
+        if start_step:
+            return itertools.islice(iter(dataset), int(start_step), None)
         return iter(dataset)
 
-    def _host_batches(self, dataset, cast):
+    def _host_batches(self, dataset, cast, start_step=0):
         """One epoch of host batches with the `input_cast` narrowing
         applied to the features slot — bytes on the wire drop 2x
         (bfloat16) or 4x (uint8); the jitted step's widener restores
         float32 in-graph."""
-        batches = self._epoch_batches(dataset)
+        batches = self._epoch_batches(dataset, start_step)
         if cast is None:
             return batches
 
@@ -1557,8 +1598,25 @@ class Trainer:
             input_cast=None,
             async_logging=True,
             warm_start=False,
-            on_retrace=None):
+            on_retrace=None,
+            resume=None,
+            retries=None):
         """Trains the model; returns a history dict of per-epoch logs.
+
+        resume: "auto" runs the fit under graftguard
+        (`resilience.resilient_fit`): typed faults — the watchdog's
+        `BackendUnavailable`, `Preemption`, `CheckpointCorrupt`,
+        `DataStall`, `TerminateOnNaN(rollback=True)`'s `NaNLoss` — are
+        caught, answered with a rescue/rollback checkpoint, and
+        retried with capped exponential backoff; re-entry restores the
+        latest checkpoint, re-bases the shuffle stream to the saved
+        mid-epoch position (bit-identical continuation), and reuses
+        the warm executables (zero new compiles). The checkpoint
+        directory is `resume_from` (else `CLOUD_TPU_RESUME_DIR`, else
+        `./graftguard_ckpt`), auto-checkpointed every epoch.
+
+        retries: graftguard's retry budget (with resume="auto" only);
+        default `CLOUD_TPU_RETRIES` (3).
 
         warm_start: AOT-compile the fit executables (train step, and
         the steps_per_execution / device-resident variants) from
@@ -1646,6 +1704,59 @@ class Trainer:
         `y` (multiplies into any explicit sample_weight). Labels
         absent from the dict weigh 1.0.
         """
+        kwargs = dict(
+            x=x, y=y, epochs=epochs, batch_size=batch_size,
+            shuffle=shuffle, validation_data=validation_data,
+            validation_split=validation_split,
+            initial_epoch=initial_epoch, callbacks=callbacks,
+            steps_per_epoch=steps_per_epoch, verbose=verbose,
+            resume_from=resume_from, prefetch=prefetch,
+            sample_weight=sample_weight, class_weight=class_weight,
+            cache=cache, input_cast=input_cast,
+            async_logging=async_logging, warm_start=warm_start,
+            on_retrace=on_retrace)
+        if resume in (None, False, "none"):
+            if retries is not None:
+                raise ValueError(
+                    "retries= only applies with resume='auto'.")
+            return self._fit_impl(**kwargs)
+        if resume != "auto":
+            raise ValueError(
+                "resume must be 'auto' or None; got {!r}.".format(resume))
+        from cloud_tpu.training import resilience
+
+        return resilience.resilient_fit(self, retries=retries, **kwargs)
+
+    def _fit_impl(self,
+                  x=None,
+                  y=None,
+                  epochs=1,
+                  batch_size=32,
+                  shuffle=True,
+                  validation_data=None,
+                  validation_split=0.0,
+                  initial_epoch=0,
+                  callbacks=(),
+                  steps_per_epoch=None,
+                  verbose=True,
+                  resume_from=None,
+                  prefetch=2,
+                  sample_weight=None,
+                  class_weight=None,
+                  cache=None,
+                  input_cast=None,
+                  async_logging=True,
+                  warm_start=False,
+                  on_retrace=None,
+                  data_seed=None,
+                  history=None):
+        """One fit attempt — `fit`'s whole body, minus the graftguard
+        dispatch. The retry loop calls this directly (inside fit's
+        env scopes, so the watchdog/telemetry/sanitizer persist across
+        attempts) with two extras: `data_seed` overrides the dataset
+        shuffle seed (NaN rollback resumes with a fresh data order)
+        and `history` accumulates one dict ACROSS attempts.
+        """
         if validation_split:
             if not 0.0 < validation_split < 1.0:
                 raise ValueError(
@@ -1705,9 +1816,10 @@ class Trainer:
         ds_kwargs = {}
         if sample_weight is not None:
             ds_kwargs["sample_weight"] = sample_weight
-        dataset = data_lib.as_dataset(x, y, batch_size=batch_size,
-                                      shuffle=shuffle, seed=self.seed,
-                                      **ds_kwargs)
+        dataset = data_lib.as_dataset(
+            x, y, batch_size=batch_size, shuffle=shuffle,
+            seed=(self.seed if data_seed is None else data_seed),
+            **ds_kwargs)
         if (sample_weight is not None
                 and not isinstance(dataset, data_lib.ArrayDataset)):
             raise ValueError(
@@ -1725,13 +1837,24 @@ class Trainer:
         sample = next(iter(dataset))
         sample_x = sample[0] if isinstance(sample, tuple) else sample
         self.build(sample_x)
+        start_step = 0
         if resume_from is not None:
             from cloud_tpu.training import checkpoint as checkpoint_lib
-            if checkpoint_lib.latest_step(resume_from) is not None:
+            ckpt_step = checkpoint_lib.latest_step(resume_from)
+            if ckpt_step is not None:
+                # CheckpointCorrupt propagates from here to graftguard,
+                # which quarantines the step and re-enters on the
+                # previous one.
                 self.state = checkpoint_lib.restore(resume_from,
-                                                    self.state)
+                                                    self.state,
+                                                    step=ckpt_step)
                 logger.info("Resumed training from %s at step %d.",
                             resume_from, int(self.state.step))
+                meta = checkpoint_lib.load_metadata(resume_from,
+                                                    ckpt_step) or {}
+                initial_epoch, start_step = self._apply_data_state(
+                    dataset, meta.get("data_state"), initial_epoch,
+                    data_seed)
 
         policy = None
         if input_cast not in (None, "none"):
@@ -1756,16 +1879,24 @@ class Trainer:
             resident = data_lib.DeviceResidentDataset.build(
                 dataset, input_cast=policy, mesh=self._mesh)
 
-        # Resident fits build their own executables per fit (the
-        # permutation geometry is baked in) and skip the step caches.
+        # Resident fits build their executables through the
+        # per-geometry _resident_run_cache (the permutation geometry is
+        # baked into the key) and skip the host step caches.
         if resident is None:
             self._ensure_host_steps(weighted, policy)
             if warm_start:
                 self._warm_fit_steps(sample, weighted, policy)
 
-        history = {}
+        history = {} if history is None else history
         self.stop_training = False
         self._abort_epoch = False
+        # graftchaos arm: only when the chaos module is loaded (a test
+        # installed a plan) or CLOUD_TPU_CHAOS asks for it — the normal
+        # fit path stays import- and branch-free in the hot loop.
+        chaos_mod = sys.modules.get("cloud_tpu.analysis.chaos")
+        if chaos_mod is None and os.environ.get("CLOUD_TPU_CHAOS"):
+            from cloud_tpu.analysis import chaos as chaos_mod
+        self._chaos = None if chaos_mod is None else chaos_mod.active_plan()
         # Retrace sentinel state (see on_retrace above): the baseline
         # is snapshotted at the end of the first COMPLETED epoch; the
         # counters are process-wide, so a second Trainer compiling
@@ -1802,13 +1933,15 @@ class Trainer:
                 self._fit_epochs_resident(
                     resident, epochs, steps_per_epoch, validation_data,
                     batch_size, callbacks, history, verbose, prefetch,
-                    initial_epoch=initial_epoch, warm_start=warm_start)
+                    initial_epoch=initial_epoch, warm_start=warm_start,
+                    start_step=start_step)
             else:
                 self._fit_epochs(dataset, epochs, steps_per_epoch,
                                  validation_data, batch_size, callbacks,
                                  history, verbose, prefetch,
                                  initial_epoch=initial_epoch,
-                                 cast=policy, weighted=weighted)
+                                 cast=policy, weighted=weighted,
+                                 start_step=start_step)
         finally:
             # The epoch loops label this thread "step"/"boundary" for
             # graftsan; an abort can exit mid-"step". Clear the label so
@@ -1902,10 +2035,100 @@ class Trainer:
         self._abort_epoch = True
         self.stop_training = True
 
+    # -- graftguard: the resumable data-stream position ----------------
+
+    def current_data_state(self):
+        """The resumable data-stream position, for checkpoint metadata.
+
+        Returns `{"epoch", "step_in_epoch", "dataset_epoch",
+        "data_seed"}` describing where the shuffle stream stands as of
+        the CURRENT train state, or None outside a fit. `step_in_epoch`
+        derives from the step counter itself (`state.step` minus the
+        epoch's base step, one device read at save time) rather than
+        host-side bookkeeping, so a watchdog fault async-raised between
+        a dispatch and its bookkeeping still checkpoints a position
+        consistent with the params — resume never double-applies a
+        step. Positions at the epoch boundary normalize to
+        `(epoch + 1, 0)`.
+        """
+        progress = self._data_progress
+        if progress is None or self.state is None:
+            return None
+        try:
+            step_in_epoch = max(
+                int(self.state.step) - progress["epoch_base"], 0)
+        except Exception:
+            # Donated/invalidated buffers (a fault landed mid-dispatch):
+            # no trustworthy position — and no trustworthy state to
+            # save it with either.
+            return None
+        epoch = int(progress["epoch"])
+        dataset_epoch = int(progress["dataset_epoch"])
+        spe = progress.get("steps_per_epoch")
+        if spe and step_in_epoch >= spe:
+            rolls = step_in_epoch // spe
+            epoch += rolls
+            dataset_epoch += rolls
+            step_in_epoch -= rolls * spe
+        return {"epoch": epoch, "step_in_epoch": step_in_epoch,
+                "dataset_epoch": dataset_epoch,
+                "data_seed": progress.get("data_seed")}
+
+    def _apply_data_state(self, dataset, data_state, initial_epoch,
+                          data_seed):
+        """Re-bases the shuffle stream to a checkpoint's mid-epoch
+        position (graftguard exact resume); returns the effective
+        `(initial_epoch, start_step)`.
+
+        The metadata carries `(epoch, step_in_epoch, dataset_epoch,
+        data_seed)` as of the save. When the live dataset draws from
+        the same seed, its epoch counter is rewound to the in-progress
+        epoch's value (overwriting the tick this fit's shape-inference
+        peek consumed) and the fit loop skips the epoch's first
+        `step_in_epoch` batches — the resumed run continues the
+        interrupted threefry permutation exactly, and with the per-step
+        train rng keyed off the restored global step, the loss
+        trajectory is bit-identical to an uninterrupted run. A
+        DIFFERENT seed (NaN rollback resumes with a fresh data-order
+        rng) instead restarts the interrupted epoch from batch 0 under
+        the new permutation.
+        """
+        if not data_state:
+            return initial_epoch, 0
+        epoch = int(data_state.get("epoch", initial_epoch))
+        step_in_epoch = int(data_state.get("step_in_epoch", 0))
+        dataset_epoch = data_state.get("dataset_epoch")
+        seed_then = data_state.get("data_seed")
+        seed_now = getattr(
+            dataset, "seed", self.seed if data_seed is None else data_seed)
+        initial_epoch = max(initial_epoch, epoch)
+        if dataset_epoch is not None and hasattr(dataset, "_epoch"):
+            dataset._epoch = int(dataset_epoch)
+        if seed_then is not None and seed_then != seed_now:
+            logger.info(
+                "Resuming epoch %d from its start with a fresh data "
+                "order (seed %s -> %s).", epoch, seed_then, seed_now)
+            return initial_epoch, 0
+        if step_in_epoch:
+            logger.info("Resuming mid-epoch: epoch %d, batch %d.",
+                        epoch, step_in_epoch)
+        return initial_epoch, step_in_epoch
+
+    def _note_dispatch_done(self):
+        """Per-dispatch epilogue shared by the fit loops: the watchdog
+        step beat, then the one-shot graftguard resume probe (latency +
+        compile delta after the first completed dispatch of a resumed
+        attempt)."""
+        watch_lib.notify_step()
+        probe = self._resume_probe
+        if probe is not None:
+            self._resume_probe = None
+            probe.first_step()
+
     def _fit_epochs(self, dataset, epochs, steps_per_epoch,
                     validation_data, batch_size, callbacks, history,
                     verbose, prefetch=2, initial_epoch=0, cast=None,
-                    weighted=False):
+                    weighted=False, start_step=0):
         pad_tail = lambda b, steady: self._pad_tail(b, steady, weighted)
         # Feeder items are (kind, examples, tail_weight_sum, batch):
         # the weight sum is only meaningful for "padded" tails (the
@@ -1914,7 +2137,25 @@ class Trainer:
         unpack = lambda item: (
             item[0], item[1],
             item[2][1] if item[0] == "padded" else None)
+        # Host mirror of the global step at epoch entry: ONE boundary
+        # sync per fit (the scalar is quiescent here), advanced by the
+        # host step count at each epoch end. current_data_state
+        # subtracts it from the live step counter to get the mid-epoch
+        # position without trusting hot-loop bookkeeping.
+        host_base = int(self.state.step)
         for epoch in range(initial_epoch, epochs):
+            epoch_start = int(start_step) if epoch == initial_epoch else 0
+            if steps_per_epoch is not None:
+                epoch_start = min(epoch_start, steps_per_epoch)
+            self._data_progress = {
+                "epoch": epoch,
+                "epoch_base": host_base - epoch_start,
+                # Recorded BEFORE iteration advances it: the value this
+                # epoch's permutation will draw from.
+                "dataset_epoch": int(getattr(dataset, "_epoch", 0)),
+                "steps_per_epoch": steps_per_epoch,
+                "data_seed": getattr(dataset, "seed", None),
+            }
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             step_logs = []
@@ -1935,10 +2176,13 @@ class Trainer:
             spe = self.steps_per_execution
             multi_step = getattr(self, "_jit_multi_step", None)
             if spe > 1 and multi_step is not None:
+                epoch_limit = (None if steps_per_epoch is None
+                               else steps_per_epoch - epoch_start)
                 feeder = data_lib.prefetch_to_device(
                     self._grouped_host_batches(
-                        self._host_batches(dataset, cast),
-                        steps_per_epoch, spe, pad_tail=pad_tail),
+                        self._host_batches(dataset, cast,
+                                           start_step=epoch_start),
+                        epoch_limit, spe, pad_tail=pad_tail),
                     size=prefetch,
                     feed=lambda item: unpack(item) + (
                         self._feed_grouped(item),))
@@ -1948,6 +2192,10 @@ class Trainer:
                 for kind, batch_examples, w_sum, fed in feeder:
                     if self._abort_epoch:
                         break
+                    if self._chaos is not None:
+                        self._chaos.pre_dispatch(
+                            host_base + count,
+                            spe if kind == "multi" else 1)
                     examples += batch_examples
                     if kind == "multi":
                         if first and epoch == initial_epoch:
@@ -2001,10 +2249,12 @@ class Trainer:
                             "per-example values.".format(
                                 sorted(self._train_scalar_unmasked)))
                     # graftwatch: one completed dispatch = one beat
-                    # (one global load + None check when unwatched).
-                    watch_lib.notify_step()
+                    # (one global load + None check when unwatched),
+                    # plus the one-shot graftguard resume probe.
+                    self._note_dispatch_done()
                     first = False
                 spans_lib.end(step_section)
+                host_base += count
                 if not (self._abort_epoch and count == 0):
                     # A zero-step aborted epoch has no metrics; an
                     # epoch-end with only steps_per_sec would desync
@@ -2016,14 +2266,18 @@ class Trainer:
                 if self.stop_training:
                     break
                 continue
+            epoch_bound = (None if steps_per_epoch is None
+                           else steps_per_epoch - epoch_start)
+
             def singles():
                 # The limit check precedes the pull: a bounded stream
                 # (steps_per_epoch over an expensive generator) must
                 # never be drawn past the bound.
                 steady = None
-                it = iter(self._host_batches(dataset, cast))
+                it = iter(self._host_batches(dataset, cast,
+                                             start_step=epoch_start))
                 i = 0
-                while steps_per_epoch is None or i < steps_per_epoch:
+                while epoch_bound is None or i < epoch_bound:
                     try:
                         b = next(it)
                     except StopIteration:
@@ -2049,6 +2303,8 @@ class Trainer:
             for kind, batch_examples, w_sum, batch in feeder:
                 if self._abort_epoch:
                     break
+                if self._chaos is not None:
+                    self._chaos.pre_dispatch(host_base + count, 1)
                 examples += batch_examples
                 if kind == "padded":
                     tail_step = self._tail_step_fn(weighted, cast)
@@ -2079,9 +2335,10 @@ class Trainer:
                 # device step); convert once per epoch below.
                 step_logs.append(logs)
                 count += 1
-                # graftwatch: one completed dispatch = one beat.
-                watch_lib.notify_step()
+                # graftwatch beat + graftguard resume probe.
+                self._note_dispatch_done()
             spans_lib.end(step_section)
+            host_base += count
             if not (self._abort_epoch and count == 0):
                 # Same zero-step-abort guard as the multi-step path.
                 self._post_epoch_logs(step_logs, count, examples, t0,
@@ -2094,7 +2351,8 @@ class Trainer:
     def _fit_epochs_resident(self, resident, epochs, steps_per_epoch,
                              validation_data, batch_size, callbacks,
                              history, verbose, prefetch=2,
-                             initial_epoch=0, warm_start=False):
+                             initial_epoch=0, warm_start=False,
+                             start_step=0):
         """The device-resident fit loop: every batch is drawn in-graph
         from `resident.data`, so the epoch loop issues executable calls
         only — ZERO per-step host->device data transfers (pinned by
@@ -2105,6 +2363,14 @@ class Trainer:
         through a second executable with its own baked scan length, so
         a call never straddles an epoch boundary (the in-graph
         permutation is derived once per call).
+
+        start_step (graftguard resume): skip the first `start_step`
+        steps of the FIRST epoch by dropping whole executable calls and
+        re-basing the position arithmetic — in-graph batch indices
+        continue the interrupted epoch's permutation exactly. Dispatch
+        is the abort granularity, so checkpointed positions are always
+        call-aligned; a foreign (unaligned) position falls back to
+        replaying the epoch from 0 with a warning.
         """
         weighted = resident.kind == "xyw"
         steps = resident.steps_per_epoch
@@ -2112,6 +2378,14 @@ class Trainer:
             steps = min(steps, int(steps_per_epoch))
         spe = min(self.steps_per_execution, steps)
         n_groups, leftover = divmod(steps, spe)
+        start = int(start_step)
+        if start and (start % spe or start >= steps):
+            logger.warning(
+                "Resident resume position step_in_epoch=%d does not "
+                "sit on a dispatch boundary (steps_per_execution=%d, "
+                "steps_per_epoch=%d); replaying the epoch from its "
+                "start instead.", start, spe, steps)
+            start = 0
         # Each executable build re-points self._train_scalar_unmasked
         # at a fresh set (populated at trace time); keep a reference to
         # every build's set so the first-step guard below sees whichever
@@ -2151,8 +2425,21 @@ class Trainer:
                                     sharding_lib.replicated(self._mesh))
         data = resident.data
         first_epoch = True
+        # Host step mirror for current_data_state / graftchaos: one
+        # boundary sync here, advanced by the host count per epoch.
+        host_base = int(self.state.step)
 
         for epoch in range(initial_epoch, epochs):
+            epoch_start = start if epoch == initial_epoch else 0
+            self._data_progress = {
+                "epoch": epoch,
+                "epoch_base": host_base - epoch_start,
+                # The counter value this epoch's permutation draws
+                # from — read BEFORE the += 1 below.
+                "dataset_epoch": int(getattr(src, "_epoch", 0)),
+                "steps_per_epoch": steps,
+                "data_seed": getattr(src, "seed", None),
+            }
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             if not first_epoch:
@@ -2163,9 +2450,14 @@ class Trainer:
             # Position arithmetic is relative to the step counter at
             # EPOCH entry (a mid-epoch abort leaves step partially
             # advanced; re-basing keeps the next epoch's positions at
-            # 0..steps-1). A REAL copy: each call donates the state
-            # (and with it the live step buffer).
+            # 0..steps-1). On a mid-epoch resume the restored counter
+            # is `epoch_start` PAST the epoch's base, so subtract it —
+            # the in-graph `(step - base) % steps` then lands on the
+            # interrupted permutation position. A REAL copy: each call
+            # donates the state (and with it the live step buffer).
             base = jnp.array(self.state.step, copy=True)
+            if epoch_start:
+                base = base - epoch_start
             if self._mesh is not None:
                 base = jax.device_put(
                     base, sharding_lib.replicated(self._mesh))
@@ -2183,9 +2475,16 @@ class Trainer:
             calls = [(run_group, spe)] * n_groups
             if leftover:
                 calls.append((run_tail, leftover))
+            if epoch_start:
+                # Aligned by the guard above: drop the already-run
+                # whole calls; the base re-basing keeps the remaining
+                # calls' in-graph positions continuous.
+                calls = calls[epoch_start // spe:]
             for run, n_steps in calls:
                 if self._abort_epoch:
                     break
+                if self._chaos is not None:
+                    self._chaos.pre_dispatch(host_base + count, n_steps)
                 if count == 0 and epoch == initial_epoch:
                     self._maybe_capture_step_flops(
                         run, n_steps, self.state, data, base, ep_idx)
@@ -2212,9 +2511,10 @@ class Trainer:
                         "per-example values.".format(
                             sorted(set().union(*scalar_sets))))
                 count += n_steps
-                # graftwatch: one completed dispatch = one beat.
-                watch_lib.notify_step()
+                # graftwatch beat + graftguard resume probe.
+                self._note_dispatch_done()
             spans_lib.end(step_section)
+            host_base += count
             if not (self._abort_epoch and count == 0):
                 self._post_epoch_logs(step_logs, count,
                                       count * resident.batch_size, t0,

@@ -211,7 +211,7 @@ def log_job_event(kind, payload, path=None):
     return path
 
 
-def read_job_events(path, with_stats=False):
+def read_job_events(path, with_stats=False, kind=None):
     """Parses a JSONL job-event file -> list of dicts.
 
     Skips blanks AND corrupt/partial lines (a writer that crashed
@@ -220,7 +220,9 @@ def read_job_events(path, with_stats=False):
     every later reader of an otherwise-healthy log. With
     `with_stats=True` returns (records, {"corrupt_lines": n}) so the
     fleet collector can report torn files instead of silently eating
-    them.
+    them. `kind` filters to one event kind (e.g. "graftguard",
+    "graftchaos", "graftwatch") — the common post-hoc assertion shape
+    in the chaos-smoke CI job and tests.
     """
     data = storage.read_bytes(path).decode("utf-8", errors="replace")
     records = []
@@ -237,6 +239,8 @@ def read_job_events(path, with_stats=False):
             "read_job_events: skipped %d corrupt/partial JSON line(s) "
             "in %s (crashed writer?); returning the %d parseable "
             "record(s).", corrupt, path, len(records))
+    if kind is not None:
+        records = [r for r in records if r.get("kind") == kind]
     if with_stats:
         return records, {"corrupt_lines": corrupt}
     return records

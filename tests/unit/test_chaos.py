@@ -1,0 +1,143 @@
+"""graftchaos: the deterministic fault-injection rig itself.
+
+The chaos harness is test infrastructure for graftguard, so its own
+contract has to be airtight: a spec parses to exactly the configured
+one-shot events, `pre_dispatch` fires only inside the dispatch window
+about to execute, `corrupt` tears real bytes off a committed
+checkpoint, and the module singleton auto-installs from
+CLOUD_TPU_CHAOS exactly once.
+"""
+
+import os
+
+import pytest
+
+from cloud_tpu.analysis import chaos
+from cloud_tpu.training import resilience
+
+
+@pytest.fixture(autouse=True)
+def _chaos_isolation(monkeypatch):
+    monkeypatch.delenv("CLOUD_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("CLOUD_TPU_EVENT_LOG", raising=False)
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+class TestParse:
+    def test_full_grammar(self):
+        events = chaos.parse_spec("hang@12:30, preempt@7,corrupt@9")
+        assert [(e.kind, e.step, e.arg) for e in events] == [
+            ("hang", 12, 30.0), ("preempt", 7, None), ("corrupt", 9, None)]
+        assert not any(e.fired for e in events)
+
+    def test_empty_items_skipped(self):
+        assert chaos.parse_spec("fetch@3,,") [0].kind == "fetch"
+        assert len(chaos.parse_spec("nan@1,")) == 1
+
+    @pytest.mark.parametrize("bad", [
+        "explode@3",        # unknown kind
+        "preempt",          # missing @step
+        "hang@twelve",      # non-int step
+        "hang@12:soon",     # non-float arg
+    ])
+    def test_malformed_raises(self, bad):
+        with pytest.raises(ValueError, match="Malformed chaos event"):
+            chaos.parse_spec(bad)
+
+
+class TestPreDispatch:
+    def test_fires_once_at_configured_step(self):
+        plan = chaos.ChaosPlan.parse("preempt@5")
+        plan.pre_dispatch(4)  # window [4, 5): not yet
+        with pytest.raises(resilience.Preemption):
+            plan.pre_dispatch(5)
+        # One-shot: the same step again is a no-op.
+        plan.pre_dispatch(5)
+        assert plan.remaining() == []
+
+    def test_grouped_window_covers_interior_steps(self):
+        # A steps_per_execution=4 dispatch at step 4 executes steps
+        # 4..7 in one call — an injection configured mid-group lands at
+        # the dispatch boundary (dispatch is the abort granularity).
+        plan = chaos.ChaosPlan.parse("fetch@6")
+        plan.pre_dispatch(0, n_steps=4)
+        with pytest.raises(resilience.DataStall):
+            plan.pre_dispatch(4, n_steps=4)
+
+    def test_typed_faults_per_kind(self):
+        for kind, exc in [("preempt", resilience.Preemption),
+                          ("fetch", resilience.DataStall),
+                          ("nan", resilience.NaNLoss)]:
+            plan = chaos.ChaosPlan.parse("{}@0".format(kind))
+            with pytest.raises(exc):
+                plan.pre_dispatch(0)
+
+    def test_hang_sleeps_then_returns(self):
+        plan = chaos.ChaosPlan.parse("hang@2:0.1")
+        plan.pre_dispatch(2)  # returns after ~0.1s, no exception
+        assert plan.remaining() == []
+
+    def test_corrupt_not_step_triggered(self):
+        plan = chaos.ChaosPlan.parse("corrupt@3")
+        plan.pre_dispatch(3)
+        (spec,) = plan.remaining()
+        assert spec["kind"] == "corrupt" and not spec["fired"]
+
+
+class TestCorrupt:
+    def test_truncates_largest_file(self, tmp_path):
+        ckpt = tmp_path / "8"
+        ckpt.mkdir()
+        (ckpt / "small.bin").write_bytes(b"x" * 10)
+        (ckpt / "big.bin").write_bytes(b"y" * 100)
+        plan = chaos.ChaosPlan.parse("corrupt@5")
+        plan.notify_checkpoint(str(ckpt), 8)
+        assert (ckpt / "big.bin").stat().st_size == 50
+        assert (ckpt / "small.bin").stat().st_size == 10
+        assert plan.remaining() == []
+
+    def test_below_threshold_stays_armed(self, tmp_path):
+        plan = chaos.ChaosPlan.parse("corrupt@10")
+        ckpt = tmp_path / "8"
+        ckpt.mkdir()
+        (ckpt / "data.bin").write_bytes(b"z" * 64)
+        plan.notify_checkpoint(str(ckpt), 8)  # 8 < 10: not yet
+        assert (ckpt / "data.bin").stat().st_size == 64
+        assert len(plan.remaining()) == 1
+
+    def test_empty_dir_stays_armed(self, tmp_path):
+        plan = chaos.ChaosPlan.parse("corrupt@0")
+        empty = tmp_path / "0"
+        empty.mkdir()
+        plan.notify_checkpoint(str(empty), 0)
+        assert len(plan.remaining()) == 1
+
+
+class TestSingleton:
+    def test_install_uninstall(self):
+        plan = chaos.install("preempt@3")
+        assert chaos.active_plan() is plan
+        chaos.uninstall()
+        assert chaos.active_plan() is None
+
+    def test_env_auto_install_is_one_time(self, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_CHAOS", "preempt@3")
+        plan = chaos.active_plan()
+        assert plan is not None
+        with pytest.raises(resilience.Preemption):
+            plan.pre_dispatch(3)
+        # A consumed plan must NOT re-arm from the env on the next ask
+        # (graftguard re-entries would replay the same injection
+        # forever).
+        assert chaos.active_plan() is plan
+        assert plan.remaining() == []
+
+    def test_notify_checkpoint_module_seam_noop(self, tmp_path):
+        # No plan installed: the checkpoint hook must be a no-op.
+        target = tmp_path / "1"
+        target.mkdir()
+        (target / "data.bin").write_bytes(b"k" * 32)
+        chaos.notify_checkpoint(str(target), 1)
+        assert (target / "data.bin").stat().st_size == 32

@@ -158,6 +158,21 @@ class TestJobEventLog:
         assert [r["kind"] for r in records] == ["a"]
         assert stats == {"corrupt_lines": 1}
 
+    def test_kind_filter(self, tmp_path):
+        # The post-hoc assertion shape of the chaos-smoke CI job: one
+        # mixed stream, filtered per producer.
+        path = str(tmp_path / "events.jsonl")
+        events.log_job_event("graftguard", {"event": "fault"}, path=path)
+        events.log_job_event("graftchaos", {"kind": "preempt"}, path=path)
+        events.log_job_event("graftguard", {"event": "resumed"}, path=path)
+        guard = events.read_job_events(path, kind="graftguard")
+        assert [r["payload"]["event"] for r in guard] == ["fault",
+                                                          "resumed"]
+        assert events.read_job_events(path, kind="graftwatch") == []
+        records, stats = events.read_job_events(
+            path, with_stats=True, kind="graftchaos")
+        assert len(records) == 1 and stats == {"corrupt_lines": 0}
+
 
 class TestJobEventStamps:
     """PR 7 identity contract: every record says WHO wrote it (host +

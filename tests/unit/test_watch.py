@@ -175,6 +175,49 @@ class TestWatchdogStall:
         finally:
             w.stop()
 
+    def test_reentry_rearms_startup_deadline(self, tmp_path):
+        """graftguard re-entry contract (ISSUE 9): after
+        notify_reentry, the generous STARTUP deadline governs again —
+        restore + rebuild must not trip the tight steady-state stall
+        deadline."""
+        w = watch.Watchdog(stall_deadline=0.3, startup_deadline=3.0,
+                           poll_interval=0.05, probe=False,
+                           out_dir=str(tmp_path))
+        # Bogus tid: a firing would latch without async-raising into
+        # this test thread.
+        w.start(watched_tid=2 ** 31 + 12345)
+        try:
+            w.notify_step()  # leave startup: stall deadline governs
+            w.notify_reentry()
+            time.sleep(0.9)  # 3x the stall deadline, inside startup
+            assert not w.fired
+            # First completed step ends the startup grace again...
+            w.notify_step()
+            deadline = time.monotonic() + 10
+            while not w.fired and time.monotonic() < deadline:
+                time.sleep(0.02)
+            # ...so a quiet 0.3s now IS a stall.
+            assert w.fired
+        finally:
+            w.stop()
+
+    def test_reentry_clears_fired_latch(self, tmp_path):
+        w = watch.Watchdog(stall_deadline=0.2, startup_deadline=0.2,
+                           poll_interval=0.05, probe=False,
+                           out_dir=str(tmp_path))
+        w.start(watched_tid=2 ** 31 + 12345)
+        try:
+            deadline = time.monotonic() + 10
+            while not w.fired and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert w.fired
+            w.notify_reentry()
+            assert not w.fired
+            assert w.take_pending() is None
+            w.check()  # latched error was cleared: must not raise
+        finally:
+            w.stop()
+
 
 class TestModuleSeam:
     def test_disabled_helpers_are_noops(self):
