@@ -190,6 +190,11 @@ COMPILE_CACHE_DIR = os.path.join(_HERE, "benchmarks", ".jax_cache")
 
 
 def _metric_name():
+    if os.environ.get("BENCH_SERVE", "0") == "1":
+        # A different measurement entirely (continuous-batching decode,
+        # not training throughput): its own metric name, its own cache
+        # slot (_series_path gives foreign names their own file).
+        return "graftserve_decode_tokens_per_sec"
     # Architecture/feeding variants are suffixed so recorded numbers
     # (including failed runs) stay apples-to-apples per series.
     name = METRIC
@@ -214,6 +219,11 @@ def _metric_name():
         # look like a throughput regression. Never pinned.
         name += "_warm"
     return name
+
+
+def _unit():
+    return ("tokens/sec" if os.environ.get("BENCH_SERVE", "0") == "1"
+            else "images/sec")
 
 
 def _probe_backend(timeout=None):
@@ -408,6 +418,14 @@ def _requested_config():
     mismatch). Values reflect the post-pin environment; `pinned` lists
     the keys best_pin.json supplied.
     """
+    if os.environ.get("BENCH_SERVE", "0") == "1":
+        # The serve series' fair-game knobs — none of the training
+        # knobs apply (it measures the decode engine, not the Trainer).
+        return {
+            "serve": True,
+            "slots": _env_int("BENCH_SERVE_SLOTS", 8),
+            "waves": _env_int("BENCH_SERVE_WAVES", 0),
+        }
     cfg = {
         "batch": BATCH,
         "image": IMAGE,
@@ -495,7 +513,7 @@ def _emit_fallback(last_err, extra=None):
     record = {
         "metric": _metric_name(),
         "value": 0.0,
-        "unit": "images/sec",
+        "unit": _unit(),
         "vs_baseline": 0.0,
         "error": last_err,
         "requested_config": requested,
@@ -533,7 +551,7 @@ def _emit_skipped(diagnosis, probes):
     _print_record({
         "metric": _metric_name(),
         "value": 0.0,
-        "unit": "images/sec",
+        "unit": _unit(),
         "vs_baseline": 0.0,
         "skipped": True,
         "skip_reason": diagnosis,
@@ -734,7 +752,114 @@ def _kernel_parity_smoke(jax):
         return "error: {}: {}".format(type(e).__name__, str(e)[:200])
 
 
+def _serve_worker():
+    """BENCH_SERVE=1: the graftserve continuous-batching series.
+
+    Measures the decode engine the way the serving smoke does — a
+    mixed-length request fleet through the Scheduler vs the
+    batch-synchronous `generate()` baseline at the SAME slot count —
+    but reports the numbers instead of enforcing a floor: tokens/sec
+    (the `value`), speedup as `vs_baseline`, requests/sec, TTFT and
+    per-token latency p50/p95/p99, plus the standard compile/transfer
+    census every bench record carries.
+    """
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    from cloud_tpu.parallel import compile_cache
+    compile_cache.enable(COMPILE_CACHE_DIR, min_compile_time_secs=1.0)
+    import jax.numpy as jnp
+
+    from cloud_tpu.parallel import runtime as runtime_lib
+    from cloud_tpu.serving import Scheduler
+    from cloud_tpu.serving.smoke import (build_model, build_requests,
+                                         run_baseline, run_serve)
+
+    slots = _env_int("BENCH_SERVE_SLOTS", 8)
+    waves = _env_int("BENCH_SERVE_WAVES", 0) or None
+    model = build_model()
+    requests = build_requests(slots, waves)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    run_baseline(model, params, requests, slots, timed=False)  # warm
+    base_tokens, base_secs = run_baseline(model, params, requests,
+                                          slots, timed=True)
+
+    t_cold = time.perf_counter()
+    pages_per_slot = model.max_seq_len // 16
+    scheduler = Scheduler(model, params, slots=slots, page_size=16,
+                          num_pages=(slots + 4) * pages_per_slot + 1,
+                          admission_window=len(requests),
+                          strict_no_retrace=True).start()
+    try:
+        buckets = sorted({scheduler._bucket(r) for r in requests})
+        scheduler.warmup(buckets,
+                         sampling_configs=[(("temperature", 0.0),)])
+        # Serve's time-to-first-step analog: engine build + the whole
+        # compile surface (prefill buckets, insert, tick, evict) to
+        # the first warm-servable state.
+        first_step_seconds = time.perf_counter() - t_cold
+        warm = runtime_lib.compile_stats()
+        _d2h_before = runtime_lib.transfer_stats()
+        _, serve_tokens, serve_secs = run_serve(scheduler, requests)
+        _d2h_after = runtime_lib.transfer_stats()
+        after = runtime_lib.compile_stats()
+        stats = scheduler.stats()
+    finally:
+        scheduler.close()
+
+    base_tps = base_tokens / base_secs
+    serve_tps = serve_tokens / serve_secs
+    _pstats = compile_cache.stats()
+    record = {
+        "metric": _metric_name(),
+        "value": round(serve_tps, 2),
+        "unit": "tokens/sec",
+        # For this series the honest baseline is the run's own
+        # batch-synchronous measurement: vs_baseline IS the
+        # continuous-batching speedup.
+        "vs_baseline": round(serve_tps / base_tps, 3),
+        "method": "continuous_vs_batch_synchronous",
+        "requests": len(requests),
+        "slots": slots,
+        "baseline_tokens_per_sec": round(base_tps, 2),
+        "requests_per_sec": round(stats["requests_per_sec"], 3),
+        "ttft_p50_s": round(stats["ttft"]["p50"], 4),
+        "ttft_p95_s": round(stats["ttft"]["p95"], 4),
+        "ttft_p99_s": round(stats["ttft"]["p99"], 4),
+        "token_latency_p50_s": round(stats["token_latency"]["p50"], 5),
+        "token_latency_p95_s": round(stats["token_latency"]["p95"], 5),
+        "token_latency_p99_s": round(stats["token_latency"]["p99"], 5),
+        "ticks": stats["ticks"],
+        # The zero-retrace contract as numbers (also enforced live by
+        # strict_no_retrace — a violation kills the run, not the lint).
+        "new_traces_post_warmup": after["n_traces"] - warm["n_traces"],
+        "new_compiles_post_warmup": (after["n_compiles"]
+                                     - warm["n_compiles"]),
+        "d2h_fetches": (_d2h_after["d2h_fetches"]
+                        - _d2h_before["d2h_fetches"]),
+        "d2h_bytes": _d2h_after["d2h_bytes"] - _d2h_before["d2h_bytes"],
+        "n_traces": after["n_traces"],
+        "n_compiles": after["n_compiles"],
+        "compile_seconds": round(after["compile_seconds"], 3),
+        "compile_cache_hits": after["cache_hits"],
+        "persistent_cache_hits": _pstats["persistent_hits"],
+        "persistent_cache_misses": _pstats["persistent_misses"],
+        "time_to_first_step_seconds": round(first_step_seconds, 3),
+        "platform": jax.default_backend(),
+        "requested_config": _requested_config(),
+    }
+    if compile_cache.is_enabled():
+        record["compile_cache_dir"] = compile_cache.cache_dir()
+    print(json.dumps(record))
+
+
 def worker():
+    if os.environ.get("BENCH_SERVE", "0") == "1":
+        _serve_worker()
+        return
     import jax
 
     if os.environ.get("BENCH_FORCE_CPU") == "1":

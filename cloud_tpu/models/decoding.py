@@ -74,6 +74,53 @@ def decode_slot_update(module, mask, batch, seq, cache_len):
     return idx, positions, allowed
 
 
+def paged_slot_update(module, mask, slots, cache_len):
+    """The per-slot (continuous-batching) counterpart of
+    `decode_slot_update`, for single-token ticks over a paged pool.
+
+    Where `decode_slot_update` advances ONE shared write pointer (all
+    examples decode in lockstep), a serving tick advances each slot
+    independently: slot s sits at its own depth `slot_steps[s]`, and an
+    inactive slot (mask 0) must not move at all. Slot-order causality
+    and validity masking are otherwise the recipe above, per row.
+
+    Cache variables created on the calling module ("cache" collection):
+      slot_steps  [S]      per-slot write pointer (tokens written)
+      slot_valid  [S, L]   True where a real token was written
+    (The page table itself is the attention module's variable — it owns
+    the physical layout; this helper owns only the logical bookkeeping.)
+
+    Returns (idx, allowed):
+      idx      [S] int32 per-slot write pointer BEFORE this call —
+               callers write this tick's k/v at logical position
+               idx[s] of slot s;
+      allowed  [S, 1, L] bool attention mask over each slot's LOGICAL
+               cache view (validity AND slot-order causality), the
+               exact mask `decode_slot_update` would produce for a
+               solo decode at the same depth.
+    """
+    slot_steps = module.variable(
+        "cache", "slot_steps", jnp.zeros, (slots,), jnp.int32)
+    slot_valid = module.variable(
+        "cache", "slot_valid", jnp.zeros, (slots, cache_len), jnp.bool_)
+
+    m = (jnp.ones((slots,), jnp.int32) if mask is None
+         else mask.reshape(slots).astype(jnp.int32))
+    idx = slot_steps.value
+    # Masked scatter: active slots validate their write position; an
+    # inactive slot OR-writes False at its (clamped) current position —
+    # the identity, so it neither moves nor changes state.
+    slot_valid.value = slot_valid.value.at[
+        jnp.arange(slots), jnp.clip(idx, 0, cache_len - 1)].max(
+            m.astype(jnp.bool_))
+    slot_steps.value = idx + m
+
+    key_slots = jnp.arange(cache_len)
+    allowed = (slot_valid.value[:, None, :]
+               & (key_slots[None, None, :] <= idx[:, None, None]))
+    return idx, allowed
+
+
 # The load-bearing fragment of the warning jax emits when donated
 # buffers can't alias (a plain `warnings.warn`, so category
 # UserWarning; jax/_src/interpreters/mlir.py). Matching a FRAGMENT
@@ -211,21 +258,101 @@ def warp_logits(logits, temperature, top_k=None, top_p=None):
         probs = jax.nn.softmax(sorted_scaled, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         keep_sorted = (cum - probs) < top_p
-        inv = jnp.argsort(sort_idx, axis=-1)
-        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        # sort_idx is a permutation per row, so its inverse is a
+        # scatter of arange — O(V), where argsort would be a third
+        # O(V log V) sort (XLA CPU sorts are the decode hot spot).
+        vocab = sort_idx.shape[-1]
+        flat = sort_idx.reshape(-1, vocab)
+        inv = jnp.zeros_like(flat).at[
+            jnp.arange(flat.shape[0])[:, None], flat].set(
+                jnp.broadcast_to(jnp.arange(vocab), flat.shape))
+        keep = jnp.take_along_axis(keep_sorted,
+                                   inv.reshape(sort_idx.shape), axis=-1)
         scaled = jnp.where(keep, scaled, -1e30)
     return scaled
+
+
+@functools.lru_cache(maxsize=256)
+def _cache_shapes(decoder, batch):
+    """Abstract decode-cache shapes for (decoder, batch), computed once
+    per config: `jax.eval_shape` re-traces the whole model every call,
+    which showed up as pure-python overhead on every generate()."""
+    return jax.eval_shape(
+        lambda: decoder.init(jax.random.PRNGKey(0),
+                             jnp.zeros((batch, 1), jnp.int32)))["cache"]
 
 
 def empty_cache(decoder, batch):
     """Zero-initialized decode-cache pytree for a decode-mode module
     (shared by `generate` and `generate_speculative`): built from the
     abstract init so no second params copy is ever materialized."""
-    shapes = jax.eval_shape(
-        lambda: decoder.init(jax.random.PRNGKey(0),
-                             jnp.zeros((batch, 1), jnp.int32)))["cache"]
+    shapes = _cache_shapes(decoder, batch)
     return jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+# --------------------------------------------------------------------------
+# Decode-cache reuse pool.
+#
+# `empty_cache` allocates a fresh HBM cache every call, so a serving loop
+# of repeated generate() calls churns allocations the size of the whole
+# KV cache at request rate. The pool below recycles them: release() parks
+# a finished call's final cache, acquire() re-zeros a parked one IN PLACE
+# (a donated jitted tree-zero, so XLA aliases the buffers instead of
+# allocating) and hands it back. Keyed on (decoder, batch) — the pair
+# that fixes every leaf shape. Bounded per key so a burst can't pin
+# unbounded HBM; thread-safe for concurrent generate() callers.
+
+_CACHE_POOL = {}
+_CACHE_POOL_LOCK = None
+_CACHE_POOL_DEPTH = 2  # parked caches per (decoder, batch) key
+
+
+def _pool_lock():
+    global _CACHE_POOL_LOCK
+    if _CACHE_POOL_LOCK is None:
+        import threading
+        _CACHE_POOL_LOCK = threading.Lock()
+    return _CACHE_POOL_LOCK
+
+
+@functools.lru_cache(maxsize=None)
+def _zero_in_place():
+    from cloud_tpu.parallel import runtime
+
+    @functools.partial(runtime.instrumented_jit, donate_argnums=0)
+    def zero(cache):
+        return jax.tree_util.tree_map(jnp.zeros_like, cache)
+    return best_effort_donation(zero)
+
+
+def acquire_cache(decoder, batch):
+    """A zeroed decode cache for (decoder, batch): a recycled buffer
+    when one is parked, a fresh `empty_cache` otherwise."""
+    with _pool_lock():
+        parked = _CACHE_POOL.get((decoder, batch))
+        cache = parked.pop() if parked else None
+    if cache is None:
+        return empty_cache(decoder, batch)
+    return _zero_in_place()(cache)
+
+
+def release_cache(decoder, batch, cache):
+    """Parks a finished decode's final cache for reuse. The caller must
+    not touch `cache` afterwards (the next acquire donates it). Drops
+    the cache on the floor (normal GC) when the pool is full."""
+    if cache is None:
+        return
+    with _pool_lock():
+        parked = _CACHE_POOL.setdefault((decoder, batch), [])
+        if len(parked) < _CACHE_POOL_DEPTH:
+            parked.append(cache)
+
+
+def clear_cache_pool():
+    """Empties the reuse pool (test isolation; frees the parked HBM)."""
+    with _pool_lock():
+        _CACHE_POOL.clear()
 
 
 def decode_latency_start():
@@ -277,7 +404,8 @@ def decode_latency_finish(start, n_tokens, result=None):
     tele.observe_decode(n_tokens, elapsed_ns / 1e9)
 
 
-__all__ = ["best_effort_donation", "bucket_length",
-           "decode_latency_finish", "decode_latency_start",
-           "decode_slot_update", "empty_cache", "validate_prompt_mask",
+__all__ = ["acquire_cache", "best_effort_donation", "bucket_length",
+           "clear_cache_pool", "decode_latency_finish",
+           "decode_latency_start", "decode_slot_update", "empty_cache",
+           "paged_slot_update", "release_cache", "validate_prompt_mask",
            "warp_logits"]

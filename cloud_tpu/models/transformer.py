@@ -32,6 +32,11 @@ class CausalSelfAttention(nn.Module):
     decode: bool = False  # autoregressive KV-cache mode
     cache_len: int = 0  # cache size (tokens); set by TransformerLM
     causal: bool = True  # False = bidirectional (encoder) attention
+    # Paged-pool decode (serving): > 0 swaps the per-example dense cache
+    # for a shared physical page pool with per-slot page tables and
+    # per-slot write pointers (continuous batching; serving/kvpool.py).
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -50,7 +55,10 @@ class CausalSelfAttention(nn.Module):
         if self.decode:
             # mask (optional [B, S]) marks REAL incoming tokens — the
             # left-padded-prompt contract (generate(prompt_mask=)).
-            out = self._decode_attention(q, k, v, mask)
+            if self.page_size:
+                out = self._paged_decode_attention(q, k, v, mask)
+            else:
+                out = self._decode_attention(q, k, v, mask)
         elif self.attention_impl in SEQUENCE_PARALLEL_IMPLS:
             # Sequence-parallel long-context paths over the mesh's "sp"
             # axis: "ring" rotates K/V around a ppermute ring
@@ -111,6 +119,81 @@ class CausalSelfAttention(nn.Module):
         weights = nn.softmax(logits, axis=-1).astype(self.compute_dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", weights, cached_v.value)
 
+    def _paged_decode_attention(self, q, k, v, mask=None):
+        """Single-token decode over the paged KV pool (continuous
+        batching). The batch dimension is SLOTS, each at its own depth:
+        physical K/V live in a shared page pool `[num_pages, page_size,
+        H, D]`, each slot's logical `[cache_len]` view is its page
+        table's gather over the pool. Writes are per-slot scatters at
+        `slot_steps[s]`; insertion/eviction are index updates on the
+        page table and validity rows (serving/engine.py), so the tick
+        executable never retraces.
+
+        Per-slot math is EXACTLY `_decode_attention`'s per-row math
+        over the gathered logical view (same masking, same f32 einsum),
+        which is what makes engine tokens bit-identical to solo
+        `generate()` — see tests/unit/test_serving.py.
+
+        The scratch page (physical page 0) is never handed out by the
+        pool allocator: freed/empty page-table rows are all 0, so an
+        inactive slot's write lands in scratch and its garbage is
+        masked to exact-zero weight, never attended by anyone.
+        """
+        from cloud_tpu.models.decoding import paged_slot_update
+
+        slots, seq, heads, head_dim = q.shape
+        if seq != 1:
+            raise ValueError(
+                "paged decode ticks are single-token (seq=1); prefill "
+                "runs on the dense path and is inserted by the engine.")
+        if not self.cache_len or self.cache_len % self.page_size:
+            raise ValueError(
+                "cache_len ({}) must be a positive multiple of "
+                "page_size ({}).".format(self.cache_len, self.page_size))
+        if self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "scratch page).")
+        pages_per_slot = self.cache_len // self.page_size
+        key_pages = self.variable(
+            "cache", "key_pages", jnp.zeros,
+            (self.num_pages, self.page_size, heads, head_dim),
+            self.compute_dtype)
+        value_pages = self.variable(
+            "cache", "value_pages", jnp.zeros,
+            (self.num_pages, self.page_size, heads, head_dim),
+            self.compute_dtype)
+        page_table = self.variable(
+            "cache", "page_table", jnp.zeros, (slots, pages_per_slot),
+            jnp.int32)
+
+        idx, allowed = paged_slot_update(self, mask, slots,
+                                         self.cache_len)
+        # Physical write target for this tick's token: slot s's page
+        # for logical position idx[s]. Inactive/evicted slots resolve
+        # to page 0 (scratch) via their zeroed page-table row.
+        phys = jnp.take_along_axis(
+            page_table.value, (idx // self.page_size)[:, None], 1)[:, 0]
+        off = idx % self.page_size
+        key_pages.value = key_pages.value.at[phys, off].set(
+            k[:, 0].astype(self.compute_dtype))
+        value_pages.value = value_pages.value.at[phys, off].set(
+            v[:, 0].astype(self.compute_dtype))
+
+        # Logical per-slot [cache_len] views: one gather per tick. (A
+        # fused paged-attention kernel would skip the materialization;
+        # at these model sizes the gather is cheap and keeps the math
+        # bit-identical to the dense path.)
+        k_view = key_pages.value[page_table.value].reshape(
+            slots, self.cache_len, heads, head_dim)
+        v_view = value_pages.value[page_table.value].reshape(
+            slots, self.cache_len, heads, head_dim)
+        scale = 1.0 / np.sqrt(head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_view,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(allowed[:, None], logits, -1e30)
+        weights = nn.softmax(logits, axis=-1).astype(self.compute_dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, v_view)
+
 
 class TransformerBlock(nn.Module):
     num_heads: int
@@ -123,6 +206,8 @@ class TransformerBlock(nn.Module):
     cache_len: int = 0
     causal: bool = True
     norm_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5
+    page_size: int = 0  # paged-pool decode (serving); see attention
+    num_pages: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
@@ -133,6 +218,8 @@ class TransformerBlock(nn.Module):
                                 decode=self.decode,
                                 cache_len=self.cache_len,
                                 causal=self.causal,
+                                page_size=self.page_size,
+                                num_pages=self.num_pages,
                                 name="attention")(y, mask)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
@@ -176,6 +263,11 @@ class TransformerLM(nn.Module):
     moe_experts: int = 0
     decode: bool = False  # autoregressive KV-cache mode (see generate())
     norm_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5
+    # Paged-pool decode (serving/engine.py): kv_page_size > 0 swaps the
+    # dense per-example cache for the shared page pool with per-slot
+    # page tables (requires decode=True; batch dim becomes slots).
+    kv_page_size: int = 0
+    kv_num_pages: int = 0
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -211,6 +303,8 @@ class TransformerLM(nn.Module):
                                  decode=self.decode,
                                  cache_len=self.max_seq_len,
                                  norm_eps=self.norm_eps,
+                                 page_size=self.kv_page_size,
+                                 num_pages=self.kv_num_pages,
                                  name="block_%d" % i)(
                                      x, mask, deterministic)
         x = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
@@ -376,10 +470,13 @@ def generate(model,
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    from cloud_tpu.models.decoding import bucket_length, empty_cache
+    from cloud_tpu.models.decoding import (acquire_cache, bucket_length,
+                                           release_cache)
 
     decoder = model.clone(decode=True, dropout_rate=0.0)
-    cache = empty_cache(decoder, batch)
+    # Reuse pool, not a fresh HBM allocation per call: a parked cache
+    # from a previous generate() is re-zeroed in place when available.
+    cache = acquire_cache(decoder, batch)
 
     prefill, decode_steps = _decode_fns(
         decoder, float(temperature),
@@ -412,10 +509,14 @@ def generate(model,
                            mask_arg)
     out = [first[:, None]]
     if max_new_tokens > 1:
-        toks = decode_steps(params, cache, first,
-                            jax.random.split(rng, max_new_tokens - 1))
+        cache, toks = decode_steps(
+            params, cache, first,
+            jax.random.split(rng, max_new_tokens - 1))
         out.append(jnp.transpose(toks, (1, 0)))
     result = jnp.concatenate([prompt] + out, axis=1)
+    # Park the final cache for the next call's acquire (its contents
+    # are dead weight; the acquire re-zeros it in place).
+    release_cache(decoder, batch, cache)
     decode_latency_finish(latency, max_new_tokens, result)
     return result
 
@@ -472,9 +573,11 @@ def _decode_fns(decoder, temperature, top_k, top_p, eos_token):
 
         done = (first_token == eos_token) if eos_token is not None \
             else jnp.zeros(first_token.shape, bool)
-        (_, _, _), toks = jax.lax.scan(
+        (cache, _, _), toks = jax.lax.scan(
             step, (cache, first_token, done), step_rngs)
-        return toks  # [T-1, B]
+        # The final cache rides back out so generate() can park it in
+        # the reuse pool (donation aliases it over the input buffers).
+        return cache, toks  # toks: [T-1, B]
 
     from cloud_tpu.models.decoding import best_effort_donation
     return best_effort_donation(prefill), best_effort_donation(

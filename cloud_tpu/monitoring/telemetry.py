@@ -67,6 +67,17 @@ SPAN_HISTOGRAMS = {
 DECODE_TOKEN_HISTOGRAM = "cloud_tpu_decode_token_latency_seconds"
 MFU_GAUGE = "cloud_tpu_mfu_pct_peak"
 
+#: graftserve (serving/scheduler.py) metric names. The scheduler feeds
+#: these through `telemetry.get().registry` under the same
+#: zero-cost-when-off discipline as the decode hooks.
+SERVE_REQUESTS_TOTAL = "cloud_tpu_serve_requests_total"
+SERVE_TOKENS_TOTAL = "cloud_tpu_serve_tokens_total"
+SERVE_REQUESTS_PER_SEC = "cloud_tpu_serve_requests_per_sec"
+SERVE_QUEUE_DEPTH = "cloud_tpu_serve_queue_depth"
+SERVE_ACTIVE_SLOTS = "cloud_tpu_serve_active_slots"
+SERVE_TTFT_HISTOGRAM = "cloud_tpu_serve_ttft_seconds"
+SERVE_TOKEN_HISTOGRAM = "cloud_tpu_serve_token_latency_seconds"
+
 
 class Counter:
     """Monotonic counter (int)."""

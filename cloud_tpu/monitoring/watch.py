@@ -80,7 +80,8 @@ logger = logging.getLogger("cloud_tpu")
 
 __all__ = ["Watchdog", "write_blackbox", "install", "uninstall",
            "current", "enabled", "env_enabled", "env_scope",
-           "heartbeat", "notify_step", "notify_reentry", "check"]
+           "heartbeat", "notify_step", "notify_reentry", "check",
+           "rewatch"]
 
 #: Spans / job events kept in the blackbox tail.
 BLACKBOX_SPAN_TAIL = 100
@@ -379,6 +380,16 @@ class Watchdog:
         """One liveness heartbeat (boundary work, eval batches)."""
         self._last_beat = time.monotonic()
 
+    def rewatch(self, tid=None):
+        """Re-aims the async-raise target at `tid` (default: the
+        calling thread) and beats. A loop that adopts an installed
+        watchdog — graftserve's tick thread — calls this once so a
+        stall interrupts the thread that is actually stuck, not
+        whichever thread ran install()."""
+        self._watched_tid = (threading.get_ident() if tid is None
+                             else tid)
+        self._last_beat = time.monotonic()
+
     def notify_step(self, step=None):
         """One COMPLETED train step: beats and advances the step
         census the blackbox reports as `last_step`."""
@@ -629,6 +640,14 @@ def check():
     w = _watchdog
     if w is not None:
         w.check()
+
+
+def rewatch(tid=None):
+    """Hands the installed watchdog to the calling thread (async-raise
+    target). No-op when disabled."""
+    w = _watchdog
+    if w is not None:
+        w.rewatch(tid)
 
 
 def notify_reentry():

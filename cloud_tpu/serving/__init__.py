@@ -1,0 +1,25 @@
+"""graftserve: continuous-batching decode over a paged KV-cache pool.
+
+See cloud_tpu/serving/README.md for the architecture. Public surface:
+
+- `PagePool` — host-side physical page accounting (kvpool.py)
+- `DecodeEngine` — slot-indexed jitted tick/insert/evict (engine.py)
+- `Scheduler`/`ServeRequest`/`ServeResult` — threads, admission,
+  backpressure, telemetry (scheduler.py)
+"""
+
+from cloud_tpu.serving.engine import (DecodeEngine, PrefillResult,
+                                      RetraceError)
+from cloud_tpu.serving.kvpool import PagePool
+from cloud_tpu.serving.scheduler import (Scheduler, ServeRequest,
+                                         ServeResult)
+
+__all__ = [
+    "DecodeEngine",
+    "PagePool",
+    "PrefillResult",
+    "RetraceError",
+    "Scheduler",
+    "ServeRequest",
+    "ServeResult",
+]

@@ -1,0 +1,388 @@
+"""graftserve decode engine: slot-indexed continuous decode tick.
+
+One persistent jitted executable (`tick`) advances every active slot one
+token per call over the paged KV pool. Requests enter mid-flight — a
+dense prefill (compiled per pow2 bucket, off the tick's critical path)
+is scattered into a free slot's pages by the `insert` executable — and
+leave mid-flight: the `evict` executable zeros the finished slots'
+page-table/validity rows without stopping the tick. All three are
+`runtime.instrumented_jit` sites with fixed shapes, so after warm-up the
+compile counters are a retrace sentinel the engine can enforce.
+
+Bit-identical contract: a request decoded through the engine produces
+exactly the tokens `models.transformer.generate()` would produce for it
+solo (same rng, same sampling config). The engine reuses generate()'s
+OWN prefill executable and rng schedule, and the paged tick reproduces
+the dense decode math per slot — per-slot sampling parameters are
+dynamic arrays whose disabled values (top_k = vocab, top_p = 1.0) are
+exact no-ops, so one tick executable serves every sampling config. See
+tests/unit/test_serving.py for the enforced oracle.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cloud_tpu.parallel import runtime
+
+
+class RetraceError(RuntimeError):
+    """The warm engine traced or compiled something new — a static-shape
+    leak in the serving path (the retrace sentinel)."""
+
+
+@dataclasses.dataclass
+class PrefillResult:
+    """A prefilled request waiting for slot insertion."""
+    first_token: int        # sampled from the prompt's last position
+    pcache: object          # dense [1, L] decode cache (device)
+    step_keys: np.ndarray   # [K, 2] uint32, generate()'s split schedule
+    bucket: int             # pow2 prefill bucket (pages were sized off it)
+    n_steps: int            # max_new_tokens for this request
+
+
+def _plain(tree):
+    """Nested-Mapping pytree -> plain dicts (flax may hand back
+    FrozenDicts; keep one structure so donation pairs buffers)."""
+    try:
+        items = tree.items()
+    except AttributeError:
+        return tree
+    return {k: _plain(v) for k, v in items}
+
+
+def _map_attention(cache, fn, *rest):
+    """Applies `fn` to every paged-attention subtree (detected by its
+    `key_pages` variable), walking `rest` trees in parallel."""
+    if isinstance(cache, dict):
+        if "key_pages" in cache:
+            return fn(cache, *rest)
+        return {k: _map_attention(cache[k], fn,
+                                  *[r[k] if isinstance(r, dict) else r
+                                    for r in rest])
+                for k in cache}
+    return cache
+
+
+def _sample_one(logits, key, temperature, top_k, top_p):
+    """One slot's sampler: `generate()`'s sample() with the sampling
+    config as runtime values. Disabled values are exact identities —
+    top_k = vocab keeps every logit, top_p = 1.0 selects the unwarped
+    branch, temperature = 0 selects greedy — so the warped results are
+    bitwise those of `decoding.warp_logits` with the static config.
+    """
+    lf = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    # kth-largest VALUE equals lax.top_k(...)[0][-1] for any tie
+    # pattern, so the `< kth` mask matches the static warper's.
+    kth = jnp.take(jnp.flip(jnp.sort(lf)), top_k - 1)
+    lk = jnp.where(lf < kth, -1e30, lf)
+    scaled = lk / jnp.where(temperature > 0.0, temperature, 1.0)
+    # Nucleus membership in descending sorted order, scattered back
+    # through the inverse permutation — warp_logits' exact recipe
+    # (including its scatter-built inverse).
+    sort_idx = jnp.flip(jnp.argsort(scaled))
+    sorted_scaled = scaled[sort_idx]
+    probs = jax.nn.softmax(sorted_scaled)
+    cum = jnp.cumsum(probs)
+    inv = jnp.zeros_like(sort_idx).at[sort_idx].set(
+        jnp.arange(sort_idx.shape[0]))
+    keep = (cum - probs < top_p)[inv]
+    warped = jnp.where(top_p < 1.0,
+                       jnp.where(keep, scaled, -1e30), scaled)
+    sampled = jax.random.categorical(key, warped).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def _sample_slots(logits, keys, temperature, top_k, top_p):
+    """All-slot sampler with a greedy fast path: the sorts behind
+    top-k/top-p cost more than the whole model apply at smoke scale
+    (XLA CPU sort), so a tick whose ACTIVE traffic is all greedy picks
+    the argmax branch at runtime — one executable either way, and the
+    sampled branch is `_sample_one` verbatim."""
+    greedy = jnp.argmax(logits.astype(jnp.float32),
+                        axis=-1).astype(jnp.int32)
+    return jax.lax.cond(
+        jnp.any(temperature > 0.0),
+        lambda: jax.vmap(_sample_one)(logits, keys, temperature,
+                                      top_k, top_p),
+        lambda: greedy)
+
+
+class DecodeEngine:
+    """Continuous-batching decode over `slots` slots of a paged pool.
+
+    Single-owner device state: exactly one thread may call
+    `insert`/`tick`/`evict` (the scheduler's tick thread); `prefill`
+    is safe to call concurrently from an admission thread.
+    """
+
+    def __init__(self, model, params, slots, page_size, num_pages,
+                 max_new_cap=None):
+        from cloud_tpu.models.transformer import TransformerLM
+
+        if not isinstance(model, TransformerLM):
+            raise NotImplementedError(
+                "graftserve v1 serves TransformerLM (dense causal "
+                "attention); got {}.".format(type(model).__name__))
+        if model.max_seq_len % page_size:
+            raise ValueError(
+                "max_seq_len ({}) must be a multiple of page_size "
+                "({}).".format(model.max_seq_len, page_size))
+        self.model = model
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.pages_per_slot = model.max_seq_len // page_size
+        self.max_seq_len = model.max_seq_len
+        self.max_new_cap = int(max_new_cap or model.max_seq_len)
+        if self.max_new_cap < 2:
+            raise ValueError("max_new_cap must be >= 2.")
+        self._params = params
+        # The SAME decode clone generate() derives, so the engine's
+        # prefill executables and cache-pool entries are shared with
+        # solo generate() calls in the process.
+        self._dense = model.clone(decode=True, dropout_rate=0.0)
+        self._paged = model.clone(decode=True, dropout_rate=0.0,
+                                  kv_page_size=page_size,
+                                  kv_num_pages=num_pages)
+
+        from cloud_tpu.models.decoding import (best_effort_donation,
+                                               empty_cache)
+        self.cache = _plain(empty_cache(self._paged, self.slots))
+        key_width = self.max_new_cap - 1
+        self.ctl = {
+            "active": jnp.zeros((slots,), jnp.bool_),
+            "done": jnp.zeros((slots,), jnp.bool_),
+            "cur_tok": jnp.zeros((slots,), jnp.int32),
+            "steps_done": jnp.zeros((slots,), jnp.int32),
+            "max_steps": jnp.zeros((slots,), jnp.int32),
+            "temperature": jnp.zeros((slots,), jnp.float32),
+            "top_k": jnp.ones((slots,), jnp.int32),
+            "top_p": jnp.ones((slots,), jnp.float32),
+            "eos": jnp.zeros((slots,), jnp.int32),
+            "has_eos": jnp.zeros((slots,), jnp.bool_),
+            "step_keys": jnp.zeros((slots, key_width, 2), jnp.uint32),
+        }
+        self._tick = best_effort_donation(functools.partial(
+            runtime.instrumented_jit, donate_argnums=(1, 2))(
+                self._tick_impl))
+        self._insert = best_effort_donation(functools.partial(
+            runtime.instrumented_jit, donate_argnums=(0, 1))(
+                self._insert_impl))
+        self._evict = best_effort_donation(functools.partial(
+            runtime.instrumented_jit, donate_argnums=(0, 1))(
+                self._evict_impl))
+        self._warm_stats = None
+
+    # -- prefill (admission thread) -----------------------------------
+
+    def prefill(self, prompt, max_new_tokens, rng, sampling):
+        """Dense prefill for one request, exactly `generate()`'s path:
+        same bucket, same left-pad + mask, same executable (shared
+        `_decode_fns` entry), same rng split schedule. `sampling` is a
+        normalized dict: temperature (float), top_k (int|None), top_p
+        (float|None), eos_token (int|None). Returns a `PrefillResult`;
+        blocks until the first token is on host (the TTFT point)."""
+        from cloud_tpu.models.decoding import (acquire_cache,
+                                               bucket_length)
+        from cloud_tpu.models.transformer import _decode_fns
+
+        prompt = np.asarray(prompt, np.int32).reshape(1, -1)
+        prompt_len = prompt.shape[1]
+        prefill_fn, _ = _decode_fns(
+            self._dense, float(sampling["temperature"]),
+            sampling["top_k"], sampling["top_p"], sampling["eos_token"])
+        key, prefill_rng = jax.random.split(rng)
+        mask_arg = None
+        prefill_tokens = jnp.asarray(prompt)
+        bucket = bucket_length(prompt_len,
+                               self.max_seq_len - max_new_tokens)
+        if bucket > prompt_len:
+            pad = bucket - prompt_len
+            prefill_tokens = jnp.pad(prefill_tokens, ((0, 0), (pad, 0)))
+            mask_arg = jnp.pad(jnp.ones((1, prompt_len), bool),
+                               ((0, 0), (pad, 0)))
+        cache = acquire_cache(self._dense, 1)
+        pcache, first = prefill_fn(self._params, cache, prefill_tokens,
+                                   prefill_rng, mask_arg)
+        step_keys = np.zeros((self.max_new_cap - 1, 2), np.uint32)
+        if max_new_tokens > 1:
+            step_keys[:max_new_tokens - 1] = np.asarray(
+                jax.random.split(key, max_new_tokens - 1))
+        first_host = int(runtime.device_fetch(first)[0])
+        return PrefillResult(first_token=first_host, pcache=pcache,
+                             step_keys=step_keys, bucket=bucket,
+                             n_steps=int(max_new_tokens))
+
+    def release_prefill(self, result):
+        """Parks a consumed (or abandoned) prefill's dense cache back
+        in the decode-cache reuse pool."""
+        from cloud_tpu.models.decoding import release_cache
+        release_cache(self._dense, 1, result.pcache)
+        result.pcache = None
+
+    # -- slot ops (tick thread) ---------------------------------------
+
+    def insert(self, slot, result, page_vec, sampling):
+        """Writes a prefilled request into free slot `slot`: scatters
+        the dense prefill cache into the reserved pages, installs the
+        page-table/validity/step rows, and arms the slot's control row
+        (sampling params, rng schedule, eos latch). One fixed-shape
+        executable for every bucket — the prefill cache is always
+        full-length dense."""
+        vocab = self.model.vocab_size
+        top_k = sampling["top_k"]
+        top_p = sampling["top_p"]
+        eos = sampling["eos_token"]
+        self.cache, self.ctl = self._insert(
+            self.cache, self.ctl, _plain(result.pcache),
+            np.int32(slot), jnp.asarray(page_vec, jnp.int32),
+            jnp.asarray(result.step_keys),
+            np.int32(result.n_steps), np.int32(result.first_token),
+            np.float32(sampling["temperature"]),
+            np.int32(vocab if top_k is None else top_k),
+            np.float32(1.0 if top_p is None else top_p),
+            np.int32(0 if eos is None else eos),
+            bool(eos is not None))
+        self.release_prefill(result)
+
+    def tick(self):
+        """Advances every active slot one token. Returns the device
+        out-array `[2, S]` (row 0: sampled token, row 1: finished flag)
+        — the scheduler fetches it with `runtime.device_fetch`."""
+        self.cache, self.ctl, out = self._tick(
+            self._params, self.cache, self.ctl)
+        return out
+
+    def evict(self, evict_mask):
+        """Frees every slot where `evict_mask` is True: page-table and
+        validity rows go back to scratch/zero, the control row disarms.
+        The physical page ids go back to the host pool separately
+        (scheduler bookkeeping)."""
+        self.cache, self.ctl = self._evict(
+            self.cache, self.ctl, jnp.asarray(evict_mask, bool))
+
+    # -- retrace sentinel ---------------------------------------------
+
+    def mark_warm(self):
+        """Snapshots the compile counters; `check_no_retrace()` raises
+        on any growth after this point."""
+        self._warm_stats = runtime.compile_stats()
+
+    def check_no_retrace(self):
+        if self._warm_stats is None:
+            return
+        now = runtime.compile_stats()
+        grew = {k: now[k] - self._warm_stats[k]
+                for k in ("n_traces", "n_compiles")
+                if now[k] > self._warm_stats[k]}
+        if grew:
+            raise RetraceError(
+                "serving path traced/compiled after warm-up: {} "
+                "(static-shape leak).".format(grew))
+
+    # -- jitted bodies ------------------------------------------------
+
+    def _tick_impl(self, params, cache, ctl):
+        active = ctl["active"]
+        logits, vars_ = self._paged.apply(
+            {"params": params, "cache": cache},
+            ctl["cur_tok"][:, None], active[:, None], mutable=["cache"])
+        logits = logits[:, 0]  # [S, V]
+        # Slot s's step i consumes generate()'s step_rngs[i]; after
+        # insertion steps_done is 1 (the prefill token), so the first
+        # tick reads key row 0.
+        key_idx = jnp.clip(ctl["steps_done"] - 1, 0,
+                           ctl["step_keys"].shape[1] - 1)
+        keys = jnp.take_along_axis(
+            ctl["step_keys"], key_idx[:, None, None], 1)[:, 0]
+        # Inactive slots keep their stale sampling rows; zeroing the
+        # temperature they feed the sampler keeps the greedy fast path
+        # available whenever the LIVE traffic is all-greedy.
+        live_temp = jnp.where(active, ctl["temperature"], 0.0)
+        nxt = _sample_slots(logits, keys, live_temp, ctl["top_k"],
+                            ctl["top_p"])
+        latched = ctl["has_eos"] & ctl["done"]
+        nxt = jnp.where(latched, ctl["eos"], nxt)
+        done = ctl["done"] | (ctl["has_eos"] & (nxt == ctl["eos"]))
+        steps = ctl["steps_done"] + active.astype(jnp.int32)
+        finished = active & (done | (steps >= ctl["max_steps"]))
+        out_ctl = dict(ctl)
+        out_ctl["cur_tok"] = jnp.where(active, nxt, ctl["cur_tok"])
+        out_ctl["done"] = jnp.where(active, done, ctl["done"])
+        out_ctl["steps_done"] = steps
+        out = jnp.stack([jnp.where(active, nxt, -1),
+                         finished.astype(jnp.int32)])
+        return _plain(vars_["cache"]), out_ctl, out
+
+    def _insert_impl(self, cache, ctl, pcache, slot, page_vec,
+                     step_keys_row, max_steps, first_tok, temperature,
+                     top_k, top_p, eos, has_eos):
+        ppn, page = self.pages_per_slot, self.page_size
+
+        def scatter(att, patt):
+            out = dict(att)
+            # Reserved ids are unique and nonzero, so real chunks land
+            # exactly; the duplicate scratch entries all carry the
+            # prefill cache's zero tail (never read either way).
+            chunks_k = patt["cached_key"][0].reshape(
+                ppn, page, *patt["cached_key"].shape[2:])
+            chunks_v = patt["cached_value"][0].reshape(
+                ppn, page, *patt["cached_value"].shape[2:])
+            out["key_pages"] = att["key_pages"].at[page_vec].set(chunks_k)
+            out["value_pages"] = att["value_pages"].at[page_vec].set(
+                chunks_v)
+            out["page_table"] = att["page_table"].at[slot].set(page_vec)
+            out["slot_steps"] = att["slot_steps"].at[slot].set(
+                patt["cache_index"])
+            out["slot_valid"] = att["slot_valid"].at[slot].set(
+                patt["slot_valid"][0])
+            return out
+
+        new_cache = _map_attention(cache, scatter, pcache)
+        new_cache["pos_count"] = cache["pos_count"].at[slot].set(
+            pcache["pos_count"][0])
+        out_ctl = dict(ctl)
+        out_ctl["active"] = ctl["active"].at[slot].set(True)
+        out_ctl["done"] = ctl["done"].at[slot].set(
+            has_eos & (first_tok == eos))
+        out_ctl["cur_tok"] = ctl["cur_tok"].at[slot].set(first_tok)
+        out_ctl["steps_done"] = ctl["steps_done"].at[slot].set(1)
+        out_ctl["max_steps"] = ctl["max_steps"].at[slot].set(max_steps)
+        out_ctl["temperature"] = ctl["temperature"].at[slot].set(
+            temperature)
+        out_ctl["top_k"] = ctl["top_k"].at[slot].set(top_k)
+        out_ctl["top_p"] = ctl["top_p"].at[slot].set(top_p)
+        out_ctl["eos"] = ctl["eos"].at[slot].set(eos)
+        out_ctl["has_eos"] = ctl["has_eos"].at[slot].set(has_eos)
+        out_ctl["step_keys"] = ctl["step_keys"].at[slot].set(
+            step_keys_row)
+        return new_cache, out_ctl
+
+    def _evict_impl(self, cache, ctl, evict_mask):
+        keep = ~evict_mask
+
+        def clear(att):
+            out = dict(att)
+            out["page_table"] = jnp.where(keep[:, None],
+                                          att["page_table"], 0)
+            out["slot_steps"] = jnp.where(keep, att["slot_steps"], 0)
+            out["slot_valid"] = att["slot_valid"] & keep[:, None]
+            return out
+
+        new_cache = _map_attention(cache, clear)
+        new_cache["pos_count"] = jnp.where(keep, cache["pos_count"], 0)
+        out_ctl = dict(ctl)
+        out_ctl["active"] = ctl["active"] & keep
+        out_ctl["done"] = ctl["done"] & keep
+        out_ctl["steps_done"] = jnp.where(keep, ctl["steps_done"], 0)
+        out_ctl["cur_tok"] = jnp.where(keep, ctl["cur_tok"], 0)
+        out_ctl["max_steps"] = jnp.where(keep, ctl["max_steps"], 0)
+        return new_cache, out_ctl
+
+
+__all__ = ["DecodeEngine", "PrefillResult", "RetraceError"]

@@ -1,0 +1,130 @@
+"""Paged KV-cache pool: host-side physical page accounting.
+
+The physical pages themselves live in HBM as flax cache variables of the
+paged decoder (`key_pages`/`value_pages` `[num_pages, page_size, H, D]`
+per attention layer — models/transformer.py `_paged_decode_attention`).
+This module owns the other half of the design: WHICH physical pages each
+request holds. Reservation happens at admission (before any HBM is
+touched for the request), so exhaustion surfaces as scheduler
+backpressure — a blocked reserve — never as an OOM or a reshape/retrace
+of the pool executable. The device side only ever sees page-id ARRAYS
+(page-table rows), so allocation and free are in-graph index updates on
+executables of fixed shape.
+
+Page 0 is the scratch page: it is never handed out, and every freed or
+never-filled page-table entry points at it. Inactive slots write their
+(masked, never-attended) tick garbage there, which is what makes
+cross-request leakage structurally impossible — a slot's table can only
+reference pages reserved for it, or scratch.
+"""
+
+import threading
+
+import numpy as np
+
+
+class PagePool:
+    """Free-list allocator over `num_pages` physical pages.
+
+    Thread-safe; `reserve` blocks (condition wait) until enough pages
+    are free, which is the backpressure primitive the scheduler builds
+    on. All bookkeeping is host-side python — the device never sees
+    this object, only the page-id vectors it emits.
+    """
+
+    def __init__(self, num_pages, page_size, pages_per_slot):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "scratch page); got {}.".format(num_pages))
+        if page_size < 1 or pages_per_slot < 1:
+            raise ValueError("page_size and pages_per_slot must be "
+                             ">= 1.")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.pages_per_slot = int(pages_per_slot)
+        self._cond = threading.Condition()
+        # LIFO free list: recently-freed pages are re-handed first
+        # (warm in whatever cache hierarchy the backend keeps).
+        self._free = list(range(1, self.num_pages))
+        self._closed = False
+
+    @property
+    def capacity(self):
+        """Allocatable pages (scratch excluded)."""
+        return self.num_pages - 1
+
+    def available(self):
+        with self._cond:
+            return len(self._free)
+
+    def pages_needed(self, prompt_bucket, max_new_tokens):
+        """Pages a request holds for its lifetime: one slot writes
+        `bucket + max_new - 1` cache positions (the final sampled token
+        is returned but never written back)."""
+        tokens = prompt_bucket + max(int(max_new_tokens) - 1, 0)
+        need = -(-tokens // self.page_size)  # ceil
+        if need > self.pages_per_slot:
+            raise ValueError(
+                "request needs {} pages but a slot addresses only {} "
+                "({} tokens / page_size {}).".format(
+                    need, self.pages_per_slot,
+                    self.pages_per_slot * self.page_size,
+                    self.page_size))
+        return need
+
+    def reserve(self, n, timeout=None):
+        """Takes `n` pages off the free list, blocking until available.
+
+        Returns the list of page ids, or None on timeout/close. A
+        request for more than `capacity` pages raises immediately —
+        waiting could never succeed (the deadlock the scheduler's
+        submit-time validation also rejects).
+        """
+        n = int(n)
+        if n == 0:
+            return []
+        if n > self.capacity:
+            raise ValueError(
+                "cannot reserve {} pages from a pool of {} allocatable "
+                "pages.".format(n, self.capacity))
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._closed or len(self._free) >= n,
+                timeout=timeout)
+            if self._closed or not ok:
+                return None
+            return [self._free.pop() for _ in range(n)]
+
+    def free(self, page_ids):
+        """Returns pages to the free list and wakes blocked reservers."""
+        if not page_ids:
+            return
+        with self._cond:
+            for pid in page_ids:
+                pid = int(pid)
+                if not 1 <= pid < self.num_pages:
+                    raise ValueError(
+                        "page id {} outside pool [1, {}).".format(
+                            pid, self.num_pages))
+                if pid in self._free:
+                    raise ValueError(
+                        "double free of page {}.".format(pid))
+                self._free.append(pid)
+            self._cond.notify_all()
+
+    def close(self):
+        """Unblocks every waiting reserve with None (shutdown path)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def page_vec(self, page_ids):
+        """A full-width page-table row for `page_ids`: the reserved ids
+        in logical order, scratch (0) beyond them. Fixed [pages_per_slot]
+        shape keeps the insert executable monomorphic."""
+        vec = np.zeros((self.pages_per_slot,), np.int32)
+        vec[:len(page_ids)] = page_ids
+        return vec
+
+
+__all__ = ["PagePool"]

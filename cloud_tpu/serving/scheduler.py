@@ -1,0 +1,546 @@
+"""graftserve request scheduler: admission, batching, backpressure.
+
+Two threads around a `DecodeEngine`:
+
+- the ADMISSION thread pops submitted requests from a bounded queue in
+  FCFS windows, orders each window longest-prefix-first (big pow2
+  prefill buckets first — they hold their slot longest, so starting
+  them earliest minimizes tail latency), reserves KV pages (BLOCKING
+  when the pool is exhausted — backpressure, never OOM), and runs the
+  dense prefill off the tick's critical path;
+- the TICK thread owns the engine's device state: it inserts ready
+  prefills into free slots, advances all active slots one token per
+  tick, fetches the tick output (the serving loop's single counted d2h
+  round trip), completes/evicts finished slots, and returns their
+  pages.
+
+Liveness rides graftwatch: the tick thread beats the installed watchdog
+every iteration and polls `watch.check()`, so a stuck tick surfaces as
+the watchdog's typed fault (graftwatch blackbox + `BackendUnavailable`)
+instead of a silent hang. Throughput/latency ride graftscope: requests
+and tokens totals, queue-depth and active-slots gauges, TTFT and
+per-token latency histograms (p50/p95/p99 via the registry snapshot).
+
+Phase labels: the tick thread runs under `runtime.set_phase
+("serve_tick")`, the admission thread under "serve_prefill" — distinct
+from the training "step" phase, so graftsan GS001 (d2h-in-step-loop)
+correctly treats the per-tick fetch as a sanctioned, attributed read.
+"""
+
+import collections
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from cloud_tpu.parallel import runtime
+from cloud_tpu.serving.engine import DecodeEngine
+from cloud_tpu.serving.kvpool import PagePool
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One decode request. Semantics (and output) match
+    `generate(model, params, prompt[None], max_new_tokens,
+    rng=PRNGKey(rng_seed), ...)` exactly — the determinism contract."""
+    prompt: Sequence[int]
+    max_new_tokens: int
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token: Optional[int] = None
+    rng_seed: int = 0
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """A completed request: `tokens` is prompt + continuation, the
+    `generate()` row contract."""
+    tokens: np.ndarray
+    ttft_s: float
+    latency_s: float
+
+
+class _Slot:
+    __slots__ = ("request", "pages", "emitted", "future", "t_submit",
+                 "ttft_s")
+
+    def __init__(self, request, pages, future, t_submit, ttft_s):
+        self.request = request
+        self.pages = pages
+        self.emitted = []
+        self.future = future
+        self.t_submit = t_submit
+        self.ttft_s = ttft_s
+
+
+def _registry():
+    """graftscope registry when telemetry is enabled, else None — the
+    decode hooks' zero-cost-when-off discipline."""
+    import sys
+    telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+    if telemetry is None:
+        return None
+    tele = telemetry.get()
+    if tele is None or not tele.active:
+        return None
+    return tele.registry
+
+
+class Scheduler:
+    """Continuous-batching front door. `submit()` from any thread;
+    results come back as futures resolving to `ServeResult`."""
+
+    def __init__(self, model, params, slots=4, page_size=16,
+                 num_pages=None, max_new_cap=None, max_queue=64,
+                 admission_window=8, strict_no_retrace=False):
+        if num_pages is None:
+            # Default: every slot can hold a full-length sequence, plus
+            # scratch — paging then bounds fragmentation, not memory.
+            num_pages = slots * (model.max_seq_len // page_size) + 1
+        self.engine = DecodeEngine(model, params, slots, page_size,
+                                   num_pages, max_new_cap=max_new_cap)
+        self.pool = PagePool(num_pages, page_size,
+                             self.engine.pages_per_slot)
+        self.strict_no_retrace = bool(strict_no_retrace)
+        self._admission_window = int(admission_window)
+        self._admit_q = queue.Queue(maxsize=max_queue)
+        self._ready = collections.deque()
+        self._ready_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._failure = None
+        self._slots = [None] * self.engine.slots
+        self._free_slots = list(range(self.engine.slots))
+        self._started = False
+        self._t_start = None
+        self._completed = 0
+        self._tokens_out = 0
+        self._ticks = 0
+        # Requests admitted but not yet slot-resident. While > 0 and
+        # slots are free, the tick loop briefly yields so inserts land
+        # before the next tick — a tick advancing 2 of 8 slots costs
+        # the same device work as a full one (the batch-synchronous
+        # waste this engine exists to avoid).
+        self._pending_inserts = 0
+        from cloud_tpu.monitoring.telemetry import Histogram
+        self._ttft_hist = Histogram("ttft")
+        self._token_hist = Histogram("token_latency")
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self):
+        if self._started:
+            return self
+        self._started = True
+        self._t_start = time.monotonic()
+        self._prefill_thread = threading.Thread(
+            target=self._prefill_loop, name="graftserve-prefill",
+            daemon=True)
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name="graftserve-tick", daemon=True)
+        self._prefill_thread.start()
+        self._tick_thread.start()
+        return self
+
+    def close(self):
+        """Stops both threads; pending/queued requests fail with a
+        RuntimeError (or the loop's typed fault, if one fired)."""
+        if not self._started:
+            return
+        self._stop.set()
+        self.pool.close()
+        self._wake.set()
+        self._prefill_thread.join(timeout=30)
+        self._tick_thread.join(timeout=30)
+        error = self._failure or RuntimeError("scheduler closed")
+        self._fail_pending(error)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, request, timeout=None):
+        """Admits one request; returns a Future[ServeResult]. Blocks
+        (then raises queue.Full) when the bounded admission queue is
+        full — backpressure, by design, reaches the caller."""
+        if self._failure is not None:
+            raise self._failure
+        self._validate(request)
+        future = Future()
+        t_submit = time.monotonic()
+        if request.max_new_tokens == 0:
+            future.set_result(ServeResult(
+                tokens=np.asarray(request.prompt, np.int32),
+                ttft_s=0.0, latency_s=0.0))
+            return future
+        if request.max_new_tokens > 1:
+            self._pending_inserts += 1
+        self._admit_q.put((request, future, t_submit), timeout=timeout)
+        self._observe_queue()
+        return future
+
+    def _validate(self, request):
+        model = self.engine.model
+        prompt_len = len(request.prompt)
+        if prompt_len < 1:
+            raise ValueError("prompt must be non-empty.")
+        if request.max_new_tokens < 0:
+            raise ValueError("max_new_tokens must be >= 0.")
+        if prompt_len + request.max_new_tokens > model.max_seq_len:
+            raise ValueError(
+                "prompt ({}) + max_new_tokens ({}) exceeds max_seq_len "
+                "{}.".format(prompt_len, request.max_new_tokens,
+                             model.max_seq_len))
+        if request.max_new_tokens > self.engine.max_new_cap:
+            raise ValueError(
+                "max_new_tokens ({}) exceeds the engine's max_new_cap "
+                "({}).".format(request.max_new_tokens,
+                               self.engine.max_new_cap))
+        if request.top_k is not None and not (
+                1 <= request.top_k <= model.vocab_size):
+            raise ValueError("top_k must be in [1, vocab_size={}]; got "
+                             "{}.".format(model.vocab_size,
+                                          request.top_k))
+        if request.top_p is not None and not (
+                0.0 < request.top_p <= 1.0):
+            raise ValueError("top_p must be in (0, 1]; got {}.".format(
+                request.top_p))
+        if request.max_new_tokens > 1:
+            # Raises when no reservation could EVER satisfy it.
+            need = self.pool.pages_needed(self._bucket(request),
+                                          request.max_new_tokens)
+            if need > self.pool.capacity:
+                raise ValueError(
+                    "request needs {} pages; the pool has {} "
+                    "allocatable.".format(need, self.pool.capacity))
+
+    def _bucket(self, request):
+        from cloud_tpu.models.decoding import bucket_length
+        return bucket_length(
+            len(request.prompt),
+            self.engine.max_seq_len - request.max_new_tokens)
+
+    @staticmethod
+    def _sampling(request):
+        return {
+            "temperature": float(request.temperature),
+            "top_k": None if request.top_k is None
+            else int(request.top_k),
+            "top_p": None if request.top_p is None
+            else float(request.top_p),
+            "eos_token": None if request.eos_token is None
+            else int(request.eos_token),
+        }
+
+    # -- admission/prefill thread -------------------------------------
+
+    def _prefill_loop(self):
+        runtime.set_phase("serve_prefill")
+        while not self._stop.is_set():
+            window = self._next_window()
+            if not window:
+                continue
+            # Longest-prefix-first within the FCFS window (stable sort:
+            # equal buckets stay FCFS).
+            window.sort(key=lambda item: -self._bucket(item[0]))
+            for request, future, t_submit in window:
+                if self._stop.is_set():
+                    return
+                try:
+                    self._admit_one(request, future, t_submit)
+                except BaseException as exc:  # noqa: BLE001
+                    if request.max_new_tokens > 1:
+                        self._pending_inserts -= 1
+                    future.set_exception(exc)
+
+    def _next_window(self):
+        window = []
+        try:
+            window.append(self._admit_q.get(timeout=0.05))
+        except queue.Empty:
+            return window
+        while len(window) < self._admission_window:
+            try:
+                window.append(self._admit_q.get_nowait())
+            except queue.Empty:
+                break
+        self._observe_queue()
+        return window
+
+    def _admit_one(self, request, future, t_submit):
+        sampling = self._sampling(request)
+        pages = []
+        if request.max_new_tokens > 1:
+            need = self.pool.pages_needed(self._bucket(request),
+                                          request.max_new_tokens)
+            while not self._stop.is_set():
+                pages = self.pool.reserve(need, timeout=0.2)
+                if pages is not None:
+                    break
+            if pages is None:  # shutdown while blocked on the pool
+                self._pending_inserts -= 1
+                future.set_exception(RuntimeError("scheduler closed"))
+                return
+        try:
+            result = self.engine.prefill(
+                np.asarray(request.prompt, np.int32),
+                request.max_new_tokens,
+                jax.random.PRNGKey(request.rng_seed), sampling)
+        except BaseException:
+            if pages:
+                self.pool.free(pages)
+            raise
+        ttft = time.monotonic() - t_submit
+        self._ttft_hist.observe(ttft)
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.histogram(telemetry.SERVE_TTFT_HISTOGRAM).observe(ttft)
+        if request.max_new_tokens == 1:
+            # Completes at prefill: no slot, no pages, no tick.
+            self.engine.release_prefill(result)
+            self._complete(request, future, t_submit, ttft,
+                           [result.first_token])
+            return
+        with self._ready_lock:
+            self._ready.append(_ReadyItem(request, result, pages,
+                                          future, t_submit, ttft))
+        self._wake.set()
+
+    # -- tick thread --------------------------------------------------
+
+    def _tick_loop(self):
+        runtime.set_phase("serve_tick")
+        from cloud_tpu.monitoring import watch
+        # Adopt an installed graftwatch: the tick thread becomes the
+        # beat source AND the async-raise target, so a stuck tick is
+        # the thread the stall fault interrupts (typed
+        # BackendUnavailable + blackbox), not a silent hang.
+        watch.rewatch()
+        skips = 0
+        try:
+            while not self._stop.is_set():
+                if watch.enabled():
+                    watch.heartbeat()
+                    watch.check()
+                self._insert_ready()
+                if not any(s is not None for s in self._slots):
+                    if not self._wake.wait(timeout=0.05):
+                        continue
+                    self._wake.clear()
+                    continue
+                if (self._free_slots and self._pending_inserts > 0
+                        and skips < 40):
+                    # Admissions are in flight and slots are open:
+                    # yield briefly so the insert lands before the
+                    # next tick. The skip cap bounds the stall when an
+                    # admission is itself blocked on pages only ticks
+                    # can free.
+                    skips += 1
+                    self._wake.wait(timeout=0.005)
+                    self._wake.clear()
+                    continue
+                skips = 0
+                t0 = time.monotonic()
+                out = self.engine.tick()
+                fetched = runtime.device_fetch(out)
+                elapsed = time.monotonic() - t0
+                self._ticks += 1
+                self._distribute(fetched, elapsed)
+                if self.strict_no_retrace:
+                    self.engine.check_no_retrace()
+        except BaseException as exc:  # noqa: BLE001
+            self._failure = exc
+            self._stop.set()
+            self.pool.close()
+            self._fail_pending(exc)
+
+    def _insert_ready(self):
+        while self._free_slots:
+            with self._ready_lock:
+                if not self._ready:
+                    return
+                item = self._ready.popleft()
+            slot = self._free_slots.pop()
+            state = _Slot(item.request, item.pages, item.future,
+                          item.t_submit, item.ttft_s)
+            state.emitted.append(item.result.first_token)
+            self._slots[slot] = state
+            self.engine.insert(slot, item.result,
+                               self.pool.page_vec(item.pages),
+                               self._sampling(item.request))
+            self._pending_inserts -= 1
+            self._observe_gauges()
+
+    def _distribute(self, fetched, elapsed):
+        tokens_row, finished_row = fetched[0], fetched[1]
+        n_active = sum(s is not None for s in self._slots)
+        if n_active:
+            self._token_hist.observe(elapsed, count=n_active)
+            reg = _registry()
+            if reg is not None:
+                from cloud_tpu.monitoring import telemetry
+                reg.histogram(telemetry.SERVE_TOKEN_HISTOGRAM).observe(
+                    elapsed, count=n_active)
+        evict_mask = np.zeros((self.engine.slots,), bool)
+        for slot, state in enumerate(self._slots):
+            if state is None:
+                continue
+            state.emitted.append(int(tokens_row[slot]))
+            if finished_row[slot]:
+                evict_mask[slot] = True
+                self._slots[slot] = None
+                self._free_slots.append(slot)
+                self.pool.free(state.pages)
+                self._complete(state.request, state.future,
+                               state.t_submit, state.ttft_s,
+                               state.emitted)
+        if evict_mask.any():
+            self.engine.evict(evict_mask)
+            self._observe_gauges()
+
+    def _complete(self, request, future, t_submit, ttft, emitted):
+        # Early-eos eviction: generate() keeps emitting eos after done,
+        # so the bit-identical fill is pure host work.
+        if len(emitted) < request.max_new_tokens:
+            emitted = emitted + [request.eos_token] * (
+                request.max_new_tokens - len(emitted))
+        tokens = np.concatenate([
+            np.asarray(request.prompt, np.int32),
+            np.asarray(emitted, np.int32)])
+        latency = time.monotonic() - t_submit
+        self._completed += 1
+        self._tokens_out += request.max_new_tokens
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.counter(telemetry.SERVE_REQUESTS_TOTAL).inc()
+            reg.counter(telemetry.SERVE_TOKENS_TOTAL).inc(
+                request.max_new_tokens)
+            wall = max(time.monotonic() - self._t_start, 1e-9)
+            reg.gauge(telemetry.SERVE_REQUESTS_PER_SEC).set(
+                self._completed / wall)
+        future.set_result(ServeResult(tokens=tokens, ttft_s=ttft,
+                                      latency_s=latency))
+
+    # -- shared helpers -----------------------------------------------
+
+    def _observe_queue(self):
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.gauge(telemetry.SERVE_QUEUE_DEPTH).set(
+                self._admit_q.qsize())
+
+    def _observe_gauges(self):
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.gauge(telemetry.SERVE_ACTIVE_SLOTS).set(
+                sum(s is not None for s in self._slots))
+            reg.gauge(telemetry.SERVE_QUEUE_DEPTH).set(
+                self._admit_q.qsize())
+
+    def _fail_pending(self, error):
+        self._pending_inserts = 0
+        with self._ready_lock:
+            ready, self._ready = list(self._ready), collections.deque()
+        for item in ready:
+            if not item.future.done():
+                item.future.set_exception(error)
+        for slot, state in enumerate(self._slots):
+            if state is not None and not state.future.done():
+                state.future.set_exception(error)
+            self._slots[slot] = None
+        while True:
+            try:
+                _, future, _ = self._admit_q.get_nowait()
+            except queue.Empty:
+                break
+            if not future.done():
+                future.set_exception(error)
+
+    # -- warm-up + stats ----------------------------------------------
+
+    def warmup(self, buckets, sampling_configs=((),), max_new=3):
+        """Compiles the whole serving surface for `buckets` x sampling
+        configs: per-bucket prefill (masked and exact-length variants),
+        insert, tick, evict, and the cache-reuse re-zero. Two
+        sequential waves so the second wave's prefills acquire parked
+        caches (compiling the in-place zero executable). Call
+        `engine.mark_warm()` is implicit — after warmup the retrace
+        sentinel is armed."""
+        configs = []
+        for cfg in sampling_configs:
+            merged = dict(temperature=0.0, top_k=None, top_p=None,
+                          eos_token=None)
+            merged.update(dict(cfg))
+            configs.append(merged)
+        for _ in range(2):
+            futures = []
+            for bucket in buckets:
+                for length in {bucket, max(bucket - 1, 1)}:
+                    if self._bucket(ServeRequest(
+                            prompt=[1] * length,
+                            max_new_tokens=max_new)) != bucket:
+                        continue
+                    for cfg in configs:
+                        futures.append(self.submit(ServeRequest(
+                            prompt=[1] * length,
+                            max_new_tokens=max_new, **cfg)))
+            for future in futures:
+                future.result(timeout=600)
+        self.engine.mark_warm()
+        # Warm-up TTFTs are compile times; restart the host-side stats
+        # so `stats()` describes warm traffic only.
+        from cloud_tpu.monitoring.telemetry import Histogram
+        self._ttft_hist = Histogram("ttft")
+        self._token_hist = Histogram("token_latency")
+        self._completed = 0
+        self._tokens_out = 0
+        self._ticks = 0
+        self._t_start = time.monotonic()
+
+    def stats(self):
+        """Host-side rollup for bench/smoke (works with telemetry
+        off)."""
+        wall = max(time.monotonic() - (self._t_start or
+                                       time.monotonic()), 1e-9)
+        return {
+            "requests_completed": self._completed,
+            "tokens_emitted": self._tokens_out,
+            "ticks": self._ticks,
+            "elapsed_seconds": wall,
+            "requests_per_sec": self._completed / wall,
+            "tokens_per_sec": self._tokens_out / wall,
+            "ttft": self._ttft_hist.snapshot(),
+            "token_latency": self._token_hist.snapshot(),
+            "queue_depth": self._admit_q.qsize(),
+        }
+
+
+class _ReadyItem:
+    __slots__ = ("request", "result", "pages", "future", "t_submit",
+                 "ttft_s")
+
+    def __init__(self, request, result, pages, future, t_submit,
+                 ttft_s):
+        self.request = request
+        self.result = result
+        self.pages = pages
+        self.future = future
+        self.t_submit = t_submit
+        self.ttft_s = ttft_s
+
+
+__all__ = ["ServeRequest", "ServeResult", "Scheduler"]

@@ -1,0 +1,242 @@
+"""graftserve smoke: the continuous-vs-synchronous proof, CPU-sized.
+
+`python -m cloud_tpu.serving.smoke` runs ≥8 concurrent mixed-length
+requests through the scheduler and enforces the serving acceptance
+contract end to end:
+
+1. THROUGHPUT — aggregate tokens/sec must be >= MIN_SPEEDUP (2.0) times
+   a batch-synchronous baseline: `generate()` over FCFS arrival-order
+   batches at the SAME slot count, each batch running to its longest
+   member's max_new_tokens (the hostage effect continuous batching
+   exists to kill). Both sides are timed warm.
+2. ZERO RETRACE — after `Scheduler.warmup()`, the whole serve pass must
+   add zero traces and zero compiles (`runtime.compile_stats` delta;
+   the engine's sentinel also runs in strict mode every tick).
+3. BIT-IDENTICAL / NO LEAKAGE — every served request's tokens must
+   equal its solo `generate()` decode exactly. Slots are reused across
+   requests (more requests than slots), so equality is also the
+   cross-request leakage check: a stale page or validity row would
+   corrupt some continuation.
+
+Writes `serving_smoke.json` (summary) next to the graftscope artifacts
+(`telemetry.jsonl` etc.) in --out-dir; CI uploads the directory.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+MIN_SPEEDUP = 2.0
+
+
+def build_model():
+    """CPU-friendly but big enough that a decode tick is device-bound
+    (the host round trip per tick must not dominate the comparison)."""
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import TransformerLM
+    return TransformerLM(vocab_size=1024, num_layers=6, num_heads=6,
+                         d_model=384, d_ff=1536, max_seq_len=64,
+                         compute_dtype=jnp.float32)
+
+
+def build_requests(slots, waves=None):
+    """Mixed-length arrival pattern, one long + (slots-1) shorts per
+    wave: under FCFS batch-synchronous decode every batch is hostage to
+    its long request; under continuous batching the shorts stream
+    through the other slots."""
+    from cloud_tpu.serving import ServeRequest
+
+    if waves is None:
+        # One long per slot: all longs decode concurrently, so the
+        # serve makespan stays near ONE long (48 ticks) while the
+        # baseline pays 48 steps per hostage batch.
+        waves = slots
+    rng = np.random.default_rng(42)
+    requests = []
+    for wave in range(waves):
+        specs = [(int(rng.integers(9, 17)), 48)]
+        specs += [(int(rng.integers(3, 9)), int(rng.integers(1, 4)))
+                  for _ in range(slots - 1)]
+        for plen, max_new in specs:
+            requests.append(ServeRequest(
+                prompt=rng.integers(1, 512, (plen,)).astype(
+                    np.int32).tolist(),
+                max_new_tokens=max_new, temperature=0.0,
+                rng_seed=1000 + len(requests)))
+    return requests
+
+
+def solo_oracle(model, params, requests):
+    """Per-request solo generate() — the bit-identical reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import generate
+    outs = []
+    for req in requests:
+        toks = generate(model, params,
+                        jnp.asarray(req.prompt, jnp.int32)[None],
+                        req.max_new_tokens,
+                        rng=jax.random.PRNGKey(req.rng_seed),
+                        temperature=req.temperature, top_k=req.top_k,
+                        top_p=req.top_p, eos_token=req.eos_token)
+        outs.append(np.asarray(toks)[0])
+    return outs
+
+
+def run_baseline(model, params, requests, slots, timed):
+    """Batch-synchronous decode: FCFS batches of `slots`, left-padded,
+    each run for its longest member's max_new_tokens. Returns (useful
+    tokens, seconds) — useful counts only each request's OWN budget."""
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import generate
+
+    t0 = time.monotonic()
+    useful = 0
+    for lo in range(0, len(requests), slots):
+        batch = requests[lo:lo + slots]
+        width = max(len(r.prompt) for r in batch)
+        tokens = np.zeros((len(batch), width), np.int32)
+        mask = np.zeros((len(batch), width), bool)
+        for row, req in enumerate(batch):
+            tokens[row, width - len(req.prompt):] = req.prompt
+            mask[row, width - len(req.prompt):] = True
+        out = generate(model, params, jnp.asarray(tokens),
+                       max(r.max_new_tokens for r in batch),
+                       rng=jax.random.PRNGKey(0), temperature=0.0,
+                       prompt_mask=jnp.asarray(mask))
+        jax.block_until_ready(out)
+        useful += sum(r.max_new_tokens for r in batch)
+    elapsed = time.monotonic() - t0
+    return (useful, elapsed) if timed else (useful, None)
+
+
+def run_serve(scheduler, requests):
+    t0 = time.monotonic()
+    futures = [scheduler.submit(req, timeout=30) for req in requests]
+    results = [f.result(timeout=600) for f in futures]
+    elapsed = time.monotonic() - t0
+    return results, sum(r.max_new_tokens for r in requests), elapsed
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out-dir", default=os.environ.get(
+        "CLOUD_TPU_TELEMETRY_DIR", "serving-smoke-out"))
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--min-speedup", type=float, default=float(
+        os.environ.get("CLOUD_TPU_SMOKE_MIN_SPEEDUP", MIN_SPEEDUP)))
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.monitoring import telemetry, watch
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.serving import Scheduler
+
+    model = build_model()
+    requests = build_requests(args.slots)
+    assert len(requests) >= 8, "smoke must run >= 8 concurrent requests"
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    print("[smoke] solo oracle ({} requests)".format(len(requests)))
+    oracle = solo_oracle(model, params, requests)
+    print("[smoke] batch-synchronous baseline (slots={})".format(
+        args.slots))
+    run_baseline(model, params, requests, args.slots, timed=False)
+    base_tokens, base_secs = run_baseline(model, params, requests,
+                                          args.slots, timed=True)
+
+    telemetry.enable(args.out_dir)
+    watch.install(stall_deadline=120.0, out_dir=args.out_dir)
+    # Pool sized past slots*pages_per_slot: the extra pages let queued
+    # requests hold reservations (prefill done, awaiting a slot) while
+    # every slot is busy — admission overlaps the tick loop.
+    pages_per_slot = model.max_seq_len // 16
+    scheduler = Scheduler(model, params, slots=args.slots, page_size=16,
+                          num_pages=(args.slots + 4) * pages_per_slot
+                          + 1,
+                          admission_window=len(requests),
+                          strict_no_retrace=True).start()
+    try:
+        buckets = sorted({scheduler._bucket(r) for r in requests})
+        print("[smoke] warmup over buckets {}".format(buckets))
+        scheduler.warmup(buckets,
+                         sampling_configs=[(("temperature", 0.0),)])
+        warm = runtime.compile_stats()
+        print("[smoke] serve pass")
+        results, serve_tokens, serve_secs = run_serve(scheduler,
+                                                      requests)
+        after = runtime.compile_stats()
+    finally:
+        scheduler.close()
+        watch.uninstall()
+
+    mismatches = [i for i, (res, ref) in enumerate(zip(results, oracle))
+                  if not np.array_equal(res.tokens, ref)]
+    new_traces = after["n_traces"] - warm["n_traces"]
+    new_compiles = after["n_compiles"] - warm["n_compiles"]
+    base_tps = base_tokens / base_secs
+    serve_tps = serve_tokens / serve_secs
+    speedup = serve_tps / base_tps
+    stats = scheduler.stats()
+
+    summary = {
+        "requests": len(requests),
+        "slots": args.slots,
+        "baseline_tokens_per_sec": base_tps,
+        "serve_tokens_per_sec": serve_tps,
+        "speedup": speedup,
+        "min_speedup": args.min_speedup,
+        "new_traces_post_warmup": new_traces,
+        "new_compiles_post_warmup": new_compiles,
+        "mismatched_requests": mismatches,
+        "ttft_p50_s": stats["ttft"].get("p50"),
+        "token_latency_p99_s": stats["token_latency"].get("p99"),
+        "requests_per_sec": stats["requests_per_sec"],
+    }
+    os.makedirs(args.out_dir, exist_ok=True)
+    with open(os.path.join(args.out_dir, "serving_smoke.json"),
+              "w") as fh:
+        json.dump(summary, fh, indent=2, sort_keys=True)
+    tele = telemetry.get()
+    if tele is not None:
+        tele.flush(wait=True)
+        telemetry.disable()
+
+    print("[smoke] baseline {:.1f} tok/s | serve {:.1f} tok/s | "
+          "speedup {:.2f}x (floor {:.1f}x)".format(
+              base_tps, serve_tps, speedup, args.min_speedup))
+    print("[smoke] post-warmup traces={} compiles={} | "
+          "mismatches={}".format(new_traces, new_compiles,
+                                 len(mismatches)))
+    failures = []
+    if speedup < args.min_speedup:
+        failures.append("speedup {:.2f}x < {:.1f}x".format(
+            speedup, args.min_speedup))
+    if new_traces or new_compiles:
+        failures.append("retrace after warmup ({} traces, {} "
+                        "compiles)".format(new_traces, new_compiles))
+    if mismatches:
+        failures.append("requests {} diverged from solo generate() "
+                        "(cross-request leakage or rng drift)".format(
+                            mismatches))
+    if failures:
+        print("[smoke] FAIL: " + "; ".join(failures))
+        return 1
+    print("[smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

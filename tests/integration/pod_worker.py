@@ -21,9 +21,27 @@ import jax
 # grid). The site hook pins JAX_PLATFORMS to the TPU tunnel, so the CPU
 # switch must be a config update, not an env var.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices",
-                  int(os.environ.get("CLOUD_TPU_TEST_LOCAL_DEVICES",
-                                     "4")))
+_local_devices = int(os.environ.get("CLOUD_TPU_TEST_LOCAL_DEVICES", "4"))
+try:
+    jax.config.update("jax_num_cpu_devices", _local_devices)
+except AttributeError:
+    # Older jax (<= 0.4.x) has no jax_num_cpu_devices option; the
+    # pre-config-option spelling is the XLA flag. The backend has not
+    # been initialized yet (no device query above), so appending to
+    # XLA_FLAGS here still takes effect at client creation.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count={}".format(
+            _local_devices))
+
+# Cross-process collectives on the CPU backend need an explicit
+# implementation on jax versions where the default is still "none"
+# (newer releases default to gloo; without it the pod psum raises
+# "Multiprocess computations aren't implemented on the CPU backend").
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except (AttributeError, ValueError):
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))

@@ -28,19 +28,35 @@ LLM_NOTEBOOK = os.path.join(REPO_ROOT, "examples",
                             "llm_finetune_notebook.ipynb")
 
 
+def _collective_timeout_flags():
+    """Raised collective-call timeouts: under full-suite parallel load
+    the CPU all-reduce rendezvous threads can be starved past the 20s
+    default, SIGABRTing the subprocess (round-3 flake). The flags only
+    exist in newer XLA bundles — on older jaxlibs an unknown XLA flag
+    is itself a hard SIGABRT, so gate on the jaxlib version."""
+    import jaxlib
+    try:
+        major, minor, patch = (
+            int(p) for p in jaxlib.__version__.split(".")[:3])
+    except ValueError:
+        return ""
+    if (major, minor, patch) < (0, 5, 0):
+        return ""
+    return (
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=240"
+    )
+
+
 def _mesh_env(**extra):
     """Subprocess env for running converted notebooks on a virtual CPU
-    mesh. 4 devices (not 8) and raised collective-call timeouts: under
-    full-suite parallel load the CPU all-reduce rendezvous threads can
-    be starved past the 20s default, SIGABRTing the subprocess
-    (round-3 flake)."""
+    mesh (4 devices, not 8)."""
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         XLA_FLAGS=(
-            "--xla_force_host_platform_device_count=4 "
-            "--xla_cpu_collective_call_warn_stuck_timeout_seconds=60 "
-            "--xla_cpu_collective_call_terminate_timeout_seconds=240"
+            "--xla_force_host_platform_device_count=4"
+            + _collective_timeout_flags()
         ),
         PYTHONPATH=REPO_ROOT,
         # Persistent compile cache: repeated runs (CI retries, the 10x

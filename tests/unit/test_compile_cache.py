@@ -231,7 +231,8 @@ class TestDecodeBucketing:
 
     def test_varied_prompt_lengths_share_executables(self):
         """The bucket census cap: three prompt lengths in one bucket
-        compile ONE prefill (+ one decode scan), not three."""
+        compile ONE prefill (+ one decode scan, + the cache pool's
+        one-time re-zero executable), never a per-length prefill."""
         from cloud_tpu.models import TransformerLM, generate
 
         model = TransformerLM(vocab_size=17, num_layers=1, num_heads=2,
@@ -243,13 +244,22 @@ class TestDecodeBucketing:
 
         runtime.reset_compile_stats()
         outs = {}
-        for length in (5, 6, 7):
+        for length in (5, 6):
             p = prompt[:, :length]
             outs[length] = generate(model, params, p, 4,
                                     temperature=0.0)
             assert outs[length].shape == (1, length + 4)
+        # Call 1: prefill + decode scan. Call 2: +1 for the in-place
+        # zero of the reacquired pool cache (the executable that
+        # replaced per-call HBM allocation) — and nothing else.
         stats = runtime.compile_stats()
-        assert stats["n_traces"] == 2, stats
+        assert stats["n_traces"] == 3, stats
+        # Every further length in the bucket rides entirely warm.
+        runtime.reset_compile_stats()
+        outs[7] = generate(model, params, prompt, 4, temperature=0.0)
+        assert outs[7].shape == (1, 11)
+        stats = runtime.compile_stats()
+        assert stats["n_traces"] == 0, stats
 
         # Bucketing is output-invisible: same tokens as the unbucketed
         # exact-shape dispatch (the left-padded-mask parity contract).
