@@ -105,6 +105,14 @@ def _env_int(key, default):
     except (TypeError, ValueError):
         return default
 
+
+def _env_float(key, default):
+    """`_env_int`'s float sibling (BENCH_SERVE_PREFIX_SHARE etc.)."""
+    try:
+        return float(os.environ.get(key, default))
+    except (TypeError, ValueError):
+        return default
+
 BATCH = _env_int("BENCH_BATCH", 256)
 IMAGE = _env_int("BENCH_IMAGE", 224)
 WARMUP_STEPS = _env_int("BENCH_WARMUP", 3)
@@ -425,6 +433,10 @@ def _requested_config():
             "serve": True,
             "slots": _env_int("BENCH_SERVE_SLOTS", 8),
             "waves": _env_int("BENCH_SERVE_WAVES", 0),
+            # graftshare knob: fraction of short requests sharing one
+            # prompt prefix (0 = no sharing, the pre-ISSUE-11 shape;
+            # the sweep runs 0 / 0.5 / 0.9).
+            "prefix_share": _env_float("BENCH_SERVE_PREFIX_SHARE", 0.0),
         }
     cfg = {
         "batch": BATCH,
@@ -752,6 +764,13 @@ def _kernel_parity_smoke(jax):
         return "error: {}: {}".format(type(e).__name__, str(e)[:200])
 
 
+def _pct(snapshot, key):
+    """Percentile from a host Histogram snapshot, None when the
+    histogram is empty (p50 of nothing reads 0.0, which would record a
+    fake perfect latency)."""
+    return round(snapshot[key], 5) if snapshot.get("count") else None
+
+
 def _serve_worker():
     """BENCH_SERVE=1: the graftserve continuous-batching series.
 
@@ -778,8 +797,9 @@ def _serve_worker():
 
     slots = _env_int("BENCH_SERVE_SLOTS", 8)
     waves = _env_int("BENCH_SERVE_WAVES", 0) or None
+    prefix_share = _env_float("BENCH_SERVE_PREFIX_SHARE", 0.0)
     model = build_model()
-    requests = build_requests(slots, waves)
+    requests = build_requests(slots, waves, prefix_share=prefix_share)
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
 
@@ -832,6 +852,20 @@ def _serve_worker():
         "token_latency_p50_s": round(stats["token_latency"]["p50"], 5),
         "token_latency_p95_s": round(stats["token_latency"]["p95"], 5),
         "token_latency_p99_s": round(stats["token_latency"]["p99"], 5),
+        # graftshare census: hit/miss TTFT split + cache effectiveness.
+        # Hit percentiles are None at prefix_share=0 (empty histogram).
+        "prefix_share": prefix_share,
+        "prefix_hit_rate": round(stats["prefix_hit_rate"], 4),
+        "prefix_hits": stats["prefix_hits"],
+        "prefix_misses": stats["prefix_misses"],
+        "prefix_tokens_served": stats["prefix_tokens_served"],
+        "ttft_hit_p50_s": _pct(stats["ttft_hit"], "p50"),
+        "ttft_hit_p95_s": _pct(stats["ttft_hit"], "p95"),
+        "ttft_hit_p99_s": _pct(stats["ttft_hit"], "p99"),
+        "ttft_miss_p50_s": _pct(stats["ttft_miss"], "p50"),
+        "ttft_miss_p95_s": _pct(stats["ttft_miss"], "p95"),
+        "ttft_miss_p99_s": _pct(stats["ttft_miss"], "p99"),
+        "cow_copies": stats["pool"]["cow_copies"],
         "ticks": stats["ticks"],
         # The zero-retrace contract as numbers (also enforced live by
         # strict_no_retrace — a violation kills the run, not the lint).

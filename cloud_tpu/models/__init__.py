@@ -10,7 +10,9 @@ from cloud_tpu.models.moe import (MoEMLP, TopKMoEMLP,
                                   expert_parallel_rules)
 from cloud_tpu.models.pipelined import PipelinedLM, pipelined_lm_rules
 from cloud_tpu.models.beam import generate_beam
-from cloud_tpu.models.speculative import generate_speculative
+from cloud_tpu.models.speculative import (SpeculativeBatchError,
+                                          SpeculativeShardingError,
+                                          generate_speculative)
 from cloud_tpu.models.hf_import import (import_hf_deepseek,
                                         import_hf_gpt2, import_hf_llama)
 from cloud_tpu.models.transformer import (TransformerEncoder,

@@ -74,9 +74,9 @@ def decode_slot_update(module, mask, batch, seq, cache_len):
     return idx, positions, allowed
 
 
-def paged_slot_update(module, mask, slots, cache_len):
+def paged_slot_update(module, mask, slots, seq, cache_len):
     """The per-slot (continuous-batching) counterpart of
-    `decode_slot_update`, for single-token ticks over a paged pool.
+    `decode_slot_update`, for decode ticks over a paged pool.
 
     Where `decode_slot_update` advances ONE shared write pointer (all
     examples decode in lockstep), a serving tick advances each slot
@@ -84,41 +84,81 @@ def paged_slot_update(module, mask, slots, cache_len):
     inactive slot (mask 0) must not move at all. Slot-order causality
     and validity masking are otherwise the recipe above, per row.
 
+    `seq` may exceed 1: the speculative tick verifies a (k+1)-token
+    window per slot in one call (serving/engine.py), writing each
+    slot's tokens at consecutive positions from its own pointer. The
+    single-token plain tick is the seq=1 specialization — the masks
+    and pointer math reduce to exactly the PR 10 forms.
+
     Cache variables created on the calling module ("cache" collection):
       slot_steps  [S]      per-slot write pointer (tokens written)
       slot_valid  [S, L]   True where a real token was written
     (The page table itself is the attention module's variable — it owns
     the physical layout; this helper owns only the logical bookkeeping.)
 
-    Returns (idx, allowed):
-      idx      [S] int32 per-slot write pointer BEFORE this call —
-               callers write this tick's k/v at logical position
-               idx[s] of slot s;
-      allowed  [S, 1, L] bool attention mask over each slot's LOGICAL
-               cache view (validity AND slot-order causality), the
-               exact mask `decode_slot_update` would produce for a
-               solo decode at the same depth.
+    Returns (pos, allowed):
+      pos      [S, seq] int32 per-token write positions — callers write
+               token j of slot s at logical position pos[s, j] (the
+               slot's pointer plus the real tokens before j);
+      allowed  [S, seq, L] bool attention mask over each slot's LOGICAL
+               cache view (validity AND slot-order causality up to each
+               query's own write position), the exact mask
+               `decode_slot_update` would produce for a solo decode at
+               the same depth.
     """
     slot_steps = module.variable(
         "cache", "slot_steps", jnp.zeros, (slots,), jnp.int32)
     slot_valid = module.variable(
         "cache", "slot_valid", jnp.zeros, (slots, cache_len), jnp.bool_)
 
-    m = (jnp.ones((slots,), jnp.int32) if mask is None
-         else mask.reshape(slots).astype(jnp.int32))
+    m = (jnp.ones((slots, seq), jnp.int32) if mask is None
+         else mask.reshape(slots, seq).astype(jnp.int32))
     idx = slot_steps.value
-    # Masked scatter: active slots validate their write position; an
+    pos = idx[:, None] + jnp.cumsum(m, 1) - m
+    # Masked scatter: active slots validate their write positions; an
     # inactive slot OR-writes False at its (clamped) current position —
     # the identity, so it neither moves nor changes state.
     slot_valid.value = slot_valid.value.at[
-        jnp.arange(slots), jnp.clip(idx, 0, cache_len - 1)].max(
-            m.astype(jnp.bool_))
-    slot_steps.value = idx + m
+        jnp.arange(slots)[:, None],
+        jnp.clip(pos, 0, cache_len - 1)].max(m.astype(jnp.bool_))
+    slot_steps.value = idx + m.sum(axis=1)
 
     key_slots = jnp.arange(cache_len)
     allowed = (slot_valid.value[:, None, :]
-               & (key_slots[None, None, :] <= idx[:, None, None]))
-    return idx, allowed
+               & (key_slots[None, None, :] <= pos[:, :, None]))
+    return pos, allowed
+
+
+def paged_slot_rewind(cache_tree, delta, cache_len):
+    """Rolls per-slot paged bookkeeping back by `delta[s]` positions:
+    the speculative tick writes a full (k+1)-token verify window, then
+    keeps only the accepted prefix — rejected positions become invalid
+    and the pointer retreats, exactly `speculative._rewind_cache`'s
+    bookkeeping-only rollback per slot. Physical page contents are NOT
+    touched: an invalidated slot is masked to exact-zero attention
+    weight and overwritten by the next real write.
+
+    `cache_tree` is a plain-dict paged cache; attention subtrees are
+    detected by their `key_pages` variable. Returns the rolled-back
+    tree (functional update).
+    """
+    def rewind(att):
+        out = dict(att)
+        steps = att["slot_steps"] - delta
+        out["slot_steps"] = steps
+        out["slot_valid"] = (att["slot_valid"]
+                             & (jnp.arange(cache_len)[None, :]
+                                < steps[:, None]))
+        return out
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            if "key_pages" in tree:
+                return rewind(tree)
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+
+    return walk(cache_tree)
 
 
 # The load-bearing fragment of the warning jax emits when donated
@@ -407,5 +447,5 @@ def decode_latency_finish(start, n_tokens, result=None):
 __all__ = ["acquire_cache", "best_effort_donation", "bucket_length",
            "clear_cache_pool", "decode_latency_finish",
            "decode_latency_start", "decode_slot_update", "empty_cache",
-           "paged_slot_update", "release_cache", "validate_prompt_mask",
-           "warp_logits"]
+           "paged_slot_rewind", "paged_slot_update", "release_cache",
+           "validate_prompt_mask", "warp_logits"]

@@ -63,6 +63,56 @@ from cloud_tpu.parallel import runtime
 _BOOKKEEPING = ("cache_index", "token_count", "pos_count")
 
 
+class SpeculativeBatchError(ValueError):
+    """`generate_speculative` is single-stream: acceptance counts
+    differ per example, which would force per-row cache rewinds the
+    batch-synchronous fused round cannot express. (The serving tick's
+    per-SLOT speculation is the batched form — serving/engine.py.)
+    Subclasses ValueError for callers that caught the untyped error."""
+
+
+class SpeculativeShardingError(NotImplementedError):
+    """`generate_speculative` decodes on a single mesh shard; a
+    sequence-parallel attention_impl on either model cannot run the
+    fused round. Subclasses NotImplementedError for callers that
+    caught the untyped error."""
+
+
+def greedy_accept(drafts, greedy):
+    """Leading-match acceptance count for greedy verification: the
+    number of proposals matching the target's own greedy choices
+    before the first mismatch, `sum(cumprod(drafts == greedy[:k]))`.
+
+    Pure and shape-generic over leading batch dims (`drafts` [..., k],
+    `greedy` [..., >=k]) — the single-stream fused round uses it at
+    [k] and the serving tick's per-slot speculation at [S, k], so the
+    two paths cannot drift (per-slot bit-identity rides on this being
+    the same math).
+    """
+    k = drafts.shape[-1]
+    accept = (drafts == greedy[..., :k]).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(accept, axis=-1), axis=-1)
+
+
+def observe_accept_rate(accepted, proposed):
+    """Feeds the shared accepted-token-rate histogram (telemetry name
+    SERVE_SPEC_ACCEPT_HISTOGRAM) — one observation per verification
+    round, value accepted/proposed in [0, 1]. Zero-cost when telemetry
+    is off: a sys.modules dict lookup, no import."""
+    import sys
+
+    telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+    if telemetry is None or not telemetry.enabled():
+        return
+    tele = telemetry.get()
+    if tele is None or not tele.active:
+        return
+    tele.registry.histogram(
+        telemetry.SERVE_SPEC_ACCEPT_HISTOGRAM,
+        start=1.0 / 64.0, factor=2.0 ** 0.5, buckets=16).observe(
+            accepted / proposed if proposed else 0.0)
+
+
 def _rewind_cache(cache, n, new_idx):
     """Roll back the last n cache slots (bookkeeping only).
 
@@ -159,8 +209,7 @@ def _greedy_round_fn(target, draft, k):
             mutable=["cache"])
         greedy = jnp.argmax(logits[0].astype(jnp.float32),
                             axis=-1).astype(jnp.int32)  # [k+1]
-        accept = (drafts == greedy[:k]).astype(jnp.int32)
-        n_acc = jnp.sum(jnp.cumprod(accept))
+        n_acc = greedy_accept(drafts, greedy)
         committed = jnp.concatenate(
             [drafts, jnp.zeros((1,), jnp.int32)])
         committed = committed.at[n_acc].set(greedy[n_acc])
@@ -306,10 +355,11 @@ def generate_speculative(model, params, draft_model, draft_params,
     """
     batch, prompt_len = prompt.shape
     if batch != 1:
-        raise ValueError(
+        raise SpeculativeBatchError(
             "generate_speculative is single-stream (batch 1); got "
-            "batch={}. Use generate() for batched decoding.".format(
-                batch))
+            "batch={}. Use generate() for batched decoding, or the "
+            "serving engine's per-slot speculation for concurrent "
+            "streams.".format(batch))
     if num_draft < 1:
         raise ValueError("num_draft must be >= 1; got {}.".format(
             num_draft))
@@ -339,7 +389,7 @@ def generate_speculative(model, params, draft_model, draft_params,
         return finish(prompt)
     for m, name in ((model, "model"), (draft_model, "draft_model")):
         if m.attention_impl in SEQUENCE_PARALLEL_IMPLS:
-            raise NotImplementedError(
+            raise SpeculativeShardingError(
                 "generate_speculative decodes on a single mesh shard; "
                 "{} uses a sequence-parallel attention_impl.".format(
                     name))
@@ -409,6 +459,7 @@ def generate_speculative(model, params, draft_model, draft_params,
         stats["rounds"] += 1
         stats["proposed"] += k
         stats["accepted_drafts"] += accepted
+        observe_accept_rate(accepted, k)
 
         seq.extend(committed)
         if eos_token is not None and eos_token in committed:
@@ -425,4 +476,6 @@ def generate_speculative(model, params, draft_model, draft_params,
     return finish(jnp.asarray([seq], jnp.int32))
 
 
-__all__ = ["generate_speculative"]
+__all__ = ["SpeculativeBatchError", "SpeculativeShardingError",
+           "generate_speculative", "greedy_accept",
+           "observe_accept_rate"]

@@ -120,19 +120,31 @@ class CausalSelfAttention(nn.Module):
         return jnp.einsum("bhqk,bkhd->bqhd", weights, cached_v.value)
 
     def _paged_decode_attention(self, q, k, v, mask=None):
-        """Single-token decode over the paged KV pool (continuous
-        batching). The batch dimension is SLOTS, each at its own depth:
-        physical K/V live in a shared page pool `[num_pages, page_size,
-        H, D]`, each slot's logical `[cache_len]` view is its page
-        table's gather over the pool. Writes are per-slot scatters at
-        `slot_steps[s]`; insertion/eviction are index updates on the
-        page table and validity rows (serving/engine.py), so the tick
-        executable never retraces.
+        """Decode over the paged KV pool (continuous batching). The
+        batch dimension is SLOTS, each at its own depth: physical K/V
+        live in a shared page pool `[num_pages, page_size, H, D]`, each
+        slot's logical `[cache_len]` view is its page table's gather
+        over the pool. Writes are per-slot scatters at `slot_steps[s]`;
+        insertion/eviction are index updates on the page table and
+        validity rows (serving/engine.py), so the tick executable never
+        retraces.
+
+        `seq` is 1 for the plain tick and k+1 for the speculative
+        verify window — each slot's tokens land at consecutive logical
+        positions from its own pointer and every query attends exactly
+        the keys a solo decode at its depth would (per-query causality
+        from `paged_slot_update`).
 
         Per-slot math is EXACTLY `_decode_attention`'s per-row math
         over the gathered logical view (same masking, same f32 einsum),
         which is what makes engine tokens bit-identical to solo
         `generate()` — see tests/unit/test_serving.py.
+
+        Pages may be SHARED between slots (radix prefix cache,
+        serving/prefixcache.py): shared pages sit strictly below every
+        holder's write pointer, so they are only ever gathered, never
+        scattered to — copy-on-write happens at insert time by routing
+        divergent content into fresh pages.
 
         The scratch page (physical page 0) is never handed out by the
         pool allocator: freed/empty page-table rows are all 0, so an
@@ -142,10 +154,6 @@ class CausalSelfAttention(nn.Module):
         from cloud_tpu.models.decoding import paged_slot_update
 
         slots, seq, heads, head_dim = q.shape
-        if seq != 1:
-            raise ValueError(
-                "paged decode ticks are single-token (seq=1); prefill "
-                "runs on the dense path and is inserted by the engine.")
         if not self.cache_len or self.cache_len % self.page_size:
             raise ValueError(
                 "cache_len ({}) must be a positive multiple of "
@@ -166,18 +174,18 @@ class CausalSelfAttention(nn.Module):
             "cache", "page_table", jnp.zeros, (slots, pages_per_slot),
             jnp.int32)
 
-        idx, allowed = paged_slot_update(self, mask, slots,
+        pos, allowed = paged_slot_update(self, mask, slots, seq,
                                          self.cache_len)
-        # Physical write target for this tick's token: slot s's page
-        # for logical position idx[s]. Inactive/evicted slots resolve
+        # Physical write targets: slot s's page for each token's
+        # logical position pos[s, j]. Inactive/evicted slots resolve
         # to page 0 (scratch) via their zeroed page-table row.
-        phys = jnp.take_along_axis(
-            page_table.value, (idx // self.page_size)[:, None], 1)[:, 0]
-        off = idx % self.page_size
+        phys = jnp.take_along_axis(page_table.value,
+                                   pos // self.page_size, 1)
+        off = pos % self.page_size
         key_pages.value = key_pages.value.at[phys, off].set(
-            k[:, 0].astype(self.compute_dtype))
+            k.astype(self.compute_dtype))
         value_pages.value = value_pages.value.at[phys, off].set(
-            v[:, 0].astype(self.compute_dtype))
+            v.astype(self.compute_dtype))
 
         # Logical per-slot [cache_len] views: one gather per tick. (A
         # fused paged-attention kernel would skip the materialization;

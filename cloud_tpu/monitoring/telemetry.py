@@ -78,6 +78,23 @@ SERVE_ACTIVE_SLOTS = "cloud_tpu_serve_active_slots"
 SERVE_TTFT_HISTOGRAM = "cloud_tpu_serve_ttft_seconds"
 SERVE_TOKEN_HISTOGRAM = "cloud_tpu_serve_token_latency_seconds"
 
+#: graftshare (prefix cache + CoW pages + tick speculation) names.
+#: Split TTFT: requests whose prompt hit the radix prefix cache prefill
+#: only their suffix, so their TTFT distribution is a different
+#: population from misses — one merged histogram would hide the win.
+SERVE_TTFT_HIT_HISTOGRAM = "cloud_tpu_serve_ttft_hit_seconds"
+SERVE_TTFT_MISS_HISTOGRAM = "cloud_tpu_serve_ttft_miss_seconds"
+SERVE_PREFIX_HIT_RATE = "cloud_tpu_serve_prefix_hit_rate"
+SERVE_PREFIX_PAGES_HELD = "cloud_tpu_serve_prefix_pages_held"
+SERVE_PREFIX_EVICTIONS = "cloud_tpu_serve_prefix_evictions_total"
+SERVE_PAGES_FREE = "cloud_tpu_serve_pages_free"
+SERVE_PAGES_SHARED = "cloud_tpu_serve_pages_shared"
+SERVE_COW_COPIES = "cloud_tpu_serve_cow_copies_total"
+#: Accepted-token rate per verification round (accepted/proposed in
+#: [0, 1]), shared by `generate_speculative` and the serving tick's
+#: per-slot speculation (models/speculative.py observe_accept_rate).
+SERVE_SPEC_ACCEPT_HISTOGRAM = "cloud_tpu_serve_spec_accepted_rate"
+
 
 class Counter:
     """Monotonic counter (int)."""

@@ -1,22 +1,44 @@
 """graftserve decode engine: slot-indexed continuous decode tick.
 
-One persistent jitted executable (`tick`) advances every active slot one
-token per call over the paged KV pool. Requests enter mid-flight — a
-dense prefill (compiled per pow2 bucket, off the tick's critical path)
-is scattered into a free slot's pages by the `insert` executable — and
+One persistent jitted executable (`tick`) advances every active slot
+over the paged KV pool — one token per call in the plain engine, up to
+`spec_k + 1` tokens per call when a draft model rides along (per-slot
+draft/verify speculation). Requests enter mid-flight — a dense prefill
+(compiled per pow2 suffix bucket, off the tick's critical path) is
+scattered into a free slot's pages by the `insert` executable — and
 leave mid-flight: the `evict` executable zeros the finished slots'
-page-table/validity rows without stopping the tick. All three are
-`runtime.instrumented_jit` sites with fixed shapes, so after warm-up the
-compile counters are a retrace sentinel the engine can enforce.
+page-table/validity rows without stopping the tick. All executables are
+`runtime.instrumented_jit` sites with fixed shapes, so after warm-up
+the compile counters are a retrace sentinel the engine can enforce.
+
+Canonical right-pad prefill (the prefix-sharing layout): prompt token i
+is written at cache slot i, the pad tail is right of the real tokens
+and invalid. Page content is therefore position-independent — the page
+holding positions [16, 32) of a prompt is bitwise the page any OTHER
+request with the same prefix would produce — which is what lets the
+radix prefix cache (serving/prefixcache.py) map one physical page into
+many slots' page tables. Pad slots carry exact-zero attention weight
+(-1e30 mask -> softmax 0.0) and positions count only real tokens, so
+right-pad output is bitwise the left-pad output generate() computes.
+
+Prefix reuse: `prefill(prefix_len=, gather_vec=)` seeds the dense
+prefill cache from already-resident pool pages (one gather + zeroed
+invalid tail) and runs the model over the SUFFIX only — TTFT drops
+from O(prompt) to O(suffix). At insert, `scatter_vec` routes chunk i
+either to its fresh page (owned/divergent content — the copy-on-write
+copy happens here, device-side, fixed shape) or to the scratch page
+(shared content already resident; the slot's page table still points
+at the shared page).
 
 Bit-identical contract: a request decoded through the engine produces
 exactly the tokens `models.transformer.generate()` would produce for it
-solo (same rng, same sampling config). The engine reuses generate()'s
-OWN prefill executable and rng schedule, and the paged tick reproduces
-the dense decode math per slot — per-slot sampling parameters are
-dynamic arrays whose disabled values (top_k = vocab, top_p = 1.0) are
-exact no-ops, so one tick executable serves every sampling config. See
-tests/unit/test_serving.py for the enforced oracle.
+solo (same rng, same sampling config) — with or without prefix sharing
+or speculation. Greedy slots accept draft tokens only where they equal
+the target argmax (`speculative.greedy_accept`); sampled slots ride the
+same executable committing one token from the verify window's first
+position, whose logits are bitwise the single-token tick's. See
+tests/unit/test_serving.py and tests/unit/test_prefix_cache.py for the
+enforced oracles.
 """
 
 import dataclasses
@@ -39,9 +61,11 @@ class PrefillResult:
     """A prefilled request waiting for slot insertion."""
     first_token: int        # sampled from the prompt's last position
     pcache: object          # dense [1, L] decode cache (device)
+    dpcache: object         # draft-model dense cache (None unless spec)
     step_keys: np.ndarray   # [K, 2] uint32, generate()'s split schedule
-    bucket: int             # pow2 prefill bucket (pages were sized off it)
+    bucket: int             # pow2 SUFFIX bucket the prefill compiled at
     n_steps: int            # max_new_tokens for this request
+    prompt_len: int         # full prompt length (prefix + suffix)
 
 
 def _plain(tree):
@@ -112,16 +136,63 @@ def _sample_slots(logits, keys, temperature, top_k, top_p):
         lambda: greedy)
 
 
+@functools.lru_cache(maxsize=64)
+def _serve_prefill_fns(decoder, temperature, top_k, top_p):
+    """Jitted canonical (right-pad) prefill for one decoder/sampling
+    config: run the suffix window, sample the last REAL position's row.
+    `last_idx` is dynamic, so every suffix length in a bucket shares
+    the executable — including prefix-HIT suffixes starting mid-cache
+    (the gathered cache's write pointer supplies the start). The row is
+    kept [1, V] so the categorical draw matches `generate()` bitwise
+    (same gumbel shape)."""
+
+    @functools.partial(runtime.instrumented_jit, donate_argnums=1)
+    def prefill(params, cache, tokens, rng, mask, last_idx):
+        logits, vars_ = decoder.apply({"params": params, "cache": cache},
+                                      tokens, mask, mutable=["cache"])
+        row = jax.lax.dynamic_slice_in_dim(
+            logits, last_idx, 1, axis=1)[:, 0].astype(jnp.float32)
+        if not temperature:
+            tok = jnp.argmax(row, axis=-1).astype(jnp.int32)
+        else:
+            from cloud_tpu.models.decoding import warp_logits
+            warped = warp_logits(row, temperature, top_k, top_p)
+            tok = jax.random.categorical(rng, warped,
+                                         axis=-1).astype(jnp.int32)
+        return vars_["cache"], tok
+
+    from cloud_tpu.models.decoding import best_effort_donation
+    return best_effort_donation(prefill)
+
+
+@functools.lru_cache(maxsize=64)
+def _draft_prefill_fn(decoder):
+    """Jitted draft-model prefill: cache only, nothing sampled (the
+    draft never emits tokens directly — it proposes inside the tick)."""
+
+    @functools.partial(runtime.instrumented_jit, donate_argnums=1)
+    def prefill(params, cache, tokens, mask):
+        _, vars_ = decoder.apply({"params": params, "cache": cache},
+                                 tokens, mask, mutable=["cache"])
+        return vars_["cache"]
+
+    from cloud_tpu.models.decoding import best_effort_donation
+    return best_effort_donation(prefill)
+
+
 class DecodeEngine:
     """Continuous-batching decode over `slots` slots of a paged pool.
 
     Single-owner device state: exactly one thread may call
-    `insert`/`tick`/`evict` (the scheduler's tick thread); `prefill`
-    is safe to call concurrently from an admission thread.
+    `insert`/`tick`/`evict` (the scheduler's tick thread); MISS-path
+    `prefill` (prefix_len == 0) is safe to call concurrently from an
+    admission thread. HIT-path prefill reads `self.cache`, which the
+    tick donates every call — it must run on the tick thread.
     """
 
     def __init__(self, model, params, slots, page_size, num_pages,
-                 max_new_cap=None):
+                 max_new_cap=None, draft_model=None, draft_params=None,
+                 spec_k=0):
         from cloud_tpu.models.transformer import TransformerLM
 
         if not isinstance(model, TransformerLM):
@@ -142,9 +213,11 @@ class DecodeEngine:
         if self.max_new_cap < 2:
             raise ValueError("max_new_cap must be >= 2.")
         self._params = params
+        self.spec_k = int(spec_k)
+        self.spec_on = draft_model is not None and self.spec_k > 0
         # The SAME decode clone generate() derives, so the engine's
-        # prefill executables and cache-pool entries are shared with
-        # solo generate() calls in the process.
+        # dense prefill caches come from the shared reuse pool solo
+        # generate() calls in the process also draw from.
         self._dense = model.clone(decode=True, dropout_rate=0.0)
         self._paged = model.clone(decode=True, dropout_rate=0.0,
                                   kv_page_size=page_size,
@@ -153,6 +226,39 @@ class DecodeEngine:
         from cloud_tpu.models.decoding import (best_effort_donation,
                                                empty_cache)
         self.cache = _plain(empty_cache(self._paged, self.slots))
+
+        if self.spec_on:
+            if not isinstance(draft_model, TransformerLM):
+                raise NotImplementedError(
+                    "draft_model must be a TransformerLM; got "
+                    "{}.".format(type(draft_model).__name__))
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    "draft vocab_size ({}) must match target ({}) — "
+                    "accept compares token ids.".format(
+                        draft_model.vocab_size, model.vocab_size))
+            if draft_model.max_seq_len != model.max_seq_len:
+                raise ValueError(
+                    "draft max_seq_len ({}) must match target ({}) — "
+                    "both caches share the page geometry.".format(
+                        draft_model.max_seq_len, model.max_seq_len))
+            self._draft_params = draft_params
+            self._dense_draft = draft_model.clone(decode=True,
+                                                  dropout_rate=0.0)
+            # Same page_size/num_pages: page id i means the same token
+            # span in both pools, so one page table (and one prefix
+            # trie) serves target and draft caches.
+            self._paged_draft = draft_model.clone(
+                decode=True, dropout_rate=0.0, kv_page_size=page_size,
+                kv_num_pages=num_pages)
+            self.draft_cache = _plain(
+                empty_cache(self._paged_draft, self.slots))
+        else:
+            self._draft_params = None
+            self._dense_draft = None
+            self._paged_draft = None
+            self.draft_cache = None
+
         key_width = self.max_new_cap - 1
         self.ctl = {
             "active": jnp.zeros((slots,), jnp.bool_),
@@ -167,95 +273,157 @@ class DecodeEngine:
             "has_eos": jnp.zeros((slots,), jnp.bool_),
             "step_keys": jnp.zeros((slots, key_width, 2), jnp.uint32),
         }
-        self._tick = best_effort_donation(functools.partial(
-            runtime.instrumented_jit, donate_argnums=(1, 2))(
-                self._tick_impl))
-        self._insert = best_effort_donation(functools.partial(
-            runtime.instrumented_jit, donate_argnums=(0, 1))(
-                self._insert_impl))
-        self._evict = best_effort_donation(functools.partial(
-            runtime.instrumented_jit, donate_argnums=(0, 1))(
-                self._evict_impl))
+        jit = runtime.instrumented_jit
+        if self.spec_on:
+            self._tick = best_effort_donation(functools.partial(
+                jit, donate_argnums=(2, 3, 4))(self._spec_tick_impl))
+            self._insert = best_effort_donation(functools.partial(
+                jit, donate_argnums=(0, 1, 2))(self._insert_spec_impl))
+            self._evict = best_effort_donation(functools.partial(
+                jit, donate_argnums=(0, 1, 2))(self._evict_spec_impl))
+        else:
+            self._tick = best_effort_donation(functools.partial(
+                jit, donate_argnums=(1, 2))(self._tick_impl))
+            self._insert = best_effort_donation(functools.partial(
+                jit, donate_argnums=(0, 1))(self._insert_impl))
+            self._evict = best_effort_donation(functools.partial(
+                jit, donate_argnums=(0, 1))(self._evict_impl))
+        self._gather = best_effort_donation(functools.partial(
+            jit, donate_argnums=(0,))(self._gather_impl))
         self._warm_stats = None
 
-    # -- prefill (admission thread) -----------------------------------
+    # -- prefill ------------------------------------------------------
 
-    def prefill(self, prompt, max_new_tokens, rng, sampling):
-        """Dense prefill for one request, exactly `generate()`'s path:
-        same bucket, same left-pad + mask, same executable (shared
-        `_decode_fns` entry), same rng split schedule. `sampling` is a
+    def prefill(self, prompt, max_new_tokens, rng, sampling,
+                prefix_len=0, gather_vec=None):
+        """Canonical right-pad prefill for one request. `sampling` is a
         normalized dict: temperature (float), top_k (int|None), top_p
-        (float|None), eos_token (int|None). Returns a `PrefillResult`;
-        blocks until the first token is on host (the TTFT point)."""
+        (float|None), eos_token (int|None).
+
+        prefix_len > 0 is a prefix-cache HIT: `gather_vec` (a
+        pool.page_vec covering ceil(prefix_len / page_size) resident
+        pages) seeds the dense cache with the first `prefix_len`
+        cached positions, and the model runs over the suffix only.
+        The rng schedule is unchanged — prefix reuse never moves a
+        sample draw, which is the bit-identity contract.
+
+        Returns a `PrefillResult`; blocks until the first token is on
+        host (the TTFT point)."""
         from cloud_tpu.models.decoding import (acquire_cache,
                                                bucket_length)
-        from cloud_tpu.models.transformer import _decode_fns
 
-        prompt = np.asarray(prompt, np.int32).reshape(1, -1)
-        prompt_len = prompt.shape[1]
-        prefill_fn, _ = _decode_fns(
-            self._dense, float(sampling["temperature"]),
-            sampling["top_k"], sampling["top_p"], sampling["eos_token"])
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        prompt_len = int(prompt.shape[0])
+        prefix_len = int(prefix_len)
+        if not 0 <= prefix_len < prompt_len:
+            raise ValueError(
+                "prefix_len must be in [0, prompt_len); got {} for a "
+                "{}-token prompt.".format(prefix_len, prompt_len))
+        n_suffix = prompt_len - prefix_len
+        bucket = bucket_length(n_suffix, self.max_seq_len)
+        if prefix_len + bucket > self.max_seq_len:
+            raise ValueError(
+                "prefix ({}) + suffix bucket ({}) exceeds max_seq_len "
+                "{}; the scheduler trims the match to keep the padded "
+                "suffix in-cache.".format(prefix_len, bucket,
+                                          self.max_seq_len))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n_suffix] = prompt[prefix_len:]
+        mask = np.zeros((1, bucket), bool)
+        mask[0, :n_suffix] = True
         key, prefill_rng = jax.random.split(rng)
-        mask_arg = None
-        prefill_tokens = jnp.asarray(prompt)
-        bucket = bucket_length(prompt_len,
-                               self.max_seq_len - max_new_tokens)
-        if bucket > prompt_len:
-            pad = bucket - prompt_len
-            prefill_tokens = jnp.pad(prefill_tokens, ((0, 0), (pad, 0)))
-            mask_arg = jnp.pad(jnp.ones((1, prompt_len), bool),
-                               ((0, 0), (pad, 0)))
-        cache = acquire_cache(self._dense, 1)
-        pcache, first = prefill_fn(self._params, cache, prefill_tokens,
-                                   prefill_rng, mask_arg)
+
+        cache = _plain(acquire_cache(self._dense, 1))
+        gvec = None
+        if prefix_len:
+            gvec = jnp.asarray(gather_vec, jnp.int32)
+            cache = self._gather(cache, self.cache, gvec,
+                                 np.int32(prefix_len))
+        fn = _serve_prefill_fns(
+            self._dense, float(sampling["temperature"]),
+            sampling["top_k"], sampling["top_p"])
+        pcache, first = fn(self._params, cache, jnp.asarray(tokens),
+                           prefill_rng, jnp.asarray(mask),
+                           np.int32(n_suffix - 1))
+        dpcache = None
+        if self.spec_on:
+            dcache = _plain(acquire_cache(self._dense_draft, 1))
+            if prefix_len:
+                dcache = self._gather(dcache, self.draft_cache, gvec,
+                                      np.int32(prefix_len))
+            dpcache = _draft_prefill_fn(self._dense_draft)(
+                self._draft_params, dcache, jnp.asarray(tokens),
+                jnp.asarray(mask))
         step_keys = np.zeros((self.max_new_cap - 1, 2), np.uint32)
         if max_new_tokens > 1:
             step_keys[:max_new_tokens - 1] = np.asarray(
                 jax.random.split(key, max_new_tokens - 1))
         first_host = int(runtime.device_fetch(first)[0])
         return PrefillResult(first_token=first_host, pcache=pcache,
-                             step_keys=step_keys, bucket=bucket,
-                             n_steps=int(max_new_tokens))
+                             dpcache=dpcache, step_keys=step_keys,
+                             bucket=bucket, n_steps=int(max_new_tokens),
+                             prompt_len=prompt_len)
 
     def release_prefill(self, result):
-        """Parks a consumed (or abandoned) prefill's dense cache back
-        in the decode-cache reuse pool."""
+        """Parks a consumed (or abandoned) prefill's dense cache(s)
+        back in the decode-cache reuse pool."""
         from cloud_tpu.models.decoding import release_cache
         release_cache(self._dense, 1, result.pcache)
         result.pcache = None
+        if result.dpcache is not None:
+            release_cache(self._dense_draft, 1, result.dpcache)
+            result.dpcache = None
 
     # -- slot ops (tick thread) ---------------------------------------
 
-    def insert(self, slot, result, page_vec, sampling):
-        """Writes a prefilled request into free slot `slot`: scatters
-        the dense prefill cache into the reserved pages, installs the
-        page-table/validity/step rows, and arms the slot's control row
-        (sampling params, rng schedule, eos latch). One fixed-shape
-        executable for every bucket — the prefill cache is always
-        full-length dense."""
+    def insert(self, slot, result, page_vec, scatter_vec, sampling):
+        """Writes a prefilled request into free slot `slot`. The page
+        vectors split ownership: `page_vec` is the slot's logical page
+        table (shared prefix pages included); `scatter_vec` routes
+        chunk i to page_vec[i] where the slot OWNS the page (fresh
+        pages, including the copy-on-write page a mid-page divergence
+        reconstructs) and to the scratch page 0 where the content is
+        already resident and shared. One fixed-shape executable for
+        every bucket — the prefill cache is always full-length dense.
+        """
         vocab = self.model.vocab_size
         top_k = sampling["top_k"]
         top_p = sampling["top_p"]
         eos = sampling["eos_token"]
-        self.cache, self.ctl = self._insert(
-            self.cache, self.ctl, _plain(result.pcache),
-            np.int32(slot), jnp.asarray(page_vec, jnp.int32),
-            jnp.asarray(result.step_keys),
-            np.int32(result.n_steps), np.int32(result.first_token),
-            np.float32(sampling["temperature"]),
-            np.int32(vocab if top_k is None else top_k),
-            np.float32(1.0 if top_p is None else top_p),
-            np.int32(0 if eos is None else eos),
-            bool(eos is not None))
+        args = (np.int32(slot), jnp.asarray(page_vec, jnp.int32),
+                jnp.asarray(scatter_vec, jnp.int32),
+                jnp.asarray(result.step_keys),
+                np.int32(result.n_steps), np.int32(result.first_token),
+                np.float32(sampling["temperature"]),
+                np.int32(vocab if top_k is None else top_k),
+                np.float32(1.0 if top_p is None else top_p),
+                np.int32(0 if eos is None else eos),
+                bool(eos is not None))
+        if self.spec_on:
+            self.cache, self.draft_cache, self.ctl = self._insert(
+                self.cache, self.draft_cache, self.ctl,
+                _plain(result.pcache), _plain(result.dpcache), *args)
+        else:
+            self.cache, self.ctl = self._insert(
+                self.cache, self.ctl, _plain(result.pcache), *args)
         self.release_prefill(result)
 
     def tick(self):
-        """Advances every active slot one token. Returns the device
-        out-array `[2, S]` (row 0: sampled token, row 1: finished flag)
-        — the scheduler fetches it with `runtime.device_fetch`."""
-        self.cache, self.ctl, out = self._tick(
-            self._params, self.cache, self.ctl)
+        """Advances every active slot. Plain engine: one token per
+        call, device out-array `[2, S]` (row 0: sampled token, row 1:
+        finished flag). Speculative engine: up to spec_k + 1 tokens per
+        call, out-array `[spec_k + 4, S]` — rows 0..spec_k committed
+        tokens (-1 on inactive slots), row spec_k + 1 the commit count,
+        row spec_k + 2 the finished flag, row spec_k + 3 the accepted
+        draft count (-1 on non-speculating slots). The scheduler
+        fetches it with `runtime.device_fetch`."""
+        if self.spec_on:
+            (self.cache, self.draft_cache, self.ctl, out) = self._tick(
+                self._params, self._draft_params, self.cache,
+                self.draft_cache, self.ctl)
+        else:
+            self.cache, self.ctl, out = self._tick(
+                self._params, self.cache, self.ctl)
         return out
 
     def evict(self, evict_mask):
@@ -263,8 +431,13 @@ class DecodeEngine:
         validity rows go back to scratch/zero, the control row disarms.
         The physical page ids go back to the host pool separately
         (scheduler bookkeeping)."""
-        self.cache, self.ctl = self._evict(
-            self.cache, self.ctl, jnp.asarray(evict_mask, bool))
+        if self.spec_on:
+            self.cache, self.draft_cache, self.ctl = self._evict(
+                self.cache, self.draft_cache, self.ctl,
+                jnp.asarray(evict_mask, bool))
+        else:
+            self.cache, self.ctl = self._evict(
+                self.cache, self.ctl, jnp.asarray(evict_mask, bool))
 
     # -- retrace sentinel ---------------------------------------------
 
@@ -286,6 +459,119 @@ class DecodeEngine:
                 "(static-shape leak).".format(grew))
 
     # -- jitted bodies ------------------------------------------------
+
+    def _gather_impl(self, dense_cache, pool_cache, page_vec,
+                     prefix_len):
+        """Seeds a fresh dense [1, L] cache with the first `prefix_len`
+        positions of the pool pages in `page_vec` (a full page_vec —
+        [pages_per_slot], scratch-padded past the match). The invalid
+        tail is zeroed, so the seeded cache is bitwise the cache a
+        right-pad prefill of those `prefix_len` tokens would have
+        produced — the suffix prefill continues from it exactly as if
+        the whole prompt had been run."""
+        L = self.max_seq_len
+        valid = jnp.arange(L) < prefix_len
+
+        def seed(att, datt):
+            out = dict(datt)
+            k = att["key_pages"][page_vec].reshape(
+                1, L, *att["key_pages"].shape[2:])
+            v = att["value_pages"][page_vec].reshape(
+                1, L, *att["value_pages"].shape[2:])
+            out["cached_key"] = jnp.where(
+                valid[None, :, None, None], k, jnp.zeros((), k.dtype))
+            out["cached_value"] = jnp.where(
+                valid[None, :, None, None], v, jnp.zeros((), v.dtype))
+            out["cache_index"] = prefix_len.astype(jnp.int32)
+            out["slot_valid"] = valid[None]
+            out["slot_pos"] = jnp.where(
+                valid, jnp.arange(L, dtype=jnp.int32), 0)[None]
+            out["token_count"] = jnp.full((1,), prefix_len, jnp.int32)
+            return out
+
+        result = _map_attention(pool_cache, seed, dense_cache)
+        # _map_attention keeps non-attention leaves from its FIRST
+        # tree; the only one is pos_count, whose pool shape is [S] —
+        # replace it with the dense [1] counter at the prefix depth.
+        result["pos_count"] = jnp.full((1,), prefix_len, jnp.int32)
+        return result
+
+    def _scatter_request(self, cache, pcache, slot, page_vec,
+                         scatter_vec):
+        """One request's dense prefill cache into the paged pool:
+        chunk i of the [1, L] dense view goes to scatter_vec[i] (its
+        fresh page, or scratch when shared content is already there);
+        the page table gets page_vec. slot_steps comes from
+        token_count (REAL tokens — cache_index includes the right-pad,
+        which must be overwritten by decode writes, not skipped)."""
+        ppn, page = self.pages_per_slot, self.page_size
+
+        def scatter(att, patt):
+            out = dict(att)
+            chunks_k = patt["cached_key"][0].reshape(
+                ppn, page, *patt["cached_key"].shape[2:])
+            chunks_v = patt["cached_value"][0].reshape(
+                ppn, page, *patt["cached_value"].shape[2:])
+            # Owned ids are unique and nonzero, so fresh chunks land
+            # exactly; shared/overflow chunks collapse onto scratch,
+            # whose content is never attended.
+            out["key_pages"] = att["key_pages"].at[scatter_vec].set(
+                chunks_k)
+            out["value_pages"] = att["value_pages"].at[scatter_vec].set(
+                chunks_v)
+            out["page_table"] = att["page_table"].at[slot].set(page_vec)
+            out["slot_steps"] = att["slot_steps"].at[slot].set(
+                patt["token_count"][0])
+            out["slot_valid"] = att["slot_valid"].at[slot].set(
+                patt["slot_valid"][0])
+            return out
+
+        new_cache = _map_attention(cache, scatter, pcache)
+        new_cache["pos_count"] = cache["pos_count"].at[slot].set(
+            pcache["pos_count"][0])
+        return new_cache
+
+    def _arm_ctl(self, ctl, slot, step_keys_row, max_steps, first_tok,
+                 temperature, top_k, top_p, eos, has_eos):
+        out_ctl = dict(ctl)
+        out_ctl["active"] = ctl["active"].at[slot].set(True)
+        out_ctl["done"] = ctl["done"].at[slot].set(
+            has_eos & (first_tok == eos))
+        out_ctl["cur_tok"] = ctl["cur_tok"].at[slot].set(first_tok)
+        out_ctl["steps_done"] = ctl["steps_done"].at[slot].set(1)
+        out_ctl["max_steps"] = ctl["max_steps"].at[slot].set(max_steps)
+        out_ctl["temperature"] = ctl["temperature"].at[slot].set(
+            temperature)
+        out_ctl["top_k"] = ctl["top_k"].at[slot].set(top_k)
+        out_ctl["top_p"] = ctl["top_p"].at[slot].set(top_p)
+        out_ctl["eos"] = ctl["eos"].at[slot].set(eos)
+        out_ctl["has_eos"] = ctl["has_eos"].at[slot].set(has_eos)
+        out_ctl["step_keys"] = ctl["step_keys"].at[slot].set(
+            step_keys_row)
+        return out_ctl
+
+    def _insert_impl(self, cache, ctl, pcache, slot, page_vec,
+                     scatter_vec, step_keys_row, max_steps, first_tok,
+                     temperature, top_k, top_p, eos, has_eos):
+        new_cache = self._scatter_request(cache, pcache, slot, page_vec,
+                                          scatter_vec)
+        out_ctl = self._arm_ctl(ctl, slot, step_keys_row, max_steps,
+                                first_tok, temperature, top_k, top_p,
+                                eos, has_eos)
+        return new_cache, out_ctl
+
+    def _insert_spec_impl(self, cache, dcache, ctl, pcache, dpcache,
+                          slot, page_vec, scatter_vec, step_keys_row,
+                          max_steps, first_tok, temperature, top_k,
+                          top_p, eos, has_eos):
+        new_cache = self._scatter_request(cache, pcache, slot, page_vec,
+                                          scatter_vec)
+        new_dcache = self._scatter_request(dcache, dpcache, slot,
+                                           page_vec, scatter_vec)
+        out_ctl = self._arm_ctl(ctl, slot, step_keys_row, max_steps,
+                                first_tok, temperature, top_k, top_p,
+                                eos, has_eos)
+        return new_cache, new_dcache, out_ctl
 
     def _tick_impl(self, params, cache, ctl):
         active = ctl["active"]
@@ -319,53 +605,126 @@ class DecodeEngine:
                          finished.astype(jnp.int32)])
         return _plain(vars_["cache"]), out_ctl, out
 
-    def _insert_impl(self, cache, ctl, pcache, slot, page_vec,
-                     step_keys_row, max_steps, first_tok, temperature,
-                     top_k, top_p, eos, has_eos):
-        ppn, page = self.pages_per_slot, self.page_size
+    def _spec_tick_impl(self, params, draft_params, cache, dcache, ctl):
+        """Draft/verify speculation, one executable per tick:
 
-        def scatter(att, patt):
-            out = dict(att)
-            # Reserved ids are unique and nonzero, so real chunks land
-            # exactly; the duplicate scratch entries all carry the
-            # prefill cache's zero tail (never read either way).
-            chunks_k = patt["cached_key"][0].reshape(
-                ppn, page, *patt["cached_key"].shape[2:])
-            chunks_v = patt["cached_value"][0].reshape(
-                ppn, page, *patt["cached_value"].shape[2:])
-            out["key_pages"] = att["key_pages"].at[page_vec].set(chunks_k)
-            out["value_pages"] = att["value_pages"].at[page_vec].set(
-                chunks_v)
-            out["page_table"] = att["page_table"].at[slot].set(page_vec)
-            out["slot_steps"] = att["slot_steps"].at[slot].set(
-                patt["cache_index"])
-            out["slot_valid"] = att["slot_valid"].at[slot].set(
-                patt["slot_valid"][0])
-            return out
+          1. draft scan: k greedy single-token steps from cur_tok
+             (writes k draft-cache entries per active slot);
+          2. verify: ONE (k+1)-token target forward over
+             [cur_tok, d_1..d_k] (writes k+1 target-cache entries);
+          3. accept: greedy slots keep the longest draft prefix that
+             matches the target argmax chain plus the target's own
+             next token (`greedy_accept` — speculative.py's pinned
+             math); sampled slots commit one token from position 0,
+             whose logits are bitwise the plain tick's;
+          4. rewind: both caches roll back to exactly
+             prompt + steps' - 1 entries (`paged_slot_rewind`); a
+             fully-accepted slot's draft cache is one entry SHORT, so
+             a masked catch-up draft forward writes d_k's entry.
 
-        new_cache = _map_attention(cache, scatter, pcache)
-        new_cache["pos_count"] = cache["pos_count"].at[slot].set(
-            pcache["pos_count"][0])
+        Invariant, before and after every tick: target and draft
+        caches both hold `prompt_len + steps_done - 1` entries —
+        cur_tok is never in either cache (it is the next input).
+        """
+        from cloud_tpu.models.decoding import paged_slot_rewind
+        from cloud_tpu.models.speculative import greedy_accept
+
+        k = self.spec_k
+        slots = self.slots
+        active = ctl["active"]
+        mask1 = active[:, None]
+
+        def draft_step(carry, _):
+            dc, tok = carry
+            dlogits, dvars = self._paged_draft.apply(
+                {"params": draft_params, "cache": dc},
+                tok[:, None], mask1, mutable=["cache"])
+            nxt = jnp.argmax(dlogits[:, 0].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            return (_plain(dvars["cache"]), nxt), nxt
+
+        (dcache, _), drafts = jax.lax.scan(
+            draft_step, (dcache, ctl["cur_tok"]), None, length=k)
+        drafts = jnp.transpose(drafts, (1, 0))  # [S, k]
+
+        verify_in = jnp.concatenate(
+            [ctl["cur_tok"][:, None], drafts], axis=1)  # [S, k+1]
+        maskk = jnp.broadcast_to(mask1, (slots, k + 1))
+        logits, vars_ = self._paged.apply(
+            {"params": params, "cache": cache},
+            verify_in, maskk, mutable=["cache"])
+        cache = _plain(vars_["cache"])
+        greedy = jnp.argmax(logits.astype(jnp.float32),
+                            axis=-1).astype(jnp.int32)  # [S, k+1]
+        n_acc = greedy_accept(drafts, greedy)  # [S]
+
+        # Sampled (temperature > 0) slots ride the same executable
+        # committing ONE token from position 0 — the plain tick's
+        # sampler over the plain tick's logits, key schedule included.
+        key_idx = jnp.clip(ctl["steps_done"] - 1, 0,
+                           ctl["step_keys"].shape[1] - 1)
+        keys = jnp.take_along_axis(
+            ctl["step_keys"], key_idx[:, None, None], 1)[:, 0]
+        live_temp = jnp.where(active, ctl["temperature"], 0.0)
+        sampled0 = _sample_slots(logits[:, 0], keys, live_temp,
+                                 ctl["top_k"], ctl["top_p"])
+
+        is_spec = active & (ctl["temperature"] == 0.0)
+        n_acc = jnp.where(is_spec, n_acc, 0)
+        bonus = jnp.take_along_axis(greedy, n_acc[:, None], 1)[:, 0]
+        pick = jnp.where(is_spec, bonus, sampled0)
+        committed = jnp.concatenate(
+            [drafts, jnp.zeros((slots, 1), jnp.int32)], axis=1)
+        committed = committed.at[jnp.arange(slots), n_acc].set(pick)
+        latched = ctl["has_eos"] & ctl["done"]
+        committed = jnp.where(latched[:, None], ctl["eos"][:, None],
+                              committed)
+
+        base_c = jnp.where(is_spec, n_acc + 1, 1)
+        # Commit stops at the first eos: tokens past it are never
+        # emitted (the scheduler latch-fills the tail on completion,
+        # exactly generate()'s where(done, eos, ...) behavior).
+        eos_hit = (ctl["has_eos"][:, None]
+                   & (committed == ctl["eos"][:, None]))
+        hit_idx = jnp.where(eos_hit, jnp.arange(k + 1)[None, :], k + 1)
+        first_eos = jnp.min(hit_idx, axis=1)
+        c = jnp.minimum(base_c, first_eos + 1)
+        done_new = ctl["done"] | (ctl["has_eos"] & (first_eos < base_c))
+        steps = ctl["steps_done"] + jnp.where(active, c, 0)
+        finished = active & (done_new | (steps >= ctl["max_steps"]))
+        cur_tok = committed[jnp.arange(slots), jnp.maximum(c - 1, 0)]
+
+        # Rewind both caches to prompt + steps' - 1 entries. Target
+        # wrote k+1 and keeps c; draft wrote k and keeps c, except the
+        # full-accept slot (c == k+1) which is one SHORT — the masked
+        # catch-up forward below writes d_k's missing entry (mask 0
+        # slots neither move their pointers nor validate anything).
+        delta_t = jnp.where(active, k + 1 - c, 0)
+        cache = paged_slot_rewind(cache, delta_t, self.max_seq_len)
+        cache["pos_count"] = cache["pos_count"] - delta_t
+        delta_d = jnp.where(active, jnp.maximum(k - c, 0), 0)
+        dcache = paged_slot_rewind(dcache, delta_d, self.max_seq_len)
+        dcache["pos_count"] = dcache["pos_count"] - delta_d
+        catch = active & (c == k + 1)
+        _, dvars = self._paged_draft.apply(
+            {"params": draft_params, "cache": dcache},
+            drafts[:, k - 1][:, None], catch[:, None],
+            mutable=["cache"])
+        dcache = _plain(dvars["cache"])
+
         out_ctl = dict(ctl)
-        out_ctl["active"] = ctl["active"].at[slot].set(True)
-        out_ctl["done"] = ctl["done"].at[slot].set(
-            has_eos & (first_tok == eos))
-        out_ctl["cur_tok"] = ctl["cur_tok"].at[slot].set(first_tok)
-        out_ctl["steps_done"] = ctl["steps_done"].at[slot].set(1)
-        out_ctl["max_steps"] = ctl["max_steps"].at[slot].set(max_steps)
-        out_ctl["temperature"] = ctl["temperature"].at[slot].set(
-            temperature)
-        out_ctl["top_k"] = ctl["top_k"].at[slot].set(top_k)
-        out_ctl["top_p"] = ctl["top_p"].at[slot].set(top_p)
-        out_ctl["eos"] = ctl["eos"].at[slot].set(eos)
-        out_ctl["has_eos"] = ctl["has_eos"].at[slot].set(has_eos)
-        out_ctl["step_keys"] = ctl["step_keys"].at[slot].set(
-            step_keys_row)
-        return new_cache, out_ctl
+        out_ctl["cur_tok"] = jnp.where(active, cur_tok, ctl["cur_tok"])
+        out_ctl["done"] = jnp.where(active, done_new, ctl["done"])
+        out_ctl["steps_done"] = steps
+        out = jnp.concatenate([
+            jnp.where(active[None, :], jnp.transpose(committed), -1),
+            jnp.where(active, c, 0)[None, :],
+            finished.astype(jnp.int32)[None, :],
+            jnp.where(is_spec, n_acc, -1)[None, :],
+        ], axis=0)  # [k+4, S]
+        return cache, dcache, out_ctl, out
 
-    def _evict_impl(self, cache, ctl, evict_mask):
-        keep = ~evict_mask
-
+    def _clear_slots(self, cache, keep):
         def clear(att):
             out = dict(att)
             out["page_table"] = jnp.where(keep[:, None],
@@ -376,6 +735,11 @@ class DecodeEngine:
 
         new_cache = _map_attention(cache, clear)
         new_cache["pos_count"] = jnp.where(keep, cache["pos_count"], 0)
+        return new_cache
+
+    def _evict_impl(self, cache, ctl, evict_mask):
+        keep = ~evict_mask
+        new_cache = self._clear_slots(cache, keep)
         out_ctl = dict(ctl)
         out_ctl["active"] = ctl["active"] & keep
         out_ctl["done"] = ctl["done"] & keep
@@ -383,6 +747,11 @@ class DecodeEngine:
         out_ctl["cur_tok"] = jnp.where(keep, ctl["cur_tok"], 0)
         out_ctl["max_steps"] = jnp.where(keep, ctl["max_steps"], 0)
         return new_cache, out_ctl
+
+    def _evict_spec_impl(self, cache, dcache, ctl, evict_mask):
+        new_cache, out_ctl = self._evict_impl(cache, ctl, evict_mask)
+        new_dcache = self._clear_slots(dcache, ~evict_mask)
+        return new_cache, new_dcache, out_ctl
 
 
 __all__ = ["DecodeEngine", "PrefillResult", "RetraceError"]

@@ -16,6 +16,17 @@ never-filled page-table entry points at it. Inactive slots write their
 (masked, never-attended) tick garbage there, which is what makes
 cross-request leakage structurally impossible — a slot's table can only
 reference pages reserved for it, or scratch.
+
+Pages are REFERENCE COUNTED so multiple holders can map the same
+physical page (the radix prefix cache shares populated prompt pages
+across requests — serving/prefixcache.py). `reserve` hands out fresh
+pages at refcount 1; `share` adds a holder to an already-allocated page;
+`free` drops one holder and only recycles the page when the last holder
+lets go. A shared page is immutable by convention: the holder that needs
+to write past it makes a copy-on-write page first (the engine's insert
+scatter routes shared entries to scratch and reconstructs divergent
+content into fresh pages), and `note_cow` keeps the count for
+`pool_stats`.
 """
 
 import threading
@@ -24,7 +35,7 @@ import numpy as np
 
 
 class PagePool:
-    """Free-list allocator over `num_pages` physical pages.
+    """Refcounting free-list allocator over `num_pages` physical pages.
 
     Thread-safe; `reserve` blocks (condition wait) until enough pages
     are free, which is the backpressure primitive the scheduler builds
@@ -46,6 +57,8 @@ class PagePool:
         # LIFO free list: recently-freed pages are re-handed first
         # (warm in whatever cache hierarchy the backend keeps).
         self._free = list(range(1, self.num_pages))
+        self._refs = {}  # page id -> holder count, allocated pages only
+        self._cow_copies = 0
         self._closed = False
 
     @property
@@ -57,11 +70,14 @@ class PagePool:
         with self._cond:
             return len(self._free)
 
-    def pages_needed(self, prompt_bucket, max_new_tokens):
+    def pages_needed(self, prompt_tokens, max_new_tokens, slack=0):
         """Pages a request holds for its lifetime: one slot writes
-        `bucket + max_new - 1` cache positions (the final sampled token
-        is returned but never written back)."""
-        tokens = prompt_bucket + max(int(max_new_tokens) - 1, 0)
+        `prompt + max_new - 1` cache positions (the final sampled token
+        is returned but never written back). `slack` adds positions the
+        slot may transiently overshoot into — the speculative tick
+        writes up to `spec_k` draft positions past the last committed
+        token before rewinding."""
+        tokens = prompt_tokens + max(int(max_new_tokens) - 1, 0) + slack
         need = -(-tokens // self.page_size)  # ceil
         if need > self.pages_per_slot:
             raise ValueError(
@@ -75,10 +91,10 @@ class PagePool:
     def reserve(self, n, timeout=None):
         """Takes `n` pages off the free list, blocking until available.
 
-        Returns the list of page ids, or None on timeout/close. A
-        request for more than `capacity` pages raises immediately —
-        waiting could never succeed (the deadlock the scheduler's
-        submit-time validation also rejects).
+        Returns the list of page ids (each at refcount 1), or None on
+        timeout/close. A request for more than `capacity` pages raises
+        immediately — waiting could never succeed (the deadlock the
+        scheduler's submit-time validation also rejects).
         """
         n = int(n)
         if n == 0:
@@ -93,24 +109,82 @@ class PagePool:
                 timeout=timeout)
             if self._closed or not ok:
                 return None
-            return [self._free.pop() for _ in range(n)]
+            pages = [self._free.pop() for _ in range(n)]
+            for pid in pages:
+                self._refs[pid] = 1
+            return pages
+
+    def share(self, page_ids):
+        """Adds one holder to each already-allocated page (prefix-cache
+        hit: a new request maps populated pages into its table)."""
+        with self._cond:
+            for pid in page_ids:
+                pid = int(pid)
+                if pid not in self._refs:
+                    raise ValueError(
+                        "cannot share unallocated page {}.".format(pid))
+                self._refs[pid] += 1
+
+    def refcount(self, page_id):
+        """Current holder count for a page (0 when free)."""
+        with self._cond:
+            return self._refs.get(int(page_id), 0)
 
     def free(self, page_ids):
-        """Returns pages to the free list and wakes blocked reservers."""
+        """Drops one holder per page; recycles pages whose last holder
+        let go and wakes blocked reservers."""
         if not page_ids:
             return
         with self._cond:
+            recycled = False
             for pid in page_ids:
                 pid = int(pid)
                 if not 1 <= pid < self.num_pages:
                     raise ValueError(
                         "page id {} outside pool [1, {}).".format(
                             pid, self.num_pages))
-                if pid in self._free:
+                refs = self._refs.get(pid, 0)
+                if refs <= 0:
                     raise ValueError(
                         "double free of page {}.".format(pid))
-                self._free.append(pid)
-            self._cond.notify_all()
+                if refs == 1:
+                    del self._refs[pid]
+                    self._free.append(pid)
+                    recycled = True
+                else:
+                    self._refs[pid] = refs - 1
+            if recycled:
+                self._cond.notify_all()
+
+    def note_cow(self, n=1):
+        """Counts a copy-on-write page reconstruction (telemetry)."""
+        with self._cond:
+            self._cow_copies += int(n)
+
+    def pool_stats(self):
+        """Point-in-time accounting: free/held/shared page counts, CoW
+        copies since construction, and a holder-count histogram
+        ({refcount: pages}) — the raw material for the SERVE_* gauges
+        and the refcount leak detector."""
+        with self._cond:
+            hist = {}
+            for refs in self._refs.values():
+                hist[refs] = hist.get(refs, 0) + 1
+            return {
+                "pages_free": len(self._free),
+                "pages_held": len(self._refs),
+                "pages_shared": sum(1 for r in self._refs.values()
+                                    if r >= 2),
+                "cow_copies": self._cow_copies,
+                "refcount_hist": hist,
+            }
+
+    def leak_report(self):
+        """Pages still held, with holder counts. A drained scheduler
+        (all requests complete, prefix cache cleared) must see {} here
+        — anything else is a refcount leak."""
+        with self._cond:
+            return dict(self._refs)
 
     def close(self):
         """Unblocks every waiting reserve with None (shutdown path)."""

@@ -3,23 +3,36 @@
 Two threads around a `DecodeEngine`:
 
 - the ADMISSION thread pops submitted requests from a bounded queue in
-  FCFS windows, orders each window longest-prefix-first (big pow2
-  prefill buckets first — they hold their slot longest, so starting
-  them earliest minimizes tail latency), reserves KV pages (BLOCKING
-  when the pool is exhausted — backpressure, never OOM), and runs the
-  dense prefill off the tick's critical path;
-- the TICK thread owns the engine's device state: it inserts ready
-  prefills into free slots, advances all active slots one token per
-  tick, fetches the tick output (the serving loop's single counted d2h
-  round trip), completes/evicts finished slots, and returns their
+  FCFS windows, orders each window longest-RADIX-match-first (requests
+  whose prompts share the most already-cached pages admit first — they
+  are the cheapest TTFT and keep hot prefixes hot; ties fall back to
+  longest-prefill-first), reserves KV pages for cache MISSES (BLOCKING
+  when the pool is exhausted — backpressure, never OOM; a blocked
+  reservation applies LRU eviction pressure to the prefix cache), and
+  runs miss prefills off the tick's critical path;
+- the TICK thread owns the engine's device state: it admits prefix-
+  cache HITS (the hit prefill gathers from the engine's live pool
+  cache, which every tick donates — only the tick thread may read it),
+  inserts ready prefills into free slots, advances all active slots
+  (one committed token per tick, or up to spec_k + 1 with speculative
+  decode), fetches the tick output (the serving loop's single counted
+  d2h round trip), completes/evicts finished slots, and returns their
   pages.
+
+Prefix sharing (graftshare): every inserted prompt's full pages are
+registered in a radix trie (serving/prefixcache.py). A later request
+whose prompt shares a prefix maps those pages into its own page table
+(pool-refcounted, copy-on-write on divergence) and prefills only its
+suffix — TTFT O(prompt) -> O(suffix). The trie's HBM budget is enforced
+by LRU eviction of pages no in-flight request holds.
 
 Liveness rides graftwatch: the tick thread beats the installed watchdog
 every iteration and polls `watch.check()`, so a stuck tick surfaces as
 the watchdog's typed fault (graftwatch blackbox + `BackendUnavailable`)
 instead of a silent hang. Throughput/latency ride graftscope: requests
-and tokens totals, queue-depth and active-slots gauges, TTFT and
-per-token latency histograms (p50/p95/p99 via the registry snapshot).
+and tokens totals, queue-depth and active-slots gauges, pool/prefix
+gauges, and TTFT histograms split by hit/miss (p50/p95/p99 via the
+registry snapshot).
 
 Phase labels: the tick thread runs under `runtime.set_phase
 ("serve_tick")`, the admission thread under "serve_prefill" — distinct
@@ -41,13 +54,15 @@ import numpy as np
 from cloud_tpu.parallel import runtime
 from cloud_tpu.serving.engine import DecodeEngine
 from cloud_tpu.serving.kvpool import PagePool
+from cloud_tpu.serving.prefixcache import PrefixCache
 
 
 @dataclasses.dataclass
 class ServeRequest:
     """One decode request. Semantics (and output) match
     `generate(model, params, prompt[None], max_new_tokens,
-    rng=PRNGKey(rng_seed), ...)` exactly — the determinism contract."""
+    rng=PRNGKey(rng_seed), ...)` exactly — the determinism contract,
+    regardless of prefix sharing or speculation."""
     prompt: Sequence[int]
     max_new_tokens: int
     temperature: float = 1.0
@@ -60,23 +75,55 @@ class ServeRequest:
 @dataclasses.dataclass
 class ServeResult:
     """A completed request: `tokens` is prompt + continuation, the
-    `generate()` row contract."""
+    `generate()` row contract. `prefix_len` is the token count served
+    from the prefix cache (0 = cold prefill)."""
     tokens: np.ndarray
     ttft_s: float
     latency_s: float
+    prefix_len: int = 0
 
 
 class _Slot:
     __slots__ = ("request", "pages", "emitted", "future", "t_submit",
-                 "ttft_s")
+                 "ttft_s", "prefix_len")
 
-    def __init__(self, request, pages, future, t_submit, ttft_s):
+    def __init__(self, request, pages, future, t_submit, ttft_s,
+                 prefix_len):
         self.request = request
         self.pages = pages
         self.emitted = []
         self.future = future
         self.t_submit = t_submit
         self.ttft_s = ttft_s
+        self.prefix_len = prefix_len
+
+
+class _ReadyItem:
+    """A miss-path prefill waiting for a free slot (admission thread
+    already ran the prefill and holds the reserved pages)."""
+    __slots__ = ("request", "result", "pages", "future", "t_submit",
+                 "ttft_s")
+
+    def __init__(self, request, result, pages, future, t_submit,
+                 ttft_s):
+        self.request = request
+        self.result = result
+        self.pages = pages
+        self.future = future
+        self.t_submit = t_submit
+        self.ttft_s = ttft_s
+
+
+class _HitTicket:
+    """A prefix-cache hit waiting for the tick thread: no pages, no
+    prefill yet — the hit prefill must read the engine's live pool
+    cache, which only the tick thread may touch."""
+    __slots__ = ("request", "future", "t_submit")
+
+    def __init__(self, request, future, t_submit):
+        self.request = request
+        self.future = future
+        self.t_submit = t_submit
 
 
 def _registry():
@@ -98,15 +145,25 @@ class Scheduler:
 
     def __init__(self, model, params, slots=4, page_size=16,
                  num_pages=None, max_new_cap=None, max_queue=64,
-                 admission_window=8, strict_no_retrace=False):
+                 admission_window=8, strict_no_retrace=False,
+                 prefix_cache=True, prefix_cache_pages=None,
+                 draft_model=None, draft_params=None, spec_k=0):
         if num_pages is None:
             # Default: every slot can hold a full-length sequence, plus
             # scratch — paging then bounds fragmentation, not memory.
             num_pages = slots * (model.max_seq_len // page_size) + 1
         self.engine = DecodeEngine(model, params, slots, page_size,
-                                   num_pages, max_new_cap=max_new_cap)
+                                   num_pages, max_new_cap=max_new_cap,
+                                   draft_model=draft_model,
+                                   draft_params=draft_params,
+                                   spec_k=spec_k)
         self.pool = PagePool(num_pages, page_size,
                              self.engine.pages_per_slot)
+        # prefix_cache_pages is the trie's HBM budget (None = half the
+        # pool — see PrefixCache); prefix_cache=False disables sharing
+        # entirely (every request cold-prefills, the A/B baseline).
+        self.trie = (PrefixCache(self.pool, max_pages=prefix_cache_pages)
+                     if prefix_cache else None)
         self.strict_no_retrace = bool(strict_no_retrace)
         self._admission_window = int(admission_window)
         self._admit_q = queue.Queue(maxsize=max_queue)
@@ -122,6 +179,11 @@ class Scheduler:
         self._completed = 0
         self._tokens_out = 0
         self._ticks = 0
+        self._hits = 0
+        self._misses = 0
+        self._prefix_tokens_served = 0
+        self._accepted_draft_tokens = 0
+        self._proposed_draft_tokens = 0
         # Requests admitted but not yet slot-resident. While > 0 and
         # slots are free, the tick loop briefly yields so inserts land
         # before the next tick — a tick advancing 2 of 8 slots costs
@@ -130,6 +192,8 @@ class Scheduler:
         self._pending_inserts = 0
         from cloud_tpu.monitoring.telemetry import Histogram
         self._ttft_hist = Histogram("ttft")
+        self._ttft_hit_hist = Histogram("ttft_hit")
+        self._ttft_miss_hist = Histogram("ttft_miss")
         self._token_hist = Histogram("token_latency")
 
     # -- lifecycle ----------------------------------------------------
@@ -189,6 +253,9 @@ class Scheduler:
         self._observe_queue()
         return future
 
+    def _spec_slack(self):
+        return self.engine.spec_k if self.engine.spec_on else 0
+
     def _validate(self, request):
         model = self.engine.model
         prompt_len = len(request.prompt)
@@ -201,6 +268,17 @@ class Scheduler:
                 "prompt ({}) + max_new_tokens ({}) exceeds max_seq_len "
                 "{}.".format(prompt_len, request.max_new_tokens,
                              model.max_seq_len))
+        if (self.engine.spec_on and request.max_new_tokens > 1
+                and prompt_len + request.max_new_tokens - 1
+                + self.engine.spec_k > model.max_seq_len):
+            # The verify window transiently writes up to spec_k draft
+            # positions past the last committed token.
+            raise ValueError(
+                "prompt ({}) + max_new_tokens ({}) - 1 + spec_k ({}) "
+                "exceeds max_seq_len {} (speculative verify "
+                "headroom).".format(prompt_len, request.max_new_tokens,
+                                    self.engine.spec_k,
+                                    model.max_seq_len))
         if request.max_new_tokens > self.engine.max_new_cap:
             raise ValueError(
                 "max_new_tokens ({}) exceeds the engine's max_new_cap "
@@ -217,8 +295,9 @@ class Scheduler:
                 request.top_p))
         if request.max_new_tokens > 1:
             # Raises when no reservation could EVER satisfy it.
-            need = self.pool.pages_needed(self._bucket(request),
-                                          request.max_new_tokens)
+            need = self.pool.pages_needed(prompt_len,
+                                          request.max_new_tokens,
+                                          slack=self._spec_slack())
             if need > self.pool.capacity:
                 raise ValueError(
                     "request needs {} pages; the pool has {} "
@@ -226,9 +305,13 @@ class Scheduler:
 
     def _bucket(self, request):
         from cloud_tpu.models.decoding import bucket_length
-        return bucket_length(
-            len(request.prompt),
-            self.engine.max_seq_len - request.max_new_tokens)
+        return bucket_length(len(request.prompt),
+                             self.engine.max_seq_len)
+
+    def _probe(self, request):
+        if self.trie is None or request.max_new_tokens <= 1:
+            return 0
+        return self.trie.probe([int(t) for t in request.prompt])
 
     @staticmethod
     def _sampling(request):
@@ -250,9 +333,14 @@ class Scheduler:
             window = self._next_window()
             if not window:
                 continue
-            # Longest-prefix-first within the FCFS window (stable sort:
-            # equal buckets stay FCFS).
-            window.sort(key=lambda item: -self._bucket(item[0]))
+            # Longest-radix-match-first within the FCFS window, then
+            # longest-prefill-first (stable sort: ties stay FCFS). Hits
+            # admit cheapest and re-touch their prefix before LRU
+            # pressure can evict it; among misses, big prefills hold
+            # their slot longest, so starting them earliest minimizes
+            # tail latency.
+            window.sort(key=lambda item: (-self._probe(item[0]),
+                                          -self._bucket(item[0])))
             for request, future, t_submit in window:
                 if self._stop.is_set():
                     return
@@ -277,14 +365,34 @@ class Scheduler:
         self._observe_queue()
         return window
 
+    def _reserve_with_pressure(self, need, timeout):
+        """One blocking-reserve round; a failed round applies LRU
+        eviction pressure to the prefix cache (pages only the trie
+        holds are reclaimable) before the caller retries."""
+        pages = self.pool.reserve(need, timeout=timeout)
+        if pages is None and self.trie is not None:
+            self.trie.evict(need)
+        return pages
+
     def _admit_one(self, request, future, t_submit):
         sampling = self._sampling(request)
+        if request.max_new_tokens > 1 and self._probe(request) > 0:
+            # Prefix-cache hit: hand the whole admission to the tick
+            # thread — the gather-prefill reads the engine's live pool
+            # cache, which every tick donates, so no other thread may
+            # read it concurrently.
+            with self._ready_lock:
+                self._ready.append(_HitTicket(request, future, t_submit))
+            self._wake.set()
+            return
         pages = []
         if request.max_new_tokens > 1:
-            need = self.pool.pages_needed(self._bucket(request),
-                                          request.max_new_tokens)
+            need = self.pool.pages_needed(len(request.prompt),
+                                          request.max_new_tokens,
+                                          slack=self._spec_slack())
+            pages = None
             while not self._stop.is_set():
-                pages = self.pool.reserve(need, timeout=0.2)
+                pages = self._reserve_with_pressure(need, timeout=0.2)
                 if pages is not None:
                     break
             if pages is None:  # shutdown while blocked on the pool
@@ -301,21 +409,36 @@ class Scheduler:
                 self.pool.free(pages)
             raise
         ttft = time.monotonic() - t_submit
-        self._ttft_hist.observe(ttft)
-        reg = _registry()
-        if reg is not None:
-            from cloud_tpu.monitoring import telemetry
-            reg.histogram(telemetry.SERVE_TTFT_HISTOGRAM).observe(ttft)
+        self._record_ttft(ttft, hit=False)
         if request.max_new_tokens == 1:
             # Completes at prefill: no slot, no pages, no tick.
             self.engine.release_prefill(result)
             self._complete(request, future, t_submit, ttft,
-                           [result.first_token])
+                           [result.first_token], prefix_len=0)
             return
         with self._ready_lock:
             self._ready.append(_ReadyItem(request, result, pages,
                                           future, t_submit, ttft))
         self._wake.set()
+
+    def _record_ttft(self, ttft, hit):
+        self._ttft_hist.observe(ttft)
+        (self._ttft_hit_hist if hit else self._ttft_miss_hist).observe(
+            ttft)
+        if hit:
+            self._hits += 1
+        else:
+            self._misses += 1
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.histogram(telemetry.SERVE_TTFT_HISTOGRAM).observe(ttft)
+            name = (telemetry.SERVE_TTFT_HIT_HISTOGRAM if hit
+                    else telemetry.SERVE_TTFT_MISS_HISTOGRAM)
+            reg.histogram(name).observe(ttft)
+            total = self._hits + self._misses
+            reg.gauge(telemetry.SERVE_PREFIX_HIT_RATE).set(
+                self._hits / total if total else 0.0)
 
     # -- tick thread --------------------------------------------------
 
@@ -366,24 +489,162 @@ class Scheduler:
             self._fail_pending(exc)
 
     def _insert_ready(self):
-        while self._free_slots:
-            with self._ready_lock:
-                if not self._ready:
-                    return
-                item = self._ready.popleft()
-            slot = self._free_slots.pop()
-            state = _Slot(item.request, item.pages, item.future,
-                          item.t_submit, item.ttft_s)
-            state.emitted.append(item.result.first_token)
-            self._slots[slot] = state
-            self.engine.insert(slot, item.result,
-                               self.pool.page_vec(item.pages),
-                               self._sampling(item.request))
+        # Hit tickets blocked on page reservation are stashed and
+        # restored at the front afterwards: a page-starved hit must not
+        # head-of-line-block ready misses (whose pages are already
+        # reserved — inserting them is what eventually frees pages).
+        blocked = []
+        try:
+            while self._free_slots:
+                with self._ready_lock:
+                    if not self._ready:
+                        return
+                    item = self._ready.popleft()
+                if isinstance(item, _HitTicket):
+                    if not self._admit_hit(item):
+                        blocked.append(item)
+                    continue
+                self._insert_miss_item(item)
+        finally:
+            if blocked:
+                with self._ready_lock:
+                    self._ready.extendleft(reversed(blocked))
+
+    def _insert_miss_item(self, item):
+        slot = self._free_slots.pop()
+        state = _Slot(item.request, item.pages, item.future,
+                      item.t_submit, item.ttft_s, prefix_len=0)
+        state.emitted.append(item.result.first_token)
+        self._slots[slot] = state
+        vec = self.pool.page_vec(item.pages)
+        self.engine.insert(slot, item.result, vec, vec,
+                           self._sampling(item.request))
+        self._register(item.request, item.pages)
+        self._pending_inserts -= 1
+        self._observe_gauges()
+
+    def _admit_hit(self, ticket):
+        """Tick-thread admission of a prefix-cache hit: match (taking
+        pool refs), trim the match until the padded suffix fits the
+        cache, reserve fresh pages for the unshared tail, gather-prefill
+        the suffix, insert, register. Returns False (nothing consumed)
+        when fresh pages cannot be reserved yet."""
+        from cloud_tpu.models.decoding import bucket_length
+
+        request = ticket.request
+        if self._stop.is_set():
             self._pending_inserts -= 1
-            self._observe_gauges()
+            if not ticket.future.done():
+                ticket.future.set_exception(
+                    self._failure or RuntimeError("scheduler closed"))
+            return True
+        prompt = [int(t) for t in request.prompt]
+        prompt_len = len(prompt)
+        page = self.pool.page_size
+        total = self.pool.pages_needed(prompt_len,
+                                       request.max_new_tokens,
+                                       slack=self._spec_slack())
+        match = self.trie.match(prompt)
+        shared = list(match.pages)
+        partial_page = match.partial_page
+        partial_len = match.partial_len
+        prefix_len = match.prefix_len
+        # Trim until prefix + pow2(suffix) fits max_seq_len: drop the
+        # partial first, then whole pages (each dropped page's ref goes
+        # straight back).
+        while prefix_len and (prefix_len + bucket_length(
+                prompt_len - prefix_len, self.engine.max_seq_len)
+                > self.engine.max_seq_len):
+            if partial_len:
+                self.pool.free([partial_page])
+                partial_page, partial_len = None, 0
+            else:
+                self.pool.free([shared.pop()])
+            prefix_len = len(shared) * page + partial_len
+        held = shared + ([partial_page] if partial_len else [])
+        if prefix_len == 0:
+            # Evicted (or trimmed away) between probe and match: it is
+            # a plain miss now — run it here; the tick thread is also
+            # allowed to prefill.
+            if held:
+                self.pool.free(held)
+            return self._admit_miss_on_tick(ticket, total)
+        fresh = self._reserve_with_pressure(total - len(shared),
+                                            timeout=0.01)
+        if fresh is None:
+            self.pool.free(held)
+            return False
+        try:
+            result = self.engine.prefill(
+                np.asarray(prompt, np.int32), request.max_new_tokens,
+                jax.random.PRNGKey(request.rng_seed),
+                self._sampling(request), prefix_len=prefix_len,
+                gather_vec=self.pool.page_vec(held))
+        except BaseException:
+            self.pool.free(held + fresh)
+            raise
+        ttft = time.monotonic() - ticket.t_submit
+        self._record_ttft(ttft, hit=True)
+        self._prefix_tokens_served += prefix_len
+        slot = self._free_slots.pop()
+        state = _Slot(request, shared + fresh, ticket.future,
+                      ticket.t_submit, ttft, prefix_len=prefix_len)
+        state.emitted.append(result.first_token)
+        self._slots[slot] = state
+        page_vec = self.pool.page_vec(shared + fresh)
+        scatter_vec = self.pool.page_vec([0] * len(shared) + fresh)
+        self.engine.insert(slot, result, page_vec, scatter_vec,
+                           self._sampling(request))
+        if partial_len:
+            # The divergent page was reconstructed into its fresh page
+            # by the insert scatter — the device-side copy-on-write.
+            self.pool.note_cow()
+            self.pool.free([partial_page])
+        self._register(request, shared + fresh)
+        self._pending_inserts -= 1
+        self._observe_gauges()
+        return True
+
+    def _admit_miss_on_tick(self, ticket, need):
+        """Fallback when a probed hit vanished before `match`: admit it
+        as a miss without bouncing back to the admission thread."""
+        request = ticket.request
+        pages = self._reserve_with_pressure(need, timeout=0.01)
+        if pages is None:
+            return False
+        try:
+            result = self.engine.prefill(
+                np.asarray(request.prompt, np.int32),
+                request.max_new_tokens,
+                jax.random.PRNGKey(request.rng_seed),
+                self._sampling(request))
+        except BaseException:
+            self.pool.free(pages)
+            raise
+        ttft = time.monotonic() - ticket.t_submit
+        self._record_ttft(ttft, hit=False)
+        slot = self._free_slots.pop()
+        state = _Slot(request, pages, ticket.future, ticket.t_submit,
+                      ttft, prefix_len=0)
+        state.emitted.append(result.first_token)
+        self._slots[slot] = state
+        vec = self.pool.page_vec(pages)
+        self.engine.insert(slot, result, vec, vec,
+                           self._sampling(request))
+        self._register(request, pages)
+        self._pending_inserts -= 1
+        self._observe_gauges()
+        return True
+
+    def _register(self, request, pages):
+        """Indexes the inserted request's full prompt pages (tick
+        thread, right after insert: the pages are populated and
+        immutable from here — decode writes start past the prompt)."""
+        if self.trie is None or request.max_new_tokens <= 1:
+            return
+        self.trie.register([int(t) for t in request.prompt], pages)
 
     def _distribute(self, fetched, elapsed):
-        tokens_row, finished_row = fetched[0], fetched[1]
         n_active = sum(s is not None for s in self._slots)
         if n_active:
             self._token_hist.observe(elapsed, count=n_active)
@@ -392,24 +653,64 @@ class Scheduler:
                 from cloud_tpu.monitoring import telemetry
                 reg.histogram(telemetry.SERVE_TOKEN_HISTOGRAM).observe(
                     elapsed, count=n_active)
+        if self.engine.spec_on:
+            self._distribute_spec(fetched)
+        else:
+            self._distribute_plain(fetched)
+
+    def _distribute_plain(self, fetched):
+        tokens_row, finished_row = fetched[0], fetched[1]
         evict_mask = np.zeros((self.engine.slots,), bool)
         for slot, state in enumerate(self._slots):
             if state is None:
                 continue
             state.emitted.append(int(tokens_row[slot]))
             if finished_row[slot]:
-                evict_mask[slot] = True
-                self._slots[slot] = None
-                self._free_slots.append(slot)
-                self.pool.free(state.pages)
-                self._complete(state.request, state.future,
-                               state.t_submit, state.ttft_s,
-                               state.emitted)
+                self._finish_slot(slot, state, evict_mask)
         if evict_mask.any():
             self.engine.evict(evict_mask)
             self._observe_gauges()
 
-    def _complete(self, request, future, t_submit, ttft, emitted):
+    def _distribute_spec(self, fetched):
+        from cloud_tpu.models.speculative import observe_accept_rate
+
+        k = self.engine.spec_k
+        count_row = fetched[k + 1]
+        finished_row = fetched[k + 2]
+        accept_row = fetched[k + 3]
+        evict_mask = np.zeros((self.engine.slots,), bool)
+        for slot, state in enumerate(self._slots):
+            if state is None:
+                continue
+            c = int(count_row[slot])
+            state.emitted.extend(
+                int(fetched[j][slot]) for j in range(c))
+            n_acc = int(accept_row[slot])
+            if n_acc >= 0:
+                self._accepted_draft_tokens += n_acc
+                self._proposed_draft_tokens += k
+                observe_accept_rate(n_acc, k)
+            if finished_row[slot]:
+                self._finish_slot(slot, state, evict_mask)
+        if evict_mask.any():
+            self.engine.evict(evict_mask)
+            self._observe_gauges()
+
+    def _finish_slot(self, slot, state, evict_mask):
+        evict_mask[slot] = True
+        self._slots[slot] = None
+        self._free_slots.append(slot)
+        self.pool.free(state.pages)
+        self._complete(state.request, state.future, state.t_submit,
+                       state.ttft_s, state.emitted,
+                       prefix_len=state.prefix_len)
+
+    def _complete(self, request, future, t_submit, ttft, emitted,
+                  prefix_len):
+        # A speculative tick can overshoot max_new_tokens by up to
+        # spec_k accepted tokens — the greedy chain is identical, so
+        # truncation is exact.
+        emitted = emitted[:request.max_new_tokens]
         # Early-eos eviction: generate() keeps emitting eos after done,
         # so the bit-identical fill is pure host work.
         if len(emitted) < request.max_new_tokens:
@@ -431,7 +732,8 @@ class Scheduler:
             reg.gauge(telemetry.SERVE_REQUESTS_PER_SEC).set(
                 self._completed / wall)
         future.set_result(ServeResult(tokens=tokens, ttft_s=ttft,
-                                      latency_s=latency))
+                                      latency_s=latency,
+                                      prefix_len=prefix_len))
 
     # -- shared helpers -----------------------------------------------
 
@@ -444,23 +746,40 @@ class Scheduler:
 
     def _observe_gauges(self):
         reg = _registry()
-        if reg is not None:
-            from cloud_tpu.monitoring import telemetry
-            reg.gauge(telemetry.SERVE_ACTIVE_SLOTS).set(
-                sum(s is not None for s in self._slots))
-            reg.gauge(telemetry.SERVE_QUEUE_DEPTH).set(
-                self._admit_q.qsize())
+        if reg is None:
+            return
+        from cloud_tpu.monitoring import telemetry
+        reg.gauge(telemetry.SERVE_ACTIVE_SLOTS).set(
+            sum(s is not None for s in self._slots))
+        reg.gauge(telemetry.SERVE_QUEUE_DEPTH).set(
+            self._admit_q.qsize())
+        pstats = self.pool.pool_stats()
+        reg.gauge(telemetry.SERVE_PAGES_FREE).set(pstats["pages_free"])
+        reg.gauge(telemetry.SERVE_PAGES_SHARED).set(
+            pstats["pages_shared"])
+        reg.gauge(telemetry.SERVE_COW_COPIES).set(pstats["cow_copies"])
+        if self.trie is not None:
+            tstats = self.trie.stats()
+            reg.gauge(telemetry.SERVE_PREFIX_PAGES_HELD).set(
+                tstats["pages_held"])
+            reg.gauge(telemetry.SERVE_PREFIX_EVICTIONS).set(
+                tstats["evictions"])
 
     def _fail_pending(self, error):
         self._pending_inserts = 0
         with self._ready_lock:
             ready, self._ready = list(self._ready), collections.deque()
         for item in ready:
+            if isinstance(item, _ReadyItem) and item.pages:
+                self.pool.free(item.pages)
             if not item.future.done():
                 item.future.set_exception(error)
         for slot, state in enumerate(self._slots):
-            if state is not None and not state.future.done():
-                state.future.set_exception(error)
+            if state is not None:
+                if state.pages:
+                    self.pool.free(state.pages)
+                if not state.future.done():
+                    state.future.set_exception(error)
             self._slots[slot] = None
         while True:
             try:
@@ -470,53 +789,141 @@ class Scheduler:
             if not future.done():
                 future.set_exception(error)
 
+    # -- invariants ---------------------------------------------------
+
+    def assert_drained(self, clear_prefix=False):
+        """Refcount leak detector. With no in-flight work, every held
+        pool page must be exactly one trie reference (refcount 1, page
+        indexed); with `clear_prefix` the trie is dropped first and the
+        pool must be FULLY free. Raises RuntimeError on any leak."""
+        busy = (any(s is not None for s in self._slots)
+                or self._pending_inserts > 0 or self._admit_q.qsize())
+        with self._ready_lock:
+            busy = busy or bool(self._ready)
+        if busy:
+            raise RuntimeError(
+                "assert_drained called with requests in flight.")
+        if clear_prefix and self.trie is not None:
+            self.trie.clear()
+        held = self.pool.leak_report()
+        trie_pages = (set(self.trie.held_pages())
+                      if self.trie is not None else set())
+        leaked = {p: r for p, r in held.items()
+                  if p not in trie_pages or r != 1}
+        if leaked:
+            raise RuntimeError(
+                "page refcount leak (page -> holders, beyond the "
+                "prefix index): {}".format(leaked))
+        if len(held) != len(trie_pages):
+            raise RuntimeError(
+                "prefix index holds {} pages but the pool records {} "
+                "held.".format(len(trie_pages), len(held)))
+
     # -- warm-up + stats ----------------------------------------------
 
     def warmup(self, buckets, sampling_configs=((),), max_new=3):
         """Compiles the whole serving surface for `buckets` x sampling
-        configs: per-bucket prefill (masked and exact-length variants),
-        insert, tick, evict, and the cache-reuse re-zero. Two
-        sequential waves so the second wave's prefills acquire parked
-        caches (compiling the in-place zero executable). Call
+        configs: per-bucket prefill (full and short lengths), insert,
+        tick, evict, and the cache-reuse re-zero. Two sequential waves
+        so the second wave's prefills acquire parked caches (compiling
+        the in-place zero executable). With the prefix cache on, every
+        pow2 width up to the largest bucket is warmed too (a hit's
+        SUFFIX can land in any of them) and a shared-prefix trio
+        compiles the gather + copy-on-write path; the trie is cleared
+        afterwards so warm-up leaves no cached state. Call
         `engine.mark_warm()` is implicit — after warmup the retrace
         sentinel is armed."""
+        from cloud_tpu.models.decoding import bucket_length
+
+        vocab = self.engine.model.vocab_size
         configs = []
         for cfg in sampling_configs:
             merged = dict(temperature=0.0, top_k=None, top_p=None,
                           eos_token=None)
             merged.update(dict(cfg))
             configs.append(merged)
+        widths = set(buckets)
+        if self.trie is not None and buckets:
+            w = 1
+            while w <= max(buckets):
+                widths.add(w)
+                w *= 2
+        # Distinct first tokens keep warm-up prompts from prefix-
+        # matching EACH OTHER — a warm-up hit would compile its suffix
+        # bucket instead of the width it was meant to compile.
+        combo = 0
+        # Widest buckets can't host a full-length prompt AND max_new
+        # decode positions — cap warm-up lengths so the request
+        # validates; bucket_length() still maps the capped length to
+        # the intended width.
+        cap = self.engine.max_seq_len - max_new - self._spec_slack()
         for _ in range(2):
             futures = []
-            for bucket in buckets:
-                for length in {bucket, max(bucket - 1, 1)}:
-                    if self._bucket(ServeRequest(
-                            prompt=[1] * length,
-                            max_new_tokens=max_new)) != bucket:
+            for bucket in sorted(widths):
+                for length in sorted({min(bucket, cap),
+                                      min(max(bucket - 1, 1), cap)}):
+                    if length < 1 or bucket_length(
+                            length, self.engine.max_seq_len) != bucket:
                         continue
                     for cfg in configs:
+                        first = 2 + combo % max(vocab - 2, 1)
+                        combo += 1
                         futures.append(self.submit(ServeRequest(
-                            prompt=[1] * length,
+                            prompt=[first] + [1] * (length - 1),
                             max_new_tokens=max_new, **cfg)))
             for future in futures:
                 future.result(timeout=600)
+        if self.trie is not None:
+            self._warm_prefix_path(configs[0])
+            self.trie.clear()
+            self.trie.reset_stats()
         self.engine.mark_warm()
         # Warm-up TTFTs are compile times; restart the host-side stats
         # so `stats()` describes warm traffic only.
         from cloud_tpu.monitoring.telemetry import Histogram
         self._ttft_hist = Histogram("ttft")
+        self._ttft_hit_hist = Histogram("ttft_hit")
+        self._ttft_miss_hist = Histogram("ttft_miss")
         self._token_hist = Histogram("token_latency")
         self._completed = 0
         self._tokens_out = 0
         self._ticks = 0
+        self._hits = 0
+        self._misses = 0
+        self._prefix_tokens_served = 0
+        self._accepted_draft_tokens = 0
+        self._proposed_draft_tokens = 0
         self._t_start = time.monotonic()
+
+    def _warm_prefix_path(self, cfg):
+        """Shared-prefix trio: a miss that registers a page, a mid-page
+        divergence (gather + CoW reconstruction), and a clean full-page
+        hit — compiles the gather executables (target and draft trees)
+        and exercises the hit insert before the sentinel arms."""
+        page = self.pool.page_size
+        vocab = self.engine.model.vocab_size
+        base_len = page + page // 2
+        if (page < 4 or vocab < 4 or base_len + 2 + self._spec_slack()
+                > self.engine.max_seq_len):
+            return
+        base = [1] * base_len
+        prompts = [
+            base,                                       # miss, registers
+            base[:(3 * page) // 4] + [2] * (base_len - (3 * page) // 4),
+            base[:page] + [3] * (base_len - page),      # full-page hit
+        ]
+        for prompt in prompts:
+            self.submit(ServeRequest(prompt=prompt, max_new_tokens=2,
+                                     **cfg)).result(timeout=600)
 
     def stats(self):
         """Host-side rollup for bench/smoke (works with telemetry
         off)."""
         wall = max(time.monotonic() - (self._t_start or
                                        time.monotonic()), 1e-9)
-        return {
+        lookups = self._hits + self._misses
+        proposed = self._proposed_draft_tokens
+        out = {
             "requests_completed": self._completed,
             "tokens_emitted": self._tokens_out,
             "ticks": self._ticks,
@@ -524,23 +931,23 @@ class Scheduler:
             "requests_per_sec": self._completed / wall,
             "tokens_per_sec": self._tokens_out / wall,
             "ttft": self._ttft_hist.snapshot(),
+            "ttft_hit": self._ttft_hit_hist.snapshot(),
+            "ttft_miss": self._ttft_miss_hist.snapshot(),
             "token_latency": self._token_hist.snapshot(),
             "queue_depth": self._admit_q.qsize(),
+            "prefix_hits": self._hits,
+            "prefix_misses": self._misses,
+            "prefix_hit_rate": self._hits / lookups if lookups else 0.0,
+            "prefix_tokens_served": self._prefix_tokens_served,
+            "pool": self.pool.pool_stats(),
+            "spec_accept_rate": (self._accepted_draft_tokens / proposed
+                                 if proposed else 0.0),
+            "spec_accepted_tokens": self._accepted_draft_tokens,
+            "spec_proposed_tokens": proposed,
         }
-
-
-class _ReadyItem:
-    __slots__ = ("request", "result", "pages", "future", "t_submit",
-                 "ttft_s")
-
-    def __init__(self, request, result, pages, future, t_submit,
-                 ttft_s):
-        self.request = request
-        self.result = result
-        self.pages = pages
-        self.future = future
-        self.t_submit = t_submit
-        self.ttft_s = ttft_s
+        if self.trie is not None:
+            out["prefix_cache"] = self.trie.stats()
+        return out
 
 
 __all__ = ["ServeRequest", "ServeResult", "Scheduler"]

@@ -1,25 +1,47 @@
-"""graftserve smoke: the continuous-vs-synchronous proof, CPU-sized.
+"""graftserve smoke: the serving acceptance contracts, CPU-sized.
 
-`python -m cloud_tpu.serving.smoke` runs ≥8 concurrent mixed-length
-requests through the scheduler and enforces the serving acceptance
-contract end to end:
+`python -m cloud_tpu.serving.smoke [--scenario base|prefix|spec|all]`
+runs the continuous-batching scheduler through three end-to-end
+scenarios, each enforcing its slice of the serving contract:
 
-1. THROUGHPUT — aggregate tokens/sec must be >= MIN_SPEEDUP (2.0) times
-   a batch-synchronous baseline: `generate()` over FCFS arrival-order
-   batches at the SAME slot count, each batch running to its longest
-   member's max_new_tokens (the hostage effect continuous batching
-   exists to kill). Both sides are timed warm.
-2. ZERO RETRACE — after `Scheduler.warmup()`, the whole serve pass must
-   add zero traces and zero compiles (`runtime.compile_stats` delta;
-   the engine's sentinel also runs in strict mode every tick).
-3. BIT-IDENTICAL / NO LEAKAGE — every served request's tokens must
-   equal its solo `generate()` decode exactly. Slots are reused across
-   requests (more requests than slots), so equality is also the
-   cross-request leakage check: a stale page or validity row would
-   corrupt some continuation.
+base (ISSUE 10) — ≥8 concurrent mixed-length requests:
+  1. THROUGHPUT — aggregate tokens/sec >= MIN_SPEEDUP (2.0) times a
+     batch-synchronous baseline: `generate()` over FCFS arrival-order
+     batches at the SAME slot count, each batch running to its longest
+     member's max_new_tokens (the hostage effect continuous batching
+     exists to kill). Both sides are timed warm.
+  2. ZERO RETRACE — after `Scheduler.warmup()`, the whole serve pass
+     must add zero traces and zero compiles (`runtime.compile_stats`
+     delta; the engine's sentinel also runs strict every tick).
+  3. BIT-IDENTICAL / NO LEAKAGE — every served request's tokens must
+     equal its solo `generate()` decode exactly. Slots are reused
+     across requests, so equality doubles as the cross-request leakage
+     check.
 
-Writes `serving_smoke.json` (summary) next to the graftscope artifacts
-(`telemetry.jsonl` etc.) in --out-dir; CI uploads the directory.
+prefix (ISSUE 11, graftshare) — a 90%-shared-prefix fleet served twice,
+  prefix cache ON then OFF (same requests, same model):
+  4. TTFT — the ON run's cache-hit TTFT p50 must be >= MIN_TTFT_RATIO
+     (5.0) times better than the OFF run's TTFT p50: radix-matched
+     pages map into the new request's page table and only the suffix
+     prefills, so TTFT drops from O(prompt) to O(suffix).
+  5. Zero post-warmup traces with the cache on (hit prefills reuse the
+     miss executables), bit-identity regardless of sharing, and the
+     drained-pool invariant: after the fleet completes, every held page
+     is exactly one prefix-cache reference (refcount leak detector).
+
+spec (ISSUE 11, speculative tick) — greedy fleet served twice, plain
+  tick then speculative (draft model + verify inside the same tick):
+  6. THROUGHPUT — tokens/sec with speculation >= MIN_SPEC_SPEEDUP (1.5)
+     times the plain tick. The draft here shares the target's first
+     block and head while the target's remaining blocks are exact
+     zero-residual identities, so draft and target agree by
+     construction (acceptance 1.0) — the gate measures the tick
+     plumbing's ceiling, not draft quality.
+  7. Bit-identity to solo generate() (the pinned accept/reject math),
+     zero post-warmup traces, drained pool.
+
+Each scenario writes `serving_smoke[_<name>].json` next to the
+graftscope artifacts in --out-dir; CI uploads the directory.
 """
 
 import argparse
@@ -31,24 +53,32 @@ import time
 import numpy as np
 
 MIN_SPEEDUP = 2.0
+MIN_TTFT_RATIO = 5.0
+MIN_SPEC_SPEEDUP = 1.5
 
 
-def build_model():
+def build_model(max_seq_len=64, num_layers=6):
     """CPU-friendly but big enough that a decode tick is device-bound
     (the host round trip per tick must not dominate the comparison)."""
     import jax.numpy as jnp
 
     from cloud_tpu.models import TransformerLM
-    return TransformerLM(vocab_size=1024, num_layers=6, num_heads=6,
-                         d_model=384, d_ff=1536, max_seq_len=64,
+    return TransformerLM(vocab_size=1024, num_layers=num_layers,
+                         num_heads=6, d_model=384, d_ff=1536,
+                         max_seq_len=max_seq_len,
                          compute_dtype=jnp.float32)
 
 
-def build_requests(slots, waves=None):
+def build_requests(slots, waves=None, prefix_share=0.0, seed=42):
     """Mixed-length arrival pattern, one long + (slots-1) shorts per
     wave: under FCFS batch-synchronous decode every batch is hostage to
     its long request; under continuous batching the shorts stream
-    through the other slots."""
+    through the other slots. `prefix_share` makes that fraction of the
+    short requests share one 32-token prompt prefix (distinct tails) —
+    the graftshare bench knob. Sharing shrinks the long request's
+    continuation (48 → 24): the batch-synchronous baseline pads its
+    batch prompt to the widest member (32 + tail), and padded prompt +
+    the batch's max_new must still fit build_model's max_seq_len."""
     from cloud_tpu.serving import ServeRequest
 
     if waves is None:
@@ -56,19 +86,81 @@ def build_requests(slots, waves=None):
         # serve makespan stays near ONE long (48 ticks) while the
         # baseline pays 48 steps per hostage batch.
         waves = slots
-    rng = np.random.default_rng(42)
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(1, 512, (32,)).astype(np.int32).tolist()
+    long_new = 48 if prefix_share <= 0.0 else 24
     requests = []
     for wave in range(waves):
-        specs = [(int(rng.integers(9, 17)), 48)]
-        specs += [(int(rng.integers(3, 9)), int(rng.integers(1, 4)))
+        specs = [(int(rng.integers(9, 17)), long_new, False)]
+        specs += [(int(rng.integers(3, 9)), int(rng.integers(1, 4)),
+                   float(rng.random()) < prefix_share)
                   for _ in range(slots - 1)]
-        for plen, max_new in specs:
+        for plen, max_new, share in specs:
+            tail = rng.integers(1, 512, (plen,)).astype(np.int32).tolist()
             requests.append(ServeRequest(
-                prompt=rng.integers(1, 512, (plen,)).astype(
-                    np.int32).tolist(),
+                prompt=(shared + tail) if share else tail,
                 max_new_tokens=max_new, temperature=0.0,
                 rng_seed=1000 + len(requests)))
     return requests
+
+
+def build_prefix_requests(model, n_requests=20, share=0.9,
+                          suffix_lo=2, suffix_hi=4, max_new=2,
+                          seed=7):
+    """`share` of the fleet extends one long common prefix (distinct
+    short tails); the rest are fully distinct long prompts. The prefix
+    fills all but one page-and-change of the context so a cache hit
+    prefills ~suffix tokens instead of ~prefix_len."""
+    from cloud_tpu.serving import ServeRequest
+
+    rng = np.random.default_rng(seed)
+    prefix_len = model.max_seq_len - 16
+    roots = [rng.integers(1, 512, (prefix_len,)).astype(np.int32).tolist()
+             for _ in range(2)]
+    requests = []
+    for i in range(n_requests):
+        root = roots[0] if (i % n_requests) < share * n_requests \
+            else roots[1]
+        tail = rng.integers(1, 512, (int(rng.integers(
+            suffix_lo, suffix_hi + 1)),)).astype(np.int32).tolist()
+        requests.append(ServeRequest(
+            prompt=root + tail, max_new_tokens=max_new,
+            temperature=0.0, rng_seed=2000 + i))
+    return requests
+
+
+def split_draft(params, draft_layers=1):
+    """Makes (target_params, draft_params) that agree by construction:
+    the draft keeps the first `draft_layers` blocks + embeddings + head
+    verbatim, and every later target block is forced to an exact
+    identity (zero attention-out and mlp-out projections → pre-norm
+    residual adds exact 0.0). Target and draft logits are then equal,
+    so greedy speculation accepts every proposal — the smoke measures
+    the tick's speculative plumbing at its acceptance ceiling."""
+    import jax.numpy as jnp
+
+    def _zeroed(tree):
+        return {k: jnp.zeros_like(v) if not isinstance(v, dict)
+                else _zeroed(v) for k, v in tree.items()}
+
+    target = dict(params)
+    draft = {}
+    n_blocks = sum(1 for k in params if k.startswith("block_"))
+    for name, sub in params.items():
+        if not name.startswith("block_"):
+            draft[name] = sub
+            continue
+        idx = int(name.split("_")[1])
+        if idx < draft_layers:
+            draft[name] = sub
+        else:
+            blk = dict(sub)
+            blk["attention"] = dict(blk["attention"],
+                                    out=_zeroed(sub["attention"]["out"]))
+            blk["mlp_out"] = _zeroed(sub["mlp_out"])
+            target[name] = blk
+    assert n_blocks > draft_layers
+    return target, draft
 
 
 def solo_oracle(model, params, requests):
@@ -126,16 +218,21 @@ def run_serve(scheduler, requests):
     return results, sum(r.max_new_tokens for r in requests), elapsed
 
 
-def main(argv=None):
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--out-dir", default=os.environ.get(
-        "CLOUD_TPU_TELEMETRY_DIR", "serving-smoke-out"))
-    parser.add_argument("--slots", type=int, default=8)
-    parser.add_argument("--min-speedup", type=float, default=float(
-        os.environ.get("CLOUD_TPU_SMOKE_MIN_SPEEDUP", MIN_SPEEDUP)))
-    args = parser.parse_args(argv)
+def _write_summary(out_dir, name, summary):
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, name), "w") as fh:
+        json.dump(summary, fh, indent=2, sort_keys=True)
 
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+def _check(failures, tag):
+    if failures:
+        print("[smoke:{}] FAIL: {}".format(tag, "; ".join(failures)))
+        return 1
+    print("[smoke:{}] PASS".format(tag))
+    return 0
+
+
+def run_base(args):
     import jax
     import jax.numpy as jnp
 
@@ -149,9 +246,9 @@ def main(argv=None):
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
 
-    print("[smoke] solo oracle ({} requests)".format(len(requests)))
+    print("[smoke:base] solo oracle ({} requests)".format(len(requests)))
     oracle = solo_oracle(model, params, requests)
-    print("[smoke] batch-synchronous baseline (slots={})".format(
+    print("[smoke:base] batch-synchronous baseline (slots={})".format(
         args.slots))
     run_baseline(model, params, requests, args.slots, timed=False)
     base_tokens, base_secs = run_baseline(model, params, requests,
@@ -170,14 +267,15 @@ def main(argv=None):
                           strict_no_retrace=True).start()
     try:
         buckets = sorted({scheduler._bucket(r) for r in requests})
-        print("[smoke] warmup over buckets {}".format(buckets))
+        print("[smoke:base] warmup over buckets {}".format(buckets))
         scheduler.warmup(buckets,
                          sampling_configs=[(("temperature", 0.0),)])
         warm = runtime.compile_stats()
-        print("[smoke] serve pass")
+        print("[smoke:base] serve pass")
         results, serve_tokens, serve_secs = run_serve(scheduler,
                                                       requests)
         after = runtime.compile_stats()
+        stats = scheduler.stats()
     finally:
         scheduler.close()
         watch.uninstall()
@@ -189,7 +287,6 @@ def main(argv=None):
     base_tps = base_tokens / base_secs
     serve_tps = serve_tokens / serve_secs
     speedup = serve_tps / base_tps
-    stats = scheduler.stats()
 
     summary = {
         "requests": len(requests),
@@ -204,20 +301,18 @@ def main(argv=None):
         "ttft_p50_s": stats["ttft"].get("p50"),
         "token_latency_p99_s": stats["token_latency"].get("p99"),
         "requests_per_sec": stats["requests_per_sec"],
+        "prefix_hit_rate": stats["prefix_hit_rate"],
     }
-    os.makedirs(args.out_dir, exist_ok=True)
-    with open(os.path.join(args.out_dir, "serving_smoke.json"),
-              "w") as fh:
-        json.dump(summary, fh, indent=2, sort_keys=True)
+    _write_summary(args.out_dir, "serving_smoke.json", summary)
     tele = telemetry.get()
     if tele is not None:
         tele.flush(wait=True)
         telemetry.disable()
 
-    print("[smoke] baseline {:.1f} tok/s | serve {:.1f} tok/s | "
+    print("[smoke:base] baseline {:.1f} tok/s | serve {:.1f} tok/s | "
           "speedup {:.2f}x (floor {:.1f}x)".format(
               base_tps, serve_tps, speedup, args.min_speedup))
-    print("[smoke] post-warmup traces={} compiles={} | "
+    print("[smoke:base] post-warmup traces={} compiles={} | "
           "mismatches={}".format(new_traces, new_compiles,
                                  len(mismatches)))
     failures = []
@@ -231,11 +326,235 @@ def main(argv=None):
         failures.append("requests {} diverged from solo generate() "
                         "(cross-request leakage or rng drift)".format(
                             mismatches))
-    if failures:
-        print("[smoke] FAIL: " + "; ".join(failures))
-        return 1
-    print("[smoke] PASS")
-    return 0
+    return _check(failures, "base")
+
+
+def run_prefix(args):
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.serving import Scheduler
+
+    model = build_model(max_seq_len=256)
+    requests = build_prefix_requests(model)
+    n_shared = sum(1 for r in requests
+                   if r.prompt[:16] == requests[0].prompt[:16])
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    print("[smoke:prefix] solo oracle ({} requests, {} share a "
+          "prefix)".format(len(requests), n_shared))
+    oracle = solo_oracle(model, params, requests)
+
+    def _serve(prefix_cache):
+        scheduler = Scheduler(model, params, slots=2, page_size=16,
+                              admission_window=4,
+                              strict_no_retrace=True,
+                              prefix_cache=prefix_cache).start()
+        try:
+            buckets = sorted({scheduler._bucket(r) for r in requests})
+            scheduler.warmup(buckets,
+                             sampling_configs=[(("temperature", 0.0),)])
+            warm = runtime.compile_stats()
+            # Sequential submits: each TTFT is pure admission+prefill,
+            # not queue wait — the honest O(prompt) vs O(suffix) read.
+            results = [scheduler.submit(r, timeout=30).result(
+                timeout=600) for r in requests]
+            after = runtime.compile_stats()
+            stats = scheduler.stats()
+            time.sleep(0.3)
+            if prefix_cache:
+                scheduler.assert_drained()          # trie refs only
+                scheduler.assert_drained(clear_prefix=True)
+            leaked = scheduler.pool.leak_report()
+            return results, stats, leaked, (
+                after["n_traces"] - warm["n_traces"],
+                after["n_compiles"] - warm["n_compiles"])
+        finally:
+            scheduler.close()
+
+    print("[smoke:prefix] serve pass (prefix cache ON)")
+    on_results, on_stats, on_leaked, on_traces = _serve(True)
+    print("[smoke:prefix] serve pass (prefix cache OFF)")
+    off_results, off_stats, _, _ = _serve(False)
+
+    mism_on = [i for i, (res, ref) in enumerate(zip(on_results, oracle))
+               if not np.array_equal(res.tokens, ref)]
+    mism_off = [i for i, (res, ref) in enumerate(zip(off_results,
+                                                     oracle))
+                if not np.array_equal(res.tokens, ref)]
+    hit_p50 = on_stats["ttft_hit"].get("p50")
+    off_p50 = off_stats["ttft"].get("p50")
+    ratio = (off_p50 / hit_p50) if hit_p50 else 0.0
+
+    summary = {
+        "requests": len(requests),
+        "shared_fraction": n_shared / len(requests),
+        "prefix_hits": on_stats["prefix_hits"],
+        "prefix_hit_rate": on_stats["prefix_hit_rate"],
+        "prefix_tokens_served": on_stats["prefix_tokens_served"],
+        "cow_copies": on_stats["pool"]["cow_copies"],
+        "ttft_hit_p50_s": hit_p50,
+        "ttft_miss_p50_s": on_stats["ttft_miss"].get("p50"),
+        "ttft_off_p50_s": off_p50,
+        "ttft_ratio": ratio,
+        "min_ttft_ratio": args.min_ttft_ratio,
+        "new_traces_post_warmup": on_traces[0],
+        "new_compiles_post_warmup": on_traces[1],
+        "mismatched_on": mism_on,
+        "mismatched_off": mism_off,
+        "leaked_pages": on_leaked,
+    }
+    _write_summary(args.out_dir, "serving_smoke_prefix.json", summary)
+
+    print("[smoke:prefix] TTFT p50 off {:.4f}s | hit {:.4f}s | ratio "
+          "{:.1f}x (floor {:.1f}x) | hits {}/{}".format(
+              off_p50 or -1, hit_p50 or -1, ratio, args.min_ttft_ratio,
+              on_stats["prefix_hits"], len(requests)))
+    failures = []
+    if ratio < args.min_ttft_ratio:
+        failures.append("TTFT ratio {:.2f}x < {:.1f}x".format(
+            ratio, args.min_ttft_ratio))
+    if on_stats["prefix_hits"] < n_shared - 1:
+        failures.append("only {} cache hits (expected >= {})".format(
+            on_stats["prefix_hits"], n_shared - 1))
+    if on_traces[0] or on_traces[1]:
+        failures.append("retrace after warmup with prefix cache on "
+                        "({} traces, {} compiles)".format(*on_traces))
+    if mism_on or mism_off:
+        failures.append("diverged from solo generate(): on={} off={}"
+                        .format(mism_on, mism_off))
+    if on_leaked:
+        failures.append("page refcount leak after drain: {}".format(
+            on_leaked))
+    return _check(failures, "prefix")
+
+
+def run_spec(args):
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.serving import Scheduler
+
+    model = build_model()
+    draft_model = build_model(num_layers=1)
+    base_params = model.init(jax.random.PRNGKey(1),
+                             jnp.zeros((1, 8), jnp.int32))["params"]
+    params, draft_params = split_draft(base_params, draft_layers=1)
+
+    rng = np.random.default_rng(3)
+    from cloud_tpu.serving import ServeRequest
+    requests = [ServeRequest(
+        prompt=rng.integers(1, 512, (int(rng.integers(6, 13)),))
+        .astype(np.int32).tolist(),
+        max_new_tokens=40, temperature=0.0, rng_seed=3000 + i)
+        for i in range(12)]
+
+    print("[smoke:spec] solo oracle ({} requests)".format(len(requests)))
+    oracle = solo_oracle(model, params, requests)
+
+    def _serve(spec_k):
+        kwargs = {}
+        if spec_k:
+            kwargs = dict(draft_model=draft_model,
+                          draft_params=draft_params, spec_k=spec_k)
+        scheduler = Scheduler(model, params, slots=4, page_size=16,
+                              admission_window=len(requests),
+                              strict_no_retrace=True, **kwargs).start()
+        try:
+            buckets = sorted({scheduler._bucket(r) for r in requests})
+            scheduler.warmup(buckets,
+                             sampling_configs=[(("temperature", 0.0),)])
+            warm = runtime.compile_stats()
+            results, tokens, secs = run_serve(scheduler, requests)
+            after = runtime.compile_stats()
+            stats = scheduler.stats()
+            time.sleep(0.3)
+            scheduler.assert_drained(clear_prefix=True)
+            return results, tokens / secs, stats, (
+                after["n_traces"] - warm["n_traces"],
+                after["n_compiles"] - warm["n_compiles"])
+        finally:
+            scheduler.close()
+
+    print("[smoke:spec] serve pass (plain tick)")
+    plain_results, plain_tps, _, _ = _serve(0)
+    print("[smoke:spec] serve pass (speculative, k={})".format(
+        args.spec_k))
+    spec_results, spec_tps, spec_stats, spec_traces = _serve(
+        args.spec_k)
+
+    mism = [i for i, (res, ref) in enumerate(zip(spec_results, oracle))
+            if not np.array_equal(res.tokens, ref)]
+    mism_plain = [i for i, (res, ref) in
+                  enumerate(zip(plain_results, oracle))
+                  if not np.array_equal(res.tokens, ref)]
+    speedup = spec_tps / plain_tps
+
+    summary = {
+        "requests": len(requests),
+        "spec_k": args.spec_k,
+        "plain_tokens_per_sec": plain_tps,
+        "spec_tokens_per_sec": spec_tps,
+        "speedup": speedup,
+        "min_speedup": args.min_spec_speedup,
+        "spec_accept_rate": spec_stats["spec_accept_rate"],
+        "new_traces_post_warmup": spec_traces[0],
+        "new_compiles_post_warmup": spec_traces[1],
+        "mismatched_spec": mism,
+        "mismatched_plain": mism_plain,
+    }
+    _write_summary(args.out_dir, "serving_smoke_spec.json", summary)
+
+    print("[smoke:spec] plain {:.1f} tok/s | spec {:.1f} tok/s | "
+          "speedup {:.2f}x (floor {:.1f}x) | accept {:.2f}".format(
+              plain_tps, spec_tps, speedup, args.min_spec_speedup,
+              spec_stats["spec_accept_rate"]))
+    failures = []
+    if speedup < args.min_spec_speedup:
+        failures.append("spec speedup {:.2f}x < {:.1f}x".format(
+            speedup, args.min_spec_speedup))
+    if spec_stats["spec_accept_rate"] < 0.9:
+        failures.append(
+            "accept rate {:.2f} < 0.9 with an agree-by-construction "
+            "draft (verify math drifted)".format(
+                spec_stats["spec_accept_rate"]))
+    if spec_traces[0] or spec_traces[1]:
+        failures.append("retrace after warmup with speculation on "
+                        "({} traces, {} compiles)".format(*spec_traces))
+    if mism or mism_plain:
+        failures.append("diverged from solo generate(): spec={} "
+                        "plain={}".format(mism, mism_plain))
+    return _check(failures, "spec")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out-dir", default=os.environ.get(
+        "CLOUD_TPU_TELEMETRY_DIR", "serving-smoke-out"))
+    parser.add_argument("--scenario", default="base",
+                        choices=["base", "prefix", "spec", "all"])
+    parser.add_argument("--slots", type=int, default=8)
+    parser.add_argument("--spec-k", type=int, default=3)
+    parser.add_argument("--min-speedup", type=float, default=float(
+        os.environ.get("CLOUD_TPU_SMOKE_MIN_SPEEDUP", MIN_SPEEDUP)))
+    parser.add_argument("--min-ttft-ratio", type=float, default=float(
+        os.environ.get("CLOUD_TPU_SMOKE_MIN_TTFT_RATIO",
+                       MIN_TTFT_RATIO)))
+    parser.add_argument("--min-spec-speedup", type=float, default=float(
+        os.environ.get("CLOUD_TPU_SMOKE_MIN_SPEC_SPEEDUP",
+                       MIN_SPEC_SPEEDUP)))
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    scenarios = {"base": [run_base], "prefix": [run_prefix],
+                 "spec": [run_spec],
+                 "all": [run_base, run_prefix, run_spec]}[args.scenario]
+    rc = 0
+    for scenario in scenarios:
+        rc = scenario(args) or rc
+    return rc
 
 
 if __name__ == "__main__":
