@@ -62,6 +62,27 @@ if "--cpu" in sys.argv[1:]:
                    ("BENCH_CHUNK", "2")):
         os.environ.setdefault(_k, _v)
 
+# Named bench configs: the fair-game ResNet variants that keep
+# resurfacing in sweeps get first-class names, so
+# `BENCH_CONFIG=bf16_input python bench.py` reproduces the exact knob
+# set a recorded series claims instead of a hand-typed env pile.
+# Expanded (setdefault) BEFORE the pin block: a named config is
+# explicit user intent, so its keys look explicitly-set to the pin
+# loop and are never overridden by best_pin.json; explicit env still
+# beats the named config.
+NAMED_CONFIGS = {
+    "bf16_input": {"BENCH_BF16_INPUT": "1"},
+    "space_to_depth": {"BENCH_S2D": "1"},
+    "bf16_s2d": {"BENCH_BF16_INPUT": "1", "BENCH_S2D": "1"},
+}
+_CFG_NAME = os.environ.get("BENCH_CONFIG", "")
+if _CFG_NAME:
+    if _CFG_NAME not in NAMED_CONFIGS:
+        sys.exit("BENCH_CONFIG=%r unknown (choose from: %s)"
+                 % (_CFG_NAME, ", ".join(sorted(NAMED_CONFIGS))))
+    for _k, _v in NAMED_CONFIGS[_CFG_NAME].items():
+        os.environ.setdefault(_k, _v)
+
 # BENCH_* keys whose values came from the pin file. BENCH_PIN_APPLIED
 # is a parent->worker handoff, not user configuration: the worker
 # subprocess inherits the parent's post-pin env (so every pinned key
@@ -201,8 +222,17 @@ def _metric_name():
     if os.environ.get("BENCH_SERVE", "0") == "1":
         # A different measurement entirely (continuous-batching decode,
         # not training throughput): its own metric name, its own cache
-        # slot (_series_path gives foreign names their own file).
-        return "graftserve_decode_tokens_per_sec"
+        # slot (_series_path gives foreign names their own file). A
+        # CLOUD_TPU_PAGED_KERNEL force-override is an A/B contrast
+        # series — suffixed so kernel-on/off records never share a
+        # cache slot with each other or with the auto flagship.
+        name = "graftserve_decode_tokens_per_sec"
+        forced = os.environ.get("CLOUD_TPU_PAGED_KERNEL", "")
+        if forced == "1":
+            name += "_pk_on"
+        elif forced == "0":
+            name += "_pk_off"
+        return name
     # Architecture/feeding variants are suffixed so recorded numbers
     # (including failed runs) stay apples-to-apples per series.
     name = METRIC
@@ -437,6 +467,13 @@ def _requested_config():
             # prompt prefix (0 = no sharing, the pre-ISSUE-11 shape;
             # the sweep runs 0 / 0.5 / 0.9).
             "prefix_share": _env_float("BENCH_SERVE_PREFIX_SHARE", 0.0),
+            # Paged decode-attention impl the serve series ran under
+            # (ops/paged_attention.py): "on"/"off" when
+            # CLOUD_TPU_PAGED_KERNEL force-overrides, else "auto"
+            # (kernel on TPU, reference elsewhere). Recorded so an
+            # A/B pair of serve records is self-describing.
+            "paged_kernel": {"1": "on", "0": "off"}.get(
+                os.environ.get("CLOUD_TPU_PAGED_KERNEL", ""), "auto"),
         }
     cfg = {
         "batch": BATCH,
@@ -457,6 +494,10 @@ def _requested_config():
     for key in ("CLOUD_TPU_FLASH_BLOCK_Q", "CLOUD_TPU_FLASH_BLOCK_K"):
         if os.environ.get(key):
             cfg[key.lower()] = _env_int(key, 0)
+    if _CFG_NAME:
+        # Provenance only (the expanded knobs above are what the run
+        # measured); absent on legacy records, so only set when used.
+        cfg["named_config"] = _CFG_NAME
     if _PIN_APPLIED:
         cfg["pinned"] = list(_PIN_APPLIED)
     return cfg
@@ -481,11 +522,12 @@ def _captured_config(record):
 
 
 def _config_mismatch(requested, captured):
-    """True iff any knob differs. `pinned` is provenance, not a knob;
-    a key absent on one side compares as its absent-default (None for
-    sizes, which only happens on hand-seeded records — an honest
-    mismatch)."""
-    keys = (set(requested) | set(captured)) - {"pinned"}
+    """True iff any knob differs. `pinned` and `named_config` are
+    provenance, not knobs (a named config expands to the same env
+    knobs an explicit run would set); a key absent on one side
+    compares as its absent-default (None for sizes, which only happens
+    on hand-seeded records — an honest mismatch)."""
+    keys = (set(requested) | set(captured)) - {"pinned", "named_config"}
     return any(requested.get(k) != captured.get(k) for k in keys)
 
 
@@ -827,6 +869,10 @@ def _serve_worker():
         _d2h_after = runtime_lib.transfer_stats()
         after = runtime_lib.compile_stats()
         stats = scheduler.stats()
+        # Model-exact per-tick cost of the paged decode-attention op
+        # (ops/paged_attention.py cost hook; what the scheduler feeds
+        # the kernel pct_peak/bytes gauges every tick).
+        kernel_costs = scheduler.engine.kernel_costs()
     finally:
         scheduler.close()
 
@@ -852,6 +898,14 @@ def _serve_worker():
         "token_latency_p50_s": round(stats["token_latency"]["p50"], 5),
         "token_latency_p95_s": round(stats["token_latency"]["p95"], 5),
         "token_latency_p99_s": round(stats["token_latency"]["p99"], 5),
+        # Paged decode-attention A/B field (ops/paged_attention.py):
+        # which impl served this record's token latencies.
+        "paged_kernel": {"1": "on", "0": "off"}.get(
+            os.environ.get("CLOUD_TPU_PAGED_KERNEL", ""), "auto"),
+        "paged_attention_flops_per_tick": kernel_costs[
+            "paged_attention"]["flops"],
+        "paged_attention_bytes_per_tick": kernel_costs[
+            "paged_attention"]["bytes_moved"],
         # graftshare census: hit/miss TTFT split + cache effectiveness.
         # Hit percentiles are None at prefix_share=0 (empty histogram).
         "prefix_share": prefix_share,

@@ -14,7 +14,7 @@ measurable.
 
 Usage: python benchmarks/sweep.py [--batches 256,512,128] [--s2d 0,1]
        [--spe 5,10,1] [--bf16-input 0,1] [--resident 0,1]
-       [--async-log 0,1] [--warm 0,1]
+       [--async-log 0,1] [--warm 0,1] [--configs bf16_input,...]
 """
 
 import argparse
@@ -51,6 +51,32 @@ def run_point(batch, s2d, spe, timeout, bf16_input=0, resident=0,
     # Per-POINT chip lock: between points the flock is free, so a
     # concurrent flagship bench.py grabs the chip within one point's
     # duration instead of waiting out the whole sweep.
+    with point_lock(timeout=timeout):
+        record, err = run_json_point(
+            [sys.executable, BENCH, "--worker"], timeout, _REPO_ROOT,
+            env=env, error_extra=point)
+    if record is None:
+        return err
+    record.update(point)
+    return record
+
+
+def run_named_point(name, timeout):
+    """One bench.py NAMED_CONFIGS point (BENCH_CONFIG=<name>).
+
+    The name is passed through and expanded by bench.py itself — the
+    sweep never duplicates the knob table, so the two can't drift; an
+    unknown name comes back as an error record, not a crash. Named
+    points ride at the pinned operating point (batch/spe from
+    best_pin.json when present) — they measure the variant's delta at
+    the flagship shape, not a new grid.
+    """
+    env = dict(
+        os.environ,
+        BENCH_CONFIG=name,
+        BENCH_SKIP_KERNEL_PARITY="1",
+    )
+    point = {"config": name}
     with point_lock(timeout=timeout):
         record, err = run_json_point(
             [sys.executable, BENCH, "--worker"], timeout, _REPO_ROOT,
@@ -100,6 +126,14 @@ def main(argv=None):
     # grid — pass --warm 0,1 to sweep it. Never pinned, like
     # --async-log: it names a cold-start regime, not a chip knob.
     parser.add_argument("--warm", default="0")
+    # Named bench configs (bench.py NAMED_CONFIGS: bf16_input,
+    # space_to_depth, bf16_s2d): extra contrast points run AFTER the
+    # grid at the pinned operating point. Contrast series only — never
+    # eligible for best/--write-pin (a named point can enable s2d,
+    # which changes the model being measured).
+    parser.add_argument("--configs", default="",
+                        help="comma list of bench.py NAMED_CONFIGS "
+                             "names to run as extra contrast points")
     parser.add_argument("--timeout", type=float, default=480.0)
     parser.add_argument("--write-pin", action="store_true",
                         help="write benchmarks/best_pin.json with the "
@@ -138,6 +172,12 @@ def main(argv=None):
                                         or record["value"]
                                         > best["value"]):
                                     best = record
+    # Named contrast points: printed like grid points but kept OUT of
+    # `best`/`records` — a named config may flip s2d (a different
+    # model), so it must never win the pin.
+    for name in [c for c in args.configs.split(",") if c]:
+        print(json.dumps(run_named_point(name, args.timeout)),
+              flush=True)
     if best is None:
         print(json.dumps({"sweep": "failed",
                           "hint": "backend unreachable for every point"}))

@@ -402,6 +402,36 @@ class SwiGLU(nn.Module):
                         name="down")(act(gate) * up)
 
 
+class FusedRMSNorm(nn.Module):
+    """`nn.RMSNorm` stand-in backed by the fused Pallas tail
+    (ops/fused_norm.py): same param ("scale", [features] f32 — so
+    checkpoints and hf_import layouts are unchanged), same f32
+    statistics, bitwise the flax output wherever the lax reference is
+    selected. Called with a `residual`, it ALSO returns the updated
+    residual stream `h = x + residual` — the pre-norm block tail
+    `x = x + y; y = norm(x)` collapses into one HBM pass.
+
+    `impl` follows the block's `attention_impl` ("reference" forces the
+    lax path; anything else auto-selects — Pallas on TPU, lax
+    elsewhere, `CLOUD_TPU_FUSED_NORM` overriding)."""
+
+    epsilon: float = 1e-6
+    dtype: Optional[jnp.dtype] = None
+    impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, residual=None):
+        from cloud_tpu.ops import fused_rmsnorm
+        scale = self.param("scale", nn.initializers.ones,
+                           (x.shape[-1],), jnp.float32)
+        normed, h = fused_rmsnorm(x, scale, residual=residual,
+                                  eps=self.epsilon,
+                                  out_dtype=self.dtype, impl=self.impl)
+        if residual is None:
+            return normed
+        return normed, h
+
+
 class LlamaBlock(nn.Module):
     num_heads: int
     num_kv_heads: int
@@ -432,7 +462,10 @@ class LlamaBlock(nn.Module):
     def __call__(self, x, mask=None, deterministic=True):
         norm = lambda name: nn.RMSNorm(
             epsilon=self.norm_eps, dtype=self.compute_dtype, name=name)
-        y = norm("norm_attn")(x)
+        fnorm = lambda name: FusedRMSNorm(
+            epsilon=self.norm_eps, dtype=self.compute_dtype,
+            impl=self.attention_impl, name=name)
+        y = fnorm("norm_attn")(x)
         y = GQAttention(self.num_heads, self.num_kv_heads,
                         self.compute_dtype, self.attention_impl,
                         self.rope_theta, rope_style=self.rope_style,
@@ -453,9 +486,14 @@ class LlamaBlock(nn.Module):
             # itself stays un-normalized).
             y = norm("norm_attn_post")(y)
         if self.dropout_rate:
+            # Dropout sits between the sublayer output and the residual
+            # add, so the fused tail (add + norm in one pass) does not
+            # apply; the param tree is identical either way.
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
-        x = x + y
-        y = norm("norm_mlp")(x)
+            x = x + y
+            y = norm("norm_mlp")(x)
+        else:
+            y, x = fnorm("norm_mlp")(y, residual=x)
         if self.moe_experts:
             from cloud_tpu.models.moe import TopKMoEMLP
             y, aux_loss = TopKMoEMLP(
@@ -586,8 +624,10 @@ class LlamaLM(nn.Module):
                            moe_capacity_factor=self.moe_capacity_factor,
                            moe_norm_topk=self.moe_norm_topk,
                            name="block_%d" % i)(x, mask, deterministic)
-        x = nn.RMSNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,
-                       name="norm_final")(x)
+        x = FusedRMSNorm(epsilon=self.norm_eps,
+                         dtype=self.compute_dtype,
+                         impl=self.attention_impl,
+                         name="norm_final")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False,
                           dtype=self.compute_dtype, name="lm_head")(x)
         logits = logits.astype(jnp.float32)

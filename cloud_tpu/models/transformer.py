@@ -187,20 +187,21 @@ class CausalSelfAttention(nn.Module):
         value_pages.value = value_pages.value.at[phys, off].set(
             v.astype(self.compute_dtype))
 
-        # Logical per-slot [cache_len] views: one gather per tick. (A
-        # fused paged-attention kernel would skip the materialization;
-        # at these model sizes the gather is cheap and keeps the math
-        # bit-identical to the dense path.)
-        k_view = key_pages.value[page_table.value].reshape(
-            slots, self.cache_len, heads, head_dim)
-        v_view = value_pages.value[page_table.value].reshape(
-            slots, self.cache_len, heads, head_dim)
-        scale = 1.0 / np.sqrt(head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_view,
-                            preferred_element_type=jnp.float32) * scale
-        logits = jnp.where(allowed[:, None], logits, -1e30)
-        weights = nn.softmax(logits, axis=-1).astype(self.compute_dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", weights, v_view)
+        # Impl selection (ops/paged_attention.py): "auto" runs the
+        # Pallas paged kernel on TPU — the page table rides as a
+        # scalar-prefetch operand, so the pool is block-indexed page by
+        # page with online softmax in VMEM, never materialized as a
+        # dense [slots, cache_len, H, D] gather — and the gathered-lax
+        # reference elsewhere, which is bitwise the dense path's math
+        # (engine-vs-solo bit-identity). CLOUD_TPU_PAGED_KERNEL=1/0
+        # force-overrides (kernel runs in interpret mode off-TPU).
+        # Every paged decode — engine tick, speculative verify window,
+        # solo paged decode — routes through this one call.
+        from cloud_tpu.ops import paged_attention
+        return paged_attention(
+            q, key_pages.value, value_pages.value, page_table.value,
+            allowed, sm_scale=1.0 / np.sqrt(head_dim),
+            impl=self.attention_impl)
 
 
 class TransformerBlock(nn.Module):

@@ -95,6 +95,15 @@ SERVE_COW_COPIES = "cloud_tpu_serve_cow_copies_total"
 #: per-slot speculation (models/speculative.py observe_accept_rate).
 SERVE_SPEC_ACCEPT_HISTOGRAM = "cloud_tpu_serve_spec_accepted_rate"
 
+#: Per-kernel cost rows (ops/ Pallas kernels: "paged_attention",
+#: "fused_norm"). Fed by `Telemetry.record_kernel_cost` from the jit
+#: cost-analysis hook (the PR 6 MFU idiom, per-kernel): the serving
+#: tick feeds paged_attention every tick with the measured tick
+#: latency; `ops.fused_norm.record_cost_row` is the bench/CI feed for
+#: the norm tail. `%s` is the kernel name.
+KERNEL_PCT_PEAK_GAUGE = "cloud_tpu_kernel_%s_pct_peak"
+KERNEL_BYTES_GAUGE = "cloud_tpu_kernel_%s_bytes_moved"
+
 
 class Counter:
     """Monotonic counter (int)."""
@@ -408,6 +417,21 @@ class Telemetry:
                 self.registry.gauge(MFU_GAUGE).set(
                     100.0 * flops_per_sec / self.peak_flops)
         self.flush()
+
+    def record_kernel_cost(self, kernel, flops, bytes_moved,
+                           elapsed_secs=None):
+        """Per-kernel cost row: bytes-moved always, pct-of-peak when
+        the caller knows the wall time one call took (MFU math, same
+        peak denominator as the step gauge). `kernel` is the row name
+        ("paged_attention", "fused_norm"); flops/bytes come from the
+        jit cost-analysis hook (ops.paged_attention_cost /
+        ops.fused_norm.fused_norm_cost)."""
+        self.registry.gauge(KERNEL_BYTES_GAUGE % kernel).set(
+            float(bytes_moved))
+        if flops and elapsed_secs and elapsed_secs > 0:
+            self.registry.gauge(KERNEL_PCT_PEAK_GAUGE % kernel).set(
+                100.0 * (float(flops) / float(elapsed_secs))
+                / self.peak_flops)
 
     def observe_decode(self, n_tokens, elapsed_secs):
         """Per-token decode latency: one observation per generated

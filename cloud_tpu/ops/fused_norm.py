@@ -1,0 +1,339 @@
+"""Fused RMSNorm + residual-add as a Pallas TPU kernel.
+
+The pre-norm transformer tail `h = x + residual; y = rmsnorm(h) * scale`
+is two HBM round trips when written as separate ops (the residual add
+materializes h, the norm re-reads it). This kernel does both in ONE HBM
+pass: each grid step streams a row block through VMEM, adds the
+residual, computes the f32 row statistics, and writes BOTH the normed
+rows and the updated residual stream h.
+
+Numerics mirror `flax.linen.RMSNorm` exactly: statistics are computed
+in f32 on the promoted input (`var = mean(h_f32^2)`), the scale param is
+f32 `[features]`, and the output is `h * (rsqrt(var + eps) * scale)`
+cast to the requested dtype — so swapping a flax norm for this op is a
+bitwise no-op in f32 and tolerance-level in bf16 (same single rounding
+point).
+
+Backward is `jax.custom_vjp` with the standard RMSNorm gradient
+recomputed from the saved h (one residual tensor, no (x, residual)
+pair): dh folds the normed-output cotangent AND the residual-stream
+cotangent, and both inputs of the fused add receive it. The backward
+runs as plain lax — decode never differentiates, and training backward
+is dominated by the matmuls either way; the single-pass claim is for
+the forward serving/training hot path.
+
+On non-TPU backends a forced kernel runs in Pallas interpret mode, so
+parity tests exercise the same code path CPU-side.
+"""
+
+import functools
+import os
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK_ROWS = 128
+
+
+class _NormConfig(NamedTuple):
+    eps: float
+    block_rows: int
+    out_dtype: str   # dtype name (hashable for the custom_vjp config)
+    interpret: bool
+
+
+def rmsnorm_residual_reference(x, scale, residual=None, eps=1e-6,
+                               out_dtype=None):
+    """Pure-lax fused norm tail: returns (normed, h).
+
+    h = x + residual (or x when residual is None); normed is flax
+    `RMSNorm(epsilon=eps, dtype=out_dtype)` applied to h, math-for-math
+    (f32 statistics on the promoted input, `h * (rsqrt(var+eps)*scale)`,
+    one cast at the end).
+    """
+    h = x if residual is None else x + residual
+    if out_dtype is None:
+        out_dtype = h.dtype
+    hf = h.astype(jnp.float32)
+    var = jnp.mean(hf * hf, axis=-1, keepdims=True)
+    mul = jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    return (hf * mul).astype(out_dtype), h
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(x_ref, r_ref, w_ref, o_ref, h_ref, *, config):
+    """One row block: h = x (+ r), f32 stats, normed — one VMEM pass."""
+    if r_ref is None:
+        h = x_ref[...]
+    else:
+        h = x_ref[...] + r_ref[...]
+        h_ref[...] = h
+    hf = h.astype(jnp.float32)
+    var = jnp.mean(hf * hf, axis=-1, keepdims=True)
+    mul = jax.lax.rsqrt(var + config.eps) * w_ref[...]
+    o_ref[...] = (hf * mul).astype(o_ref.dtype)
+
+
+def _norm_forward(config, x, residual, scale):
+    """x/residual: [rows, D] (row-padded); scale: [1, D] f32 ->
+    (normed [rows, D] out_dtype, h [rows, D] x.dtype)."""
+    rows, features = x.shape
+    block = config.block_rows
+    grid = (rows // block,)
+    out_dtype = jnp.dtype(config.out_dtype)
+    row_spec = pl.BlockSpec((block, features), lambda i: (i, 0))
+    w_spec = pl.BlockSpec((1, features), lambda i: (0, 0))
+    if residual is None:
+        kernel = functools.partial(
+            lambda x_ref, w_ref, o_ref, **kw: _fwd_kernel(
+                x_ref, None, w_ref, o_ref, None, **kw),
+            config=config)
+        normed = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[row_spec, w_spec],
+            out_specs=row_spec,
+            out_shape=jax.ShapeDtypeStruct((rows, features), out_dtype),
+            interpret=config.interpret,
+        )(x, scale)
+        return normed, x
+    kernel = functools.partial(_fwd_kernel, config=config)
+    normed, h = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[row_spec, row_spec, w_spec],
+        out_specs=[row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, features), out_dtype),
+            jax.ShapeDtypeStruct((rows, features), x.dtype),
+        ],
+        interpret=config.interpret,
+    )(x, residual, scale)
+    return normed, h
+
+
+def _norm_bwd_math(config, h, scale, g_normed, g_h):
+    """Standard RMSNorm gradient in f32 from the saved residual stream:
+    dh = g*w*r - h * r^3/D * sum(g*w*h) (+ the h cotangent), with both
+    fused-add inputs receiving dh; dscale sums over rows."""
+    features = h.shape[-1]
+    hf = h.astype(jnp.float32)
+    gf = g_normed.astype(jnp.float32)
+    w = scale.astype(jnp.float32)
+    var = jnp.mean(hf * hf, axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(var + config.eps)
+    gw = gf * w
+    inner = jnp.sum(gw * hf, axis=-1, keepdims=True)
+    dh = gw * r - hf * (r * r * r / features) * inner
+    if g_h is not None:
+        dh = dh + g_h.astype(jnp.float32)
+    dscale = jnp.sum(gf * hf * r, axis=0,
+                     keepdims=True).astype(scale.dtype)
+    return dh, dscale
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_rmsnorm(config, x, scale):
+    return _norm_forward(config, x, None, scale)
+
+
+def _fused_rmsnorm_fwd(config, x, scale):
+    out = _norm_forward(config, x, None, scale)
+    return out, (x, scale)
+
+
+def _fused_rmsnorm_bwd(config, residuals, grads):
+    x, scale = residuals
+    g_normed, g_h = grads
+    dh, dscale = _norm_bwd_math(config, x, scale, g_normed, g_h)
+    return dh.astype(x.dtype), dscale
+
+
+_fused_rmsnorm.defvjp(_fused_rmsnorm_fwd, _fused_rmsnorm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_rmsnorm_residual(config, x, residual, scale):
+    return _norm_forward(config, x, residual, scale)
+
+
+def _fused_rmsnorm_residual_fwd(config, x, residual, scale):
+    normed, h = _norm_forward(config, x, residual, scale)
+    return (normed, h), (h, scale)
+
+
+def _fused_rmsnorm_residual_bwd(config, residuals, grads):
+    h, scale = residuals
+    g_normed, g_h = grads
+    dh, dscale = _norm_bwd_math(config, h, scale, g_normed, g_h)
+    return dh.astype(h.dtype), dh.astype(h.dtype), dscale
+
+
+_fused_rmsnorm_residual.defvjp(_fused_rmsnorm_residual_fwd,
+                               _fused_rmsnorm_residual_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def fused_rmsnorm(x, scale, residual=None, eps=1e-6, out_dtype=None,
+                  impl="auto", interpret: Optional[bool] = None,
+                  block_rows=None):
+    """Dispatching fused RMSNorm(+residual) tail: returns (normed, h).
+
+    x: [..., D]; residual: same shape or None; scale: [D] (the flax
+    RMSNorm "scale" param, f32). h = x + residual (the continuing
+    residual stream; x itself when residual is None); normed =
+    RMSNorm(h) in `out_dtype` (default: h's dtype).
+
+    impl: "fused" forces the Pallas kernel, "reference" the lax path;
+    "auto" picks the kernel on TPU, the reference elsewhere. The
+    `CLOUD_TPU_FUSED_NORM` env var ("1"/"0") is the deployment A/B
+    override and beats `impl`; a forced kernel runs in interpret mode
+    off-TPU. Differentiable w.r.t. x, residual, and scale either way.
+    """
+    features = x.shape[-1]
+    if scale.shape != (features,):
+        raise ValueError(
+            "scale must be [features] = ({},); got {}.".format(
+                features, scale.shape))
+    if residual is not None and residual.shape != x.shape:
+        raise ValueError(
+            "residual must match x's shape {}; got {}.".format(
+                x.shape, residual.shape))
+    env = os.environ.get("CLOUD_TPU_FUSED_NORM", "").strip()
+    if env == "1":
+        use_kernel = True
+    elif env == "0":
+        use_kernel = False
+    elif impl == "fused":
+        use_kernel = True
+    elif impl == "reference":
+        use_kernel = False
+    else:
+        use_kernel = jax.default_backend() == "tpu"
+    if not use_kernel:
+        return rmsnorm_residual_reference(x, scale, residual=residual,
+                                          eps=eps, out_dtype=out_dtype)
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_rows is None:
+        block_rows = int(os.environ.get("CLOUD_TPU_FUSED_NORM_BLOCK",
+                                        _BLOCK_ROWS))
+    if out_dtype is None:
+        out_dtype = x.dtype if residual is None else jnp.promote_types(
+            x.dtype, residual.dtype)
+
+    lead = x.shape[:-1]
+    rows = 1
+    for dim in lead:
+        rows *= dim
+    block_rows = min(block_rows, max(rows, 1))
+    rows_pad = -(-rows // block_rows) * block_rows
+    # eps stays as passed (a static Python scalar — the config is a
+    # hashable static kernel arg); a float(...) cast here would read
+    # as a host sync to graftlint's jit-chain analysis.
+    config = _NormConfig(eps=eps, block_rows=int(block_rows),
+                         out_dtype=jnp.dtype(out_dtype).name,
+                         interpret=bool(interpret))
+
+    def fold(a):
+        a = a.reshape(rows, features)
+        if rows_pad != rows:
+            # Zero rows: var = 0, rsqrt(eps) finite, output rows 0 —
+            # sliced away below; pad/slice autodiff owns the edges.
+            a = jnp.pad(a, ((0, rows_pad - rows), (0, 0)))
+        return a
+
+    w = scale.astype(jnp.float32)[None, :]
+    if residual is None:
+        normed, h = _fused_rmsnorm(config, fold(x), w)
+    else:
+        normed, h = _fused_rmsnorm_residual(config, fold(x),
+                                            fold(residual), w)
+    normed = normed[:rows].reshape(lead + (features,))
+    h = h[:rows].reshape(lead + (features,))
+    return normed, h
+
+
+def fused_norm_cost(shape, dtype=jnp.bfloat16, with_residual=True):
+    """Per-call flops / bytes-moved row for the telemetry gauges, via
+    the jit cost-analysis hook on the lax reference (PR 6 idiom);
+    bytes_moved is the fused single-pass traffic (x [+ residual] in,
+    normed + h out, scale). Returns {"flops", "bytes_moved"}; never
+    raises."""
+    rows = 1
+    for dim in shape[:-1]:
+        rows *= dim
+    features = shape[-1]
+    n = float(rows * features)
+    flops = 4.0 * n  # add, square, two scaled multiplies per element
+    try:
+        args = [jax.ShapeDtypeStruct(tuple(shape), dtype),
+                jax.ShapeDtypeStruct((features,), jnp.float32)]
+        if with_residual:
+            fn = functools.partial(
+                lambda x, s, r: rmsnorm_residual_reference(
+                    x, s, residual=r))
+            args.append(jax.ShapeDtypeStruct(tuple(shape), dtype))
+        else:
+            fn = rmsnorm_residual_reference
+        analysis = jax.jit(fn).lower(*args).cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        flops = float(analysis.get("flops", flops) or flops)
+    except Exception:
+        pass
+    itemsize = jnp.dtype(dtype).itemsize
+    tensors = 4 if with_residual else 2  # in (+res), normed, h is x
+    bytes_moved = float(tensors * n * itemsize + features * 4)
+    return {"flops": flops, "bytes_moved": bytes_moved}
+
+
+def record_cost_row(shape, dtype=jnp.bfloat16, with_residual=True,
+                    iters=10):
+    """Times the jitted fused tail at `shape` and feeds the telemetry
+    kernel-cost row (`cloud_tpu_kernel_fused_norm_pct_peak` /
+    `_bytes_moved`) — the bench/CI hook that turns the cost analysis
+    into a tracked pct-of-peak metric. No-op (returns None) when
+    telemetry is off; returns the per-call seconds otherwise."""
+    import sys
+    import time
+
+    telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+    if telemetry is None:
+        return None
+    tele = telemetry.get()
+    if tele is None or not tele.active:
+        return None
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape), dtype)
+    residual = jnp.asarray(rng.randn(*shape), dtype) if with_residual \
+        else None
+    scale = jnp.ones((shape[-1],), jnp.float32)
+
+    @jax.jit
+    def run(x, residual, scale):
+        return fused_rmsnorm(x, scale, residual=residual)
+
+    jax.block_until_ready(run(x, residual, scale))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run(x, residual, scale)
+    jax.block_until_ready(out)
+    elapsed = (time.perf_counter() - t0) / max(iters, 1)
+    cost = fused_norm_cost(shape, dtype, with_residual)
+    tele.record_kernel_cost("fused_norm", cost["flops"],
+                            cost["bytes_moved"], elapsed)
+    return elapsed

@@ -1,0 +1,384 @@
+"""Paged decode attention as a Pallas TPU kernel.
+
+The serving tick's hot op: one (or `spec_k + 1`) query positions per
+slot attending over that slot's logical KV cache, which lives scattered
+across a physical page pool (`key_pages`/`value_pages`
+`[num_pages, page_size, H, D]`, serving/kvpool.py) and is addressed
+through a per-slot page table. The lax path materializes a dense
+`[slots, cache_len, H, D]` view by gathering the pool through the page
+table every tick; this kernel never does — the page table rides as a
+scalar-prefetch operand, so each grid step's K/V block is *indexed*
+straight out of the pool in HBM (the gather becomes block addressing)
+and streamed through VMEM with FlashAttention-style online softmax.
+
+Grid and masking contract (see /opt/skills/guides/pallas_guide.md):
+- Grid is (slots*heads, pages_per_slot) with the page dimension
+  innermost. Program (b, j) serves slot b // H, head b % H, and logical
+  page j; its K/V block is physical page `page_table[b // H, j]` —
+  `PrefetchScalarGridSpec` places the table in SMEM before the kernel
+  runs so the BlockSpec index maps can read it.
+- VMEM scratch (acc, m, l) carries the online-softmax state across page
+  steps; the output block is written on the last page step. m/l live in
+  (seq_pad, 128) lane-broadcast scratch (Mosaic has no cheap
+  (N,1)<->(1,N) transpose).
+- Masking is purely the caller's `allowed [slots, seq, cache_len]`
+  (from `decoding.paged_slot_update`): it already encodes per-query
+  causality over *logical* key slots plus slot validity, so freed /
+  never-written / scratch-page-0 entries carry exact-zero weight — the
+  kernel zeroes masked probabilities explicitly (`p = where(mask, ...)`)
+  rather than relying on exp underflow, so a fully-masked row (e.g. a
+  padded query row or an evicted slot) outputs zeros, never a uniform
+  average over pool garbage.
+- `seq` (1 for the plain tick, spec_k + 1 for the speculative verify
+  window) is padded to a sublane multiple; padded query rows are
+  all-masked and sliced away.
+
+The gathered-lax reference below is bitwise the math
+`models/transformer.py::_paged_decode_attention` shipped before this
+kernel (gather -> f32 einsum -> -1e30 mask -> softmax -> cast ->
+einsum), so engine-vs-solo bit-identity pins keep holding wherever the
+reference is selected. Off-TPU the kernel path executes as
+`_paged_walk_lax` — the same page-block walk and online-softmax update
+order, vectorized in lax (Mosaic can't compile there, and Pallas
+interpret mode is two orders of magnitude too slow for a serving
+tick) — which is what the `CLOUD_TPU_PAGED_KERNEL=1` smoke measures;
+the parity suite additionally forces `interpret=True` to pin the true
+interpreted kernel against both the walk and the reference.
+
+Forward only: decode never differentiates through the cache.
+"""
+
+import functools
+import math
+import os
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128
+_SUBLANES = 8
+
+
+class _PagedConfig(NamedTuple):
+    sm_scale: float
+    heads: int
+    seq_pad: int     # query rows after sublane padding
+    page_size: int
+    interpret: bool
+
+
+def paged_attention_reference(q, key_pages, value_pages, page_table,
+                              allowed, sm_scale=None):
+    """Gathered-lax paged decode attention (the correctness oracle).
+
+    q: [slots, seq, H, D]; key_pages/value_pages: [N, P, H, D];
+    page_table: [slots, pages_per_slot] int32; allowed:
+    [slots, seq, cache_len] bool (True = attend) ->
+    [slots, seq, H, D] in the page dtype.
+
+    Logical per-slot [cache_len] views, one gather per call — bitwise
+    the pre-kernel serving-tick math, kept verbatim so the kernel-off
+    engine stays bit-identical to solo `generate()` decodes.
+    """
+    num_pages, page_size, heads, head_dim = key_pages.shape
+    slots, pages_per_slot = page_table.shape
+    cache_len = pages_per_slot * page_size
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    k_view = key_pages[page_table].reshape(slots, cache_len, heads,
+                                           head_dim)
+    v_view = value_pages[page_table].reshape(slots, cache_len, heads,
+                                             head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_view,
+                        preferred_element_type=jnp.float32) * sm_scale
+    logits = jnp.where(allowed[:, None], logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1).astype(value_pages.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v_view)
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_kernel(pt_ref, q_ref, k_ref, v_ref, a_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, config, num_pages):
+    del pt_ref  # consumed by the BlockSpec index maps
+    ji = pl.program_id(1)
+
+    @pl.when(ji == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]             # [seq_pad, D]
+    k = k_ref[0, :, 0, :]    # [P, D] — physical page pt[slot, ji]
+    v = v_ref[0, :, 0, :]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * config.sm_scale
+    mask = a_ref[0, :, 0, :] != 0
+
+    s = jnp.where(mask, s, _NEG_INF)
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_curr = jnp.max(s, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_curr)
+    alpha = jnp.exp(m_prev - m_next)
+    # Explicit zero where masked: exp(s - m) underflows to 0 for normal
+    # rows, but a fully-masked row (padded query, evicted slot, scratch
+    # page) has m == s == -inf and exp(0) == 1 would leak pool garbage.
+    p = jnp.where(mask, jnp.exp(s - m_next), 0.0)
+    l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
+
+    @pl.when(ji == num_pages - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+def _paged_forward(config, q, key_pages, value_pages, page_table,
+                   allowed):
+    """q: [S*H, seq_pad, D] (head-folded); allowed:
+    [S, seq_pad, pages_per_slot, P] int32 -> out [S*H, seq_pad, D].
+
+    The page table is the scalar-prefetch operand: index maps read
+    `pt[b // H, j]` to address each program's physical K/V page, so the
+    pool is only ever touched at the pages a slot actually owns.
+    """
+    bh, seq_pad, head_dim = q.shape
+    heads = config.heads
+    page_size = config.page_size
+    pages_per_slot = page_table.shape[1]
+    grid = (bh, pages_per_slot)
+    kernel = functools.partial(_paged_kernel, config=config,
+                               num_pages=pages_per_slot)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, seq_pad, head_dim),
+                         lambda b, j, pt: (b, 0, 0)),
+            # K/V blocks are single physical pages, gathered by block
+            # *indexing* through the prefetched table — never an HBM
+            # materialization of the dense [S, cache_len, H, D] view.
+            pl.BlockSpec((1, page_size, 1, head_dim),
+                         lambda b, j, pt: (pt[b // heads, j], 0,
+                                           b % heads, 0)),
+            pl.BlockSpec((1, page_size, 1, head_dim),
+                         lambda b, j, pt: (pt[b // heads, j], 0,
+                                           b % heads, 0)),
+            # The singleton page axis keeps the mask block's last dim
+            # equal to the array dim (Mosaic's lane rule for P < 128).
+            pl.BlockSpec((1, seq_pad, 1, page_size),
+                         lambda b, j, pt: (b // heads, 0, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, seq_pad, head_dim),
+                               lambda b, j, pt: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((seq_pad, head_dim), jnp.float32),
+            pltpu.VMEM((seq_pad, _LANES), jnp.float32),
+            pltpu.VMEM((seq_pad, _LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, seq_pad, head_dim),
+                                       value_pages.dtype),
+        interpret=config.interpret,
+    )(page_table, q, key_pages, value_pages, allowed)
+
+
+def _paged_walk_lax(q, key_pages, value_pages, page_table, allowed,
+                    sm_scale):
+    """The kernel's defining math as vectorized lax: walk the page
+    blocks in grid order, gathering ONLY the slots' own pages (one
+    [slots, P, H, D] take per logical page — never the dense
+    [slots, cache_len] view), with the exact online-softmax update
+    sequence `_paged_kernel` runs per step. This is the off-TPU
+    execution of the kernel path: Mosaic can't compile there and
+    Pallas interpret mode is ~100x too slow for a serving tick, so the
+    `CLOUD_TPU_PAGED_KERNEL=1` smoke runs this form while the parity
+    suite pins it against the true interpreted kernel
+    (`interpret=True`) and the gathered reference."""
+    num_pages, page_size, heads, head_dim = key_pages.shape
+    slots, seq, q_heads, _ = q.shape
+    pages_per_slot = page_table.shape[1]
+    am = allowed.reshape(slots, seq, pages_per_slot, page_size)
+    m = jnp.full((slots, heads, seq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((slots, heads, seq, 1), jnp.float32)
+    acc = jnp.zeros((slots, heads, seq, head_dim), jnp.float32)
+    for j in range(pages_per_slot):
+        k = key_pages[page_table[:, j]]      # [slots, P, H, D]
+        v = value_pages[page_table[:, j]]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * sm_scale
+        mask = am[:, :, j, :][:, None]       # [slots, 1, seq, P]
+        s = jnp.where(mask, s, _NEG_INF)
+        m_curr = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m, m_curr)
+        alpha = jnp.exp(m - m_next)
+        p = jnp.where(mask, jnp.exp(s - m_next), 0.0)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd",
+                                       p.astype(v.dtype), v)
+        m = m_next
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / safe_l).astype(value_pages.dtype)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def paged_decode_attention(q, key_pages, value_pages, page_table,
+                           allowed, sm_scale=None,
+                           interpret: Optional[bool] = None):
+    """Pallas paged decode attention; layouts as the reference.
+
+    Handles both the seq=1 plain tick and the seq=spec_k+1 speculative
+    verify window (query rows are sublane-padded; padded rows are
+    all-masked and sliced away). Output matches
+    `paged_attention_reference` to online-softmax accumulation order —
+    tolerance-level, not bitwise; fully-masked rows (evicted slots,
+    padded queries) output exact zeros.
+
+    interpret: None (default) compiles the kernel on TPU and runs the
+    lax page-walk form of the same math elsewhere; True forces Pallas
+    interpret mode (the parity suite's same-code-path check — far too
+    slow for a serving tick).
+    """
+    num_pages, page_size, heads, head_dim = key_pages.shape
+    slots, seq, q_heads, _ = q.shape
+    pages_per_slot = page_table.shape[1]
+    cache_len = pages_per_slot * page_size
+    if q_heads != heads:
+        raise ValueError(
+            "q heads ({}) must match page heads ({}) — the paged "
+            "decode cache stores full-width heads.".format(q_heads,
+                                                           heads))
+    if value_pages.shape != key_pages.shape:
+        raise ValueError(
+            "key_pages and value_pages must have identical shapes; "
+            "got {} vs {}.".format(key_pages.shape, value_pages.shape))
+    if allowed.shape != (slots, seq, cache_len):
+        raise ValueError(
+            "allowed must be [slots, seq, cache_len] = {}; got "
+            "{}.".format((slots, seq, cache_len), allowed.shape))
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return _paged_walk_lax(q, key_pages, value_pages,
+                                   page_table, allowed,
+                                   float(sm_scale))
+        interpret = False
+
+    seq_pad = -(-seq // _SUBLANES) * _SUBLANES
+    config = _PagedConfig(sm_scale=float(sm_scale), heads=heads,
+                          seq_pad=seq_pad, page_size=page_size,
+                          interpret=bool(interpret))
+
+    qf = jnp.transpose(q, (0, 2, 1, 3)).reshape(slots * heads, seq,
+                                                head_dim)
+    amask = allowed.astype(jnp.int32)
+    if seq_pad != seq:
+        qf = jnp.pad(qf, ((0, 0), (0, seq_pad - seq), (0, 0)))
+        # Padded query rows are fully masked -> zero output rows.
+        amask = jnp.pad(amask, ((0, 0), (0, seq_pad - seq), (0, 0)))
+    amask = amask.reshape(slots, seq_pad, pages_per_slot, page_size)
+
+    out = _paged_forward(config, qf, key_pages, value_pages,
+                         page_table.astype(jnp.int32), amask)
+    out = out[:, :seq].reshape(slots, heads, seq, head_dim)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def paged_attention(q, key_pages, value_pages, page_table, allowed,
+                    sm_scale=None, impl="auto",
+                    interpret: Optional[bool] = None):
+    """Dispatching paged decode attention: Pallas kernel or gathered lax.
+
+    impl: "paged" forces the kernel, "reference" forces the gathered
+    lax path; "auto" (and any training-side impl name such as "flash",
+    which has no paged analogue) picks the kernel on TPU and the
+    reference elsewhere. The `CLOUD_TPU_PAGED_KERNEL` env var is the
+    deployment/A-B override and beats `impl`: "1" forces the kernel
+    (interpret mode off-TPU, so CPU CI drives the kernel code path),
+    "0" forces the reference, unset/empty defers to `impl`.
+    """
+    env = os.environ.get("CLOUD_TPU_PAGED_KERNEL", "").strip()
+    if env == "1":
+        use_kernel = True
+    elif env == "0":
+        use_kernel = False
+    elif impl == "paged":
+        use_kernel = True
+    elif impl == "reference":
+        use_kernel = False
+    else:
+        use_kernel = jax.default_backend() == "tpu"
+    if use_kernel:
+        return paged_decode_attention(q, key_pages, value_pages,
+                                      page_table, allowed,
+                                      sm_scale=sm_scale,
+                                      interpret=interpret)
+    return paged_attention_reference(q, key_pages, value_pages,
+                                     page_table, allowed,
+                                     sm_scale=sm_scale)
+
+
+def paged_attention_cost(slots, seq, heads, head_dim, page_size,
+                         pages_per_slot, dtype=jnp.bfloat16):
+    """Per-call flops / bytes-moved row for the telemetry gauges.
+
+    flops come from the jit cost-analysis hook (the PR 6 idiom —
+    `lower().cost_analysis()`, list-unwrapped, exception-swallowed) on
+    the gathered reference at these shapes; bytes_moved is the kernel's
+    HBM traffic (q + out + the slot's own K/V pages + table + mask),
+    i.e. what the fused path touches — NOT the dense gather the
+    reference materializes. Returns {"flops", "bytes_moved"}; never
+    raises (falls back to the analytic flop count).
+    """
+    cache_len = page_size * pages_per_slot
+    num_pages = slots * pages_per_slot + 1
+    itemsize = jnp.dtype(dtype).itemsize
+    # 2 matmuls (qk^T, pv), 2 flops per MAC.
+    flops = 4.0 * slots * seq * cache_len * heads * head_dim
+    try:
+        shapes = (
+            jax.ShapeDtypeStruct((slots, seq, heads, head_dim), dtype),
+            jax.ShapeDtypeStruct((num_pages, page_size, heads,
+                                  head_dim), dtype),
+            jax.ShapeDtypeStruct((num_pages, page_size, heads,
+                                  head_dim), dtype),
+            jax.ShapeDtypeStruct((slots, pages_per_slot), jnp.int32),
+            jax.ShapeDtypeStruct((slots, seq, cache_len), jnp.bool_),
+        )
+        analysis = jax.jit(paged_attention_reference).lower(
+            *shapes).cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        flops = float(analysis.get("flops", flops) or flops)
+    except Exception:
+        pass
+    bytes_moved = float(
+        2 * slots * cache_len * heads * head_dim * itemsize   # K/V pages
+        + 2 * slots * seq * heads * head_dim * itemsize       # q + out
+        + slots * pages_per_slot * 4                          # table
+        + slots * seq * cache_len)                            # mask
+    return {"flops": flops, "bytes_moved": bytes_moved}

@@ -291,6 +291,7 @@ class DecodeEngine:
         self._gather = best_effort_donation(functools.partial(
             jit, donate_argnums=(0,))(self._gather_impl))
         self._warm_stats = None
+        self._kernel_costs = None
 
     # -- prefill ------------------------------------------------------
 
@@ -457,6 +458,33 @@ class DecodeEngine:
             raise RetraceError(
                 "serving path traced/compiled after warm-up: {} "
                 "(static-shape leak).".format(grew))
+
+    def kernel_costs(self):
+        """Per-TICK cost rows for the telemetry kernel gauges: the
+        paged-attention flops / bytes-moved one tick dispatches (all
+        layers, verify-window width when speculating), from the jit
+        cost-analysis hook in ops/paged_attention.py. Computed lazily
+        (one uninstrumented lowering — the retrace sentinel counts only
+        `instrumented_jit` sites) and cached; the scheduler pairs it
+        with the measured tick latency for the pct_peak gauge."""
+        if self._kernel_costs is None:
+            from cloud_tpu import ops
+
+            model = self.model
+            head_dim = model.d_model // model.num_heads
+            seq = self.spec_k + 1 if self.spec_on else 1
+            cost = ops.paged_attention_cost(
+                self.slots, seq, model.num_heads, head_dim,
+                self.page_size, self.pages_per_slot,
+                dtype=model.compute_dtype)
+            layers = model.num_layers
+            self._kernel_costs = {
+                "paged_attention": {
+                    "flops": cost["flops"] * layers,
+                    "bytes_moved": cost["bytes_moved"] * layers,
+                },
+            }
+        return self._kernel_costs
 
     # -- jitted bodies ------------------------------------------------
 

@@ -653,6 +653,14 @@ class Scheduler:
                 from cloud_tpu.monitoring import telemetry
                 reg.histogram(telemetry.SERVE_TOKEN_HISTOGRAM).observe(
                     elapsed, count=n_active)
+                # Kernel cost rows: one tick's paged-attention flops /
+                # bytes over its measured wall time — pct_peak and
+                # bytes_moved track the fused-kernel A/B alongside the
+                # token-latency p99 this histogram already exports.
+                for name, cost in self.engine.kernel_costs().items():
+                    telemetry.get().record_kernel_cost(
+                        name, cost["flops"], cost["bytes_moved"],
+                        elapsed)
         if self.engine.spec_on:
             self._distribute_spec(fetched)
         else:

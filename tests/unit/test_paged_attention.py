@@ -1,0 +1,229 @@
+"""Paged decode-attention kernel vs the gathered-lax reference.
+
+The parity suite the serving tick's fused page gather rides on
+(cloud_tpu/ops/paged_attention.py): the Pallas kernel in interpret
+mode, the off-TPU lax page-walk form, and the gathered reference must
+agree — across the plain seq=1 tick, the speculative seq=k+1 verify
+window, shared/CoW donor pages, and the masking edge cases the engine
+relies on (scratch page 0 never contributes; an evicted slot's rows
+come out exact-zero from the kernel).
+
+Interpret-mode pallas_call is orders of magnitude slower than lax, so
+every shape here is tiny; the serving-scale behavior is pinned by the
+smoke gates (serving/smoke.py) with CLOUD_TPU_PAGED_KERNEL=1.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# The ops package re-exports the `paged_attention` FUNCTION under the
+# same name as this module, shadowing the package attribute — go
+# through sys.modules for the module itself.
+import cloud_tpu.ops.paged_attention  # noqa: F401  (registers module)
+
+pa = sys.modules["cloud_tpu.ops.paged_attention"]
+
+TOL = 2e-5
+
+
+def _scenario(slots=3, pages_per_slot=4, page_size=16, heads=2,
+              head_dim=64, seq=1, dtype=jnp.float32, seed=0):
+    """A miniature engine cache: page 0 is the scratch page, slot i
+    owns `pages_per_slot` distinct pages, per-slot positions stagger so
+    the causal frontier crosses page boundaries."""
+    rng = np.random.default_rng(seed)
+    num_pages = slots * pages_per_slot + 1
+    cache_len = pages_per_slot * page_size
+    shape = (num_pages, page_size, heads, head_dim)
+    key_pages = jnp.asarray(rng.normal(size=shape), dtype)
+    value_pages = jnp.asarray(rng.normal(size=shape), dtype)
+    q = jnp.asarray(rng.normal(size=(slots, seq, heads, head_dim)),
+                    dtype)
+    page_table = jnp.asarray(
+        1 + np.arange(slots * pages_per_slot).reshape(
+            slots, pages_per_slot), jnp.int32)
+    # Slot s decodes at position pos_s; verify-window row t may attend
+    # through pos_s + t (the engine's causal contract).
+    pos = np.array([(7 + 11 * s) % (cache_len - seq) for s in
+                    range(slots)])
+    allowed = (np.arange(cache_len)[None, None, :]
+               <= (pos[:, None] + np.arange(seq))[:, :, None])
+    return q, key_pages, value_pages, page_table, jnp.asarray(allowed)
+
+
+def _all_impls(q, kp, vp, pt, allowed):
+    ref = pa.paged_attention_reference(q, kp, vp, pt, allowed)
+    walk = pa._paged_walk_lax(q, kp, vp, pt, allowed,
+                              1.0 / np.sqrt(q.shape[-1]))
+    kern = pa.paged_decode_attention(q, kp, vp, pt, allowed,
+                                     interpret=True)
+    return ref, walk, kern
+
+
+def test_plain_tick_parity():
+    """seq=1 — the shape every non-speculative serving tick runs."""
+    ref, walk, kern = _all_impls(*_scenario(seq=1))
+    np.testing.assert_allclose(kern, ref, atol=TOL, rtol=TOL)
+    np.testing.assert_allclose(walk, ref, atol=TOL, rtol=TOL)
+
+
+def test_verify_window_parity():
+    """seq=k+1 (speculative verify window, here k=3): per-row causal
+    frontier; rows are sublane-padded inside the kernel (4 -> 8) and
+    the pad rows must never leak into the sliced output."""
+    ref, walk, kern = _all_impls(*_scenario(seq=4))
+    np.testing.assert_allclose(kern, ref, atol=TOL, rtol=TOL)
+    np.testing.assert_allclose(walk, ref, atol=TOL, rtol=TOL)
+
+
+def test_walk_matches_interpret_kernel_tightly():
+    """The lax page-walk is the kernel's off-TPU execution: same math,
+    same page order, same online-softmax update sequence. It must track
+    the interpret-mode kernel much tighter than either tracks the
+    reference (which softmaxes in one pass)."""
+    q, kp, vp, pt, allowed = _scenario(seq=4)
+    walk = pa._paged_walk_lax(q, kp, vp, pt, allowed,
+                              1.0 / np.sqrt(q.shape[-1]))
+    kern = pa.paged_decode_attention(q, kp, vp, pt, allowed,
+                                     interpret=True)
+    np.testing.assert_allclose(np.asarray(walk), np.asarray(kern),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_bf16_parity():
+    """bf16 pages (the serving dtype): kernel within bf16 resolution of
+    the reference."""
+    ref, walk, kern = _all_impls(*_scenario(seq=1, dtype=jnp.bfloat16))
+    np.testing.assert_allclose(
+        np.asarray(kern, np.float32), np.asarray(ref, np.float32),
+        atol=2e-2, rtol=2e-2)
+    np.testing.assert_allclose(
+        np.asarray(walk, np.float32), np.asarray(ref, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+def test_shared_donor_pages():
+    """graftshare CoW: a prefix-cache hit leaves multiple slots'
+    page tables pointing at the SAME donor pages. The gather-free
+    kernel must read shared pages identically to the reference."""
+    q, kp, vp, pt, allowed = _scenario(slots=3, seq=1)
+    pt = np.asarray(pt).copy()
+    pt[1, :2] = pt[0, :2]  # slots 0 and 1 share two donor pages
+    pt[2, 0] = pt[0, 0]    # three-way share of the first page
+    pt = jnp.asarray(pt)
+    ref, walk, kern = _all_impls(q, kp, vp, pt, allowed)
+    np.testing.assert_allclose(kern, ref, atol=TOL, rtol=TOL)
+    np.testing.assert_allclose(walk, ref, atol=TOL, rtol=TOL)
+
+
+@pytest.mark.parametrize("impl_name", ["reference", "walk", "kernel"])
+def test_scratch_page_never_contributes(impl_name):
+    """Page 0 is the pool's scratch page: unallocated page-table tail
+    entries point at it and their positions are always masked. Filling
+    it with large finite garbage (NOT NaN — 0 * NaN = NaN would poison
+    any impl) must not move a single output bit."""
+    q, kp, vp, pt, allowed = _scenario(slots=2, pages_per_slot=3,
+                                       seq=1)
+    pt = np.asarray(pt).copy()
+    pt[:, -1] = 0  # tail entries parked on the scratch page
+    pt = jnp.asarray(pt)
+    # Mask off everything the scratch page would back.
+    allowed = np.asarray(allowed).copy()
+    allowed[:, :, -16:] = False
+    allowed = jnp.asarray(allowed)
+
+    def run(kp):
+        if impl_name == "reference":
+            return pa.paged_attention_reference(q, kp, vp, pt, allowed)
+        if impl_name == "walk":
+            return pa._paged_walk_lax(q, kp, vp, pt, allowed,
+                                      1.0 / np.sqrt(q.shape[-1]))
+        return pa.paged_decode_attention(q, kp, vp, pt, allowed,
+                                         interpret=True)
+
+    clean = run(kp)
+    garbage = run(kp.at[0].set(1e30))
+    np.testing.assert_array_equal(np.asarray(clean),
+                                  np.asarray(garbage))
+
+
+def test_evicted_slot_outputs_exact_zeros():
+    """An evicted/inactive slot has `allowed` all-False. The kernel and
+    walk output EXACT zeros there (explicit p=where(mask,...,0)); the
+    reference's one-pass softmax instead averages garbage uniformly.
+    The engine never consumes those rows — this pins the intentional
+    divergence so a refactor can't silently change it."""
+    q, kp, vp, pt, allowed = _scenario(slots=3, seq=1)
+    allowed = np.asarray(allowed).copy()
+    allowed[1] = False  # slot 1 evicted
+    allowed = jnp.asarray(allowed)
+    walk = pa._paged_walk_lax(q, kp, vp, pt, allowed,
+                              1.0 / np.sqrt(q.shape[-1]))
+    kern = pa.paged_decode_attention(q, kp, vp, pt, allowed,
+                                     interpret=True)
+    np.testing.assert_array_equal(np.asarray(walk)[1],
+                                  np.zeros_like(np.asarray(walk)[1]))
+    np.testing.assert_array_equal(np.asarray(kern)[1],
+                                  np.zeros_like(np.asarray(kern)[1]))
+    # Live slots still match the reference exactly as usual.
+    ref = pa.paged_attention_reference(q, kp, vp, pt, allowed)
+    for s in (0, 2):
+        np.testing.assert_allclose(np.asarray(kern)[s],
+                                   np.asarray(ref)[s],
+                                   atol=TOL, rtol=TOL)
+
+
+def test_impl_selection_off_tpu():
+    """On CPU, impl='reference' (and 'auto'/'flash') is bitwise the
+    gathered reference; impl='paged' is bitwise the lax page-walk."""
+    q, kp, vp, pt, allowed = _scenario(seq=1)
+    ref = pa.paged_attention_reference(q, kp, vp, pt, allowed)
+    walk = pa._paged_walk_lax(q, kp, vp, pt, allowed,
+                              1.0 / np.sqrt(q.shape[-1]))
+    for impl in ("reference", "auto", "flash"):
+        got = pa.paged_attention(q, kp, vp, pt, allowed, impl=impl)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    got = pa.paged_attention(q, kp, vp, pt, allowed, impl="paged")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(walk))
+
+
+def test_env_override_beats_impl(monkeypatch):
+    """CLOUD_TPU_PAGED_KERNEL is the deployment A/B switch: '0' forces
+    the reference even under impl='paged'; '1' forces the kernel path
+    even under impl='reference'."""
+    q, kp, vp, pt, allowed = _scenario(seq=1)
+    ref = pa.paged_attention_reference(q, kp, vp, pt, allowed)
+    walk = pa._paged_walk_lax(q, kp, vp, pt, allowed,
+                              1.0 / np.sqrt(q.shape[-1]))
+    monkeypatch.setenv("CLOUD_TPU_PAGED_KERNEL", "0")
+    got = pa.paged_attention(q, kp, vp, pt, allowed, impl="paged")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    monkeypatch.setenv("CLOUD_TPU_PAGED_KERNEL", "1")
+    got = pa.paged_attention(q, kp, vp, pt, allowed, impl="reference")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(walk))
+
+
+def test_shape_validation():
+    q, kp, vp, pt, allowed = _scenario(seq=1)
+    with pytest.raises(ValueError, match="allowed must be"):
+        pa.paged_decode_attention(q, kp, vp, pt, allowed[:, :, :-1])
+    with pytest.raises(ValueError, match="identical shapes"):
+        pa.paged_decode_attention(q, kp, vp[:-1], pt, allowed)
+
+
+def test_cost_hook():
+    """The telemetry row: positive flops and bytes, and the fused
+    bytes figure stays below the dense-gather materialization (the
+    whole point of the kernel)."""
+    cost = pa.paged_attention_cost(slots=8, seq=1, heads=8,
+                                   head_dim=64, page_size=16,
+                                   pages_per_slot=4)
+    assert cost["flops"] > 0
+    assert cost["bytes_moved"] > 0
+    cache_len = 16 * 4
+    dense_gather = 2 * 8 * cache_len * 8 * 64 * 2  # K+V, bf16
+    assert cost["bytes_moved"] < 2 * dense_gather
