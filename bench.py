@@ -219,6 +219,14 @@ COMPILE_CACHE_DIR = os.path.join(_HERE, "benchmarks", ".jax_cache")
 
 
 def _metric_name():
+    if os.environ.get("BENCH_SERVE_LOAD", "0") == "1":
+        # graftlens open-loop load series: goodput (fraction of offered
+        # requests meeting the TTFT+TPOT SLOs) at the highest swept
+        # arrival rate, with the full offered-vs-achieved curve in the
+        # record. Checked before BENCH_SERVE: the load series drives a
+        # Scheduler too, but measures the SLO envelope, not raw
+        # tokens/sec.
+        return "graftserve_loadgen_goodput"
     if os.environ.get("BENCH_SERVE", "0") == "1":
         # A different measurement entirely (continuous-batching decode,
         # not training throughput): its own metric name, its own cache
@@ -260,6 +268,8 @@ def _metric_name():
 
 
 def _unit():
+    if os.environ.get("BENCH_SERVE_LOAD", "0") == "1":
+        return "goodput_frac"
     return ("tokens/sec" if os.environ.get("BENCH_SERVE", "0") == "1"
             else "images/sec")
 
@@ -456,6 +466,21 @@ def _requested_config():
     mismatch). Values reflect the post-pin environment; `pinned` lists
     the keys best_pin.json supplied.
     """
+    if os.environ.get("BENCH_SERVE_LOAD", "0") == "1":
+        # The loadgen series' fair-game knobs: the arrival process and
+        # the SLO envelope the goodput number is measured against.
+        return {
+            "serve_load": True,
+            "slots": _env_int("BENCH_SERVE_LOAD_SLOTS", 8),
+            "requests": _env_int("BENCH_SERVE_LOAD_REQUESTS", 24),
+            "rates": os.environ.get("BENCH_SERVE_LOAD_RATES", "2,4,8"),
+            "process": os.environ.get("BENCH_SERVE_LOAD_PROCESS",
+                                      "poisson"),
+            "shared_prefix_ratio": _env_float(
+                "BENCH_SERVE_LOAD_SHARE", 0.5),
+            "slo_ttft_s": _env_float("BENCH_SLO_TTFT", 0.5),
+            "slo_tpot_s": _env_float("BENCH_SLO_TPOT", 0.1),
+        }
     if os.environ.get("BENCH_SERVE", "0") == "1":
         # The serve series' fair-game knobs — none of the training
         # knobs apply (it measures the decode engine, not the Trainer).
@@ -944,7 +969,131 @@ def _serve_worker():
     print(json.dumps(record))
 
 
+def _serve_load_worker():
+    """BENCH_SERVE_LOAD=1: the graftlens open-loop goodput series.
+
+    Unlike BENCH_SERVE (a closed-loop fleet: the driver submits the
+    next request when the previous finishes, so the system sets its
+    own arrival rate), this series offers load on an independent clock
+    — Poisson arrivals at 2-3 fixed rates from serving/loadgen.py —
+    and records the SLO envelope: `value` is goodput (fraction of
+    OFFERED requests completing within --slo-ttft/--slo-tpot) at the
+    HIGHEST swept rate, `vs_baseline` is goodput at the lowest (the
+    underload sanity point; a healthy stack reads ~1.0 there), and
+    `load_curve` carries the full offered-vs-achieved sweep.
+    """
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    from cloud_tpu.parallel import compile_cache
+    compile_cache.enable(COMPILE_CACHE_DIR, min_compile_time_secs=1.0)
+    import jax.numpy as jnp
+
+    from cloud_tpu.parallel import runtime as runtime_lib
+    from cloud_tpu.serving import Scheduler
+    from cloud_tpu.serving import loadgen
+    from cloud_tpu.serving.smoke import build_model
+
+    slots = _env_int("BENCH_SERVE_LOAD_SLOTS", 8)
+    n_requests = _env_int("BENCH_SERVE_LOAD_REQUESTS", 24)
+    rates = [float(r) for r in os.environ.get(
+        "BENCH_SERVE_LOAD_RATES", "2,4,8").split(",") if r.strip()]
+    process = os.environ.get("BENCH_SERVE_LOAD_PROCESS", "poisson")
+    share = _env_float("BENCH_SERVE_LOAD_SHARE", 0.5)
+    slo_ttft = _env_float("BENCH_SLO_TTFT", 0.5)
+    slo_tpot = _env_float("BENCH_SLO_TPOT", 0.1)
+
+    model = build_model()
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    specs = [loadgen.LoadSpec(rate=rate, n_requests=n_requests,
+                              process=process,
+                              shared_prefix_ratio=share, seed=i)
+             for i, rate in enumerate(rates)]
+
+    t_cold = time.perf_counter()
+    pages_per_slot = model.max_seq_len // 16
+    scheduler = Scheduler(model, params, slots=slots, page_size=16,
+                          num_pages=(slots + 4) * pages_per_slot + 1,
+                          admission_window=slots,
+                          strict_no_retrace=True).start()
+    try:
+        all_requests = []
+        for spec in specs:
+            all_requests.extend(loadgen.build_requests(
+                spec, model.vocab_size, model.max_seq_len))
+        buckets = sorted({scheduler._bucket(r) for r in all_requests})
+        scheduler.warmup(buckets,
+                         sampling_configs=[(("temperature", 0.0),)])
+        first_step_seconds = time.perf_counter() - t_cold
+        warm = runtime_lib.compile_stats()
+        runs = [loadgen.run_load(scheduler, spec, slo_ttft=slo_ttft,
+                                 slo_tpot=slo_tpot)
+                for spec in specs]
+        after = runtime_lib.compile_stats()
+        stats = scheduler.stats()
+    finally:
+        scheduler.close()
+
+    # Sweep order is the env-var order; value/vs_baseline key on the
+    # rate extremes so a reordered RATES list still records the same
+    # contrast.
+    lowest = min(runs, key=lambda r: r["spec"]["rate"])
+    highest = max(runs, key=lambda r: r["spec"]["rate"])
+    _pstats = compile_cache.stats()
+    record = {
+        "metric": _metric_name(),
+        "value": round(highest["goodput"], 4),
+        "unit": "goodput_frac",
+        # Goodput under the lightest offered load: the run's own
+        # underload control, not a cached foreign number.
+        "vs_baseline": round(lowest["goodput"], 4),
+        "method": "open_loop_loadgen",
+        "slots": slots,
+        "requests_per_rate": n_requests,
+        "process": process,
+        "shared_prefix_ratio": share,
+        "slo_ttft_s": slo_ttft,
+        "slo_tpot_s": slo_tpot,
+        "load_curve": [{
+            "rate": run["spec"]["rate"],
+            "offered_rps": round(run["offered_rps"], 3),
+            "achieved_rps": round(run["achieved_rps"], 3),
+            "goodput": round(run["goodput"], 4),
+            "completed": run["completed"],
+            "rejected": run["rejected"],
+            "failed": run["failed"],
+            "ttft_p95_s": _pct(run["ttft"], "p95"),
+            "tpot_p95_s": _pct(run["tpot"], "p95"),
+            "hit_rate": round(run["hit_rate"], 4),
+        } for run in runs],
+        "prefix_hit_rate": round(stats["prefix_hit_rate"], 4),
+        "queue_wait_p95_s": _pct(stats["queue_wait"], "p95"),
+        "reserve_wait_p95_s": _pct(stats["reserve_wait"], "p95"),
+        "ticks": stats["ticks"],
+        "new_traces_post_warmup": after["n_traces"] - warm["n_traces"],
+        "new_compiles_post_warmup": (after["n_compiles"]
+                                     - warm["n_compiles"]),
+        "n_traces": after["n_traces"],
+        "n_compiles": after["n_compiles"],
+        "compile_seconds": round(after["compile_seconds"], 3),
+        "compile_cache_hits": after["cache_hits"],
+        "persistent_cache_hits": _pstats["persistent_hits"],
+        "persistent_cache_misses": _pstats["persistent_misses"],
+        "time_to_first_step_seconds": round(first_step_seconds, 3),
+        "platform": jax.default_backend(),
+        "requested_config": _requested_config(),
+    }
+    if compile_cache.is_enabled():
+        record["compile_cache_dir"] = compile_cache.cache_dir()
+    print(json.dumps(record))
+
+
 def worker():
+    if os.environ.get("BENCH_SERVE_LOAD", "0") == "1":
+        _serve_load_worker()
+        return
     if os.environ.get("BENCH_SERVE", "0") == "1":
         _serve_worker()
         return

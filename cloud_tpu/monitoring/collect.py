@@ -24,6 +24,19 @@ Outputs under --out:
                         own labeled pid lane (Perfetto-ready)
     fleet.prom          Prometheus textfile with {host=,process=}
                         labels per series, plus fleet-level gauges
+
+Serve mode (graftlens): `--serve` additionally rolls `reqtrace` JSONL
+records (serving/reqtrace.py lifecycles, grouped per (host, pid, rid))
+into:
+    serve_report.json   per-request latency decomposition -> TTFT/TPOT
+                        percentiles split by prefix-cache hit/miss and
+                        prompt bucket, queue/reserve wait breakdown,
+                        slot-occupancy timeline, and goodput against
+                        `--slo-ttft` / `--slo-tpot`
+    trace.json          grows a "graftserve requests" lane: one tid per
+                        request, phases tiled submit->complete as "X"
+                        events (the per-request waterfall, Perfetto-
+                        ready next to the span lanes)
 """
 
 import argparse
@@ -35,7 +48,8 @@ import sys
 logger = logging.getLogger("cloud_tpu")
 
 __all__ = ["discover_inputs", "load_process_records", "merge_traces",
-           "fleet_report", "render_fleet_prometheus", "collect", "main"]
+           "fleet_report", "render_fleet_prometheus", "collect", "main",
+           "request_lifecycles", "serve_report", "serve_trace_lane"]
 
 STEP_HISTOGRAM = "cloud_tpu_step_latency_seconds"
 STEPS_PER_SEC = "cloud_tpu_steps_per_sec"
@@ -319,15 +333,341 @@ def render_fleet_prometheus(report):
     return "\n".join(lines) + "\n"
 
 
-def collect(inputs, out_dir):
+# -- graftlens serve mode ---------------------------------------------
+
+#: Lifecycle boundary events in pipeline order; the time between two
+#: consecutive PRESENT boundaries is attributed to the phase named
+#: after the later one. The tiling telescopes: phase sums equal the
+#: submitted->complete span exactly, so the waterfall accounts for the
+#: request's end-to-end latency (the accounting_residual check).
+_BOUNDARIES = ("submitted", "queued", "pages_reserved", "prefill",
+               "slot_insert", "complete")
+_PHASE_OF = {
+    "queued": "queue_wait",
+    "pages_reserved": "admit",
+    "prefill": "prefill",
+    "slot_insert": "await_slot",
+    "complete": "decode",
+}
+
+
+def _quantile(values, q):
+    vals = sorted(v for v in values if v is not None)
+    if not vals:
+        return None
+    pos = (len(vals) - 1) * q
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
+
+def _pcts(values):
+    vals = [v for v in values if v is not None]
+    out = {"count": len(vals)}
+    for name, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+        out[name] = _quantile(vals, q)
+    out["mean"] = sum(vals) / len(vals) if vals else None
+    return out
+
+
+def request_lifecycles(by_process):
+    """reqtrace records -> ({"host/pid/rid": [events]}, [global events]).
+
+    Event dicts are the record payloads plus "_monotonic"/"_time"
+    stamps, sorted by emit time per request. rid-less payloads
+    (prefix_evict) land in the global list. rids are unique per
+    process, so the (host, pid) prefix keeps two processes' r000000
+    apart in one merged view.
+    """
+    lifecycles = {}
+    globals_ = []
+    for records in by_process.values():
+        for record in records:
+            if record.get("kind") != "reqtrace":
+                continue
+            payload = record.get("payload")
+            if not isinstance(payload, dict) or "event" not in payload:
+                continue
+            event = dict(payload)
+            event["_monotonic"] = float(record.get("monotonic", 0.0))
+            event["_time"] = record.get("time")
+            rid = payload.get("rid")
+            if rid is None:
+                globals_.append(event)
+                continue
+            key = "{}/{}/{}".format(record.get("host", "unknown"),
+                                    record.get("pid", 0), rid)
+            lifecycles.setdefault(key, []).append(event)
+    for events in lifecycles.values():
+        events.sort(key=lambda e: e["_monotonic"])
+    globals_.sort(key=lambda e: e["_monotonic"])
+    return lifecycles, globals_
+
+
+def _summarize_request(events):
+    """One lifecycle -> summary row: identity fields, terminal status,
+    per-phase durations (boundary tiling), and latency cross-checks."""
+    first = {}
+    for event in events:
+        first.setdefault(event["event"], event)
+    summary = {"events": len(events)}
+    submitted = first.get("submitted")
+    if submitted is not None:
+        summary["prompt_len"] = submitted.get("prompt_len")
+        summary["max_new"] = submitted.get("max_new")
+    complete = first.get("complete")
+    fail = first.get("fail")
+    summary["terminal"] = ("complete" if complete is not None
+                           else "fail" if fail is not None else None)
+    prefill = first.get("prefill")
+    probe = first.get("radix_probe")
+    prefix_len = None
+    if complete is not None:
+        prefix_len = complete.get("prefix_len")
+    elif prefill is not None:
+        prefix_len = prefill.get("prefix_len")
+    summary["prefix_len"] = prefix_len
+    if prefix_len is not None:
+        summary["hit"] = bool(prefix_len)
+    elif probe is not None:
+        summary["hit"] = bool(probe.get("hit"))
+    else:
+        summary["hit"] = None
+    if prefill is not None:
+        summary["bucket"] = prefill.get("bucket")
+        summary["prefill_dur_s"] = prefill.get("dur_s")
+    queued = first.get("queued")
+    if queued is not None:
+        summary["queue_wait_s"] = queued.get("wait_s")
+    reserved = first.get("pages_reserved")
+    if reserved is not None:
+        summary["reserve_wait_s"] = reserved.get("wait_s")
+        summary["pages"] = reserved.get("pages")
+    if complete is not None:
+        summary["ttft_s"] = complete.get("ttft_s")
+        summary["latency_s"] = complete.get("latency_s")
+        tokens = complete.get("tokens")
+        summary["tokens"] = tokens
+        if (tokens and tokens > 1
+                and summary.get("ttft_s") is not None
+                and summary.get("latency_s") is not None):
+            summary["tpot_s"] = ((summary["latency_s"]
+                                  - summary["ttft_s"]) / (tokens - 1))
+    if fail is not None:
+        summary["error"] = fail.get("error")
+    present = [(name, first[name]["_monotonic"])
+               for name in _BOUNDARIES if name in first]
+    phases = {}
+    for (_, t_a), (name_b, t_b) in zip(present, present[1:]):
+        phase = _PHASE_OF[name_b]
+        phases[phase] = phases.get(phase, 0.0) + max(t_b - t_a, 0.0)
+    summary["phases_s"] = phases
+    if complete is not None and submitted is not None:
+        span = complete["_monotonic"] - submitted["_monotonic"]
+        summary["trace_span_s"] = span
+        if summary.get("latency_s") is not None:
+            # latency is measured at future-resolution; the traced span
+            # tiles submitted->complete. |residual| beyond a few ms
+            # means an emission site stopped tiling.
+            summary["accounting_residual_s"] = (summary["latency_s"]
+                                                - span)
+    return summary
+
+
+def serve_report(lifecycles, globals_=(), slo_ttft=None, slo_tpot=None):
+    """Per-request lifecycles -> the serve report dict.
+
+    Goodput = completed AND ttft <= slo_ttft AND tpot <= slo_tpot,
+    over ALL submitted requests (sheds/failures/orphans count against
+    it). A None SLO target passes that axis; single-token requests
+    have no TPOT and pass the TPOT axis. The hit/miss goodput split
+    uses completed requests of that class as its denominator (an
+    orphan has no authoritative class).
+    """
+    requests = {key: _summarize_request(events)
+                for key, events in lifecycles.items()}
+    rows = list(requests.values())
+    completed = [r for r in rows if r["terminal"] == "complete"]
+    failed = [r for r in rows if r["terminal"] == "fail"]
+    orphans = sorted(key for key, r in requests.items()
+                     if r["terminal"] is None)
+
+    def _good(row):
+        if row["terminal"] != "complete":
+            return False
+        if slo_ttft is not None and (row.get("ttft_s") is None
+                                     or row["ttft_s"] > slo_ttft):
+            return False
+        tpot = row.get("tpot_s")
+        if slo_tpot is not None and tpot is not None and tpot > slo_tpot:
+            return False
+        return True
+
+    def _goodput(rows_subset, denominator):
+        if not denominator:
+            return None
+        return sum(1 for r in rows_subset if _good(r)) / denominator
+
+    hits = [r for r in completed if r.get("hit")]
+    misses = [r for r in completed if r.get("hit") is False]
+    by_bucket = {}
+    for row in completed:
+        bucket = row.get("bucket")
+        if bucket is not None:
+            by_bucket.setdefault(int(bucket), []).append(row)
+
+    occupancy = sorted(
+        (event["_monotonic"], event.get("active_slots"))
+        for events in lifecycles.values() for event in events
+        if event["event"] == "tick_commit"
+        and event.get("active_slots") is not None)
+    timeline = []
+    if occupancy:
+        t0 = occupancy[0][0]
+        stride = max(1, len(occupancy) // 240)
+        timeline = [[round(t - t0, 6), slots]
+                    for t, slots in occupancy[::stride]]
+    residuals = [abs(r["accounting_residual_s"]) for r in completed
+                 if r.get("accounting_residual_s") is not None]
+    phase_names = sorted({name for r in rows
+                          for name in r.get("phases_s", ())})
+    report = {
+        "format": "cloud_tpu.serve_report.v1",
+        "slo": {"ttft_s": slo_ttft, "tpot_s": slo_tpot},
+        "requests": {
+            "submitted": len(rows),
+            "completed": len(completed),
+            "failed": len(failed),
+            "orphaned": len(orphans),
+            "orphans": orphans,
+        },
+        "goodput": {
+            "overall": _goodput(completed, len(rows)) or 0.0,
+            "hit": _goodput(hits, len(hits)),
+            "miss": _goodput(misses, len(misses)),
+        },
+        "ttft": {
+            "overall": _pcts([r.get("ttft_s") for r in completed]),
+            "hit": _pcts([r.get("ttft_s") for r in hits]),
+            "miss": _pcts([r.get("ttft_s") for r in misses]),
+            "by_bucket": {
+                str(bucket): _pcts([r.get("ttft_s") for r in rows_b])
+                for bucket, rows_b in sorted(by_bucket.items())},
+        },
+        "tpot": {
+            "overall": _pcts([r.get("tpot_s") for r in completed]),
+            "hit": _pcts([r.get("tpot_s") for r in hits]),
+            "miss": _pcts([r.get("tpot_s") for r in misses]),
+        },
+        "latency": _pcts([r.get("latency_s") for r in completed]),
+        "queue_wait": _pcts([r.get("queue_wait_s") for r in rows]),
+        "reserve_wait": _pcts([r.get("reserve_wait_s") for r in rows]),
+        "phases": {name: _pcts([r.get("phases_s", {}).get(name)
+                                for r in rows])
+                   for name in phase_names},
+        "accounting_max_residual_s": max(residuals) if residuals
+        else None,
+        "slot_occupancy": {
+            "mean": (sum(s for _, s in occupancy) / len(occupancy)
+                     if occupancy else None),
+            "max": max((s for _, s in occupancy), default=None),
+            "timeline": timeline,
+        },
+        "prefix_evict_pages": sum(e.get("pages", 0) for e in globals_
+                                  if e["event"] == "prefix_evict"),
+        "per_request": requests,
+    }
+    return report
+
+
+def serve_trace_lane(lifecycles, globals_=(), pid=0):
+    """Per-request waterfall as Chrome trace events on one pid lane.
+
+    tid 0 is the global cache lane (prefix_evict instants); each
+    request gets its own tid (ordered by first event) named after its
+    rid, with its phases tiled as "X" events and tick_commit/fail as
+    instants. Timestamps are microseconds from the earliest reqtrace
+    event, so the lane lines up with span lanes from the same process.
+    """
+    monos = [e["_monotonic"] for events in lifecycles.values()
+             for e in events]
+    monos.extend(e["_monotonic"] for e in globals_)
+    if not monos:
+        return []
+    t0 = min(monos)
+
+    def _us(t):
+        return (t - t0) * 1e6
+
+    events = [
+        {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+         "args": {"name": "graftserve requests"}},
+        {"ph": "M", "pid": pid, "tid": 0, "name": "process_sort_index",
+         "args": {"sort_index": pid}},
+        {"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
+         "args": {"name": "prefix cache"}},
+    ]
+    for event in globals_:
+        events.append({"ph": "i", "pid": pid, "tid": 0, "s": "t",
+                       "name": event["event"], "ts": _us(event["_monotonic"]),
+                       "args": {k: v for k, v in event.items()
+                                if not k.startswith("_")}})
+    ordered = sorted(lifecycles.items(),
+                     key=lambda kv: kv[1][0]["_monotonic"])
+    for tid, (key, levents) in enumerate(ordered, start=1):
+        rid = key.rsplit("/", 1)[-1]
+        events.append({"ph": "M", "pid": pid, "tid": tid,
+                       "name": "thread_name", "args": {"name": rid}})
+        first = {}
+        for event in levents:
+            first.setdefault(event["event"], event)
+        present = [(name, first[name]["_monotonic"])
+                   for name in _BOUNDARIES if name in first]
+        for (_, t_a), (name_b, t_b) in zip(present, present[1:]):
+            args = {k: v for k, v in first[name_b].items()
+                    if not k.startswith("_") and k != "rid"}
+            events.append({"ph": "X", "pid": pid, "tid": tid,
+                           "name": _PHASE_OF[name_b], "cat": "reqtrace",
+                           "ts": _us(t_a),
+                           "dur": max((t_b - t_a) * 1e6, 0.0),
+                           "args": args})
+        for event in levents:
+            if event["event"] in ("tick_commit", "fail"):
+                events.append({"ph": "i", "pid": pid, "tid": tid,
+                               "s": "t", "name": event["event"],
+                               "ts": _us(event["_monotonic"]),
+                               "args": {k: v for k, v in event.items()
+                                        if not k.startswith("_")
+                                        and k != "rid"}})
+    return events
+
+
+def collect(inputs, out_dir, serve=False, slo_ttft=None, slo_tpot=None):
     """The full pass: discover -> group -> report -> merge -> write.
     Returns the fleet report dict (with an extra "outputs" section
-    naming what was written)."""
+    naming what was written). `serve=True` additionally rolls reqtrace
+    records into serve_report.json and a waterfall lane in trace.json.
+    """
     jsonl_paths, trace_paths = discover_inputs(inputs)
     by_process, corrupt = load_process_records(jsonl_paths)
     report = fleet_report(by_process, corrupt)
     os.makedirs(out_dir, exist_ok=True)
     outputs = {}
+
+    lifecycles, globals_ = {}, []
+    if serve:
+        lifecycles, globals_ = request_lifecycles(by_process)
+        sreport = serve_report(lifecycles, globals_,
+                               slo_ttft=slo_ttft, slo_tpot=slo_tpot)
+        serve_path = os.path.join(out_dir, "serve_report.json")
+        with open(serve_path, "w") as f:
+            json.dump(sreport, f, indent=2, sort_keys=True)
+            f.write("\n")
+        outputs["serve_report"] = serve_path
+        report["serve"] = {
+            "requests": sreport["requests"],
+            "goodput": sreport["goodput"],
+        }
 
     report_path = os.path.join(out_dir, "fleet_report.json")
     with open(report_path, "w") as f:
@@ -335,13 +675,17 @@ def collect(inputs, out_dir):
         f.write("\n")
     outputs["report"] = report_path
 
-    if trace_paths:
+    if trace_paths or (serve and lifecycles):
         trace, lanes = merge_traces(trace_paths)
+        if serve:
+            trace["traceEvents"].extend(
+                serve_trace_lane(lifecycles, globals_, pid=len(lanes)))
         trace_path = os.path.join(out_dir, "trace.json")
         with open(trace_path, "w") as f:
             json.dump(trace, f)
         outputs["trace"] = trace_path
-        outputs["lanes"] = len(lanes)
+        outputs["lanes"] = len(lanes) + (1 if serve and lifecycles
+                                         else 0)
 
     prom_path = os.path.join(out_dir, "fleet.prom")
     with open(prom_path, "w") as f:
@@ -362,10 +706,25 @@ def main(argv=None):
                              "trace.json files")
     parser.add_argument("--out", default="fleet",
                         help="output directory (default ./fleet)")
+    parser.add_argument("--serve", action="store_true",
+                        help="also roll reqtrace records into "
+                             "serve_report.json + a waterfall lane")
+    parser.add_argument("--slo-ttft", type=float, default=None,
+                        help="goodput TTFT target, seconds")
+    parser.add_argument("--slo-tpot", type=float, default=None,
+                        help="goodput per-token target, seconds")
     args = parser.parse_args(argv)
-    report = collect(args.inputs, args.out)
+    report = collect(args.inputs, args.out, serve=args.serve,
+                     slo_ttft=args.slo_ttft, slo_tpot=args.slo_tpot)
     fleet = report["fleet"]
     print("fleet: {} process(es)".format(fleet["process_count"]))
+    serve = report.get("serve")
+    if serve is not None:
+        reqs = serve["requests"]
+        print("serve: {} submitted / {} completed / {} failed / {} "
+              "orphaned, goodput {}".format(
+                  reqs["submitted"], reqs["completed"], reqs["failed"],
+                  reqs["orphaned"], serve["goodput"]["overall"]))
     if "step_p50_skew_pct" in fleet:
         print("step p50 skew: {:.1f}% (straggler: {})".format(
             fleet["step_p50_skew_pct"], fleet["straggler"]))
@@ -375,7 +734,7 @@ def main(argv=None):
             (report.get("corrupt_inputs") or {}).items()):
         print("torn input: {} ({} corrupt line(s))".format(
             path, "unreadable" if count < 0 else count))
-    for key in ("report", "trace", "prom"):
+    for key in ("report", "serve_report", "trace", "prom"):
         if key in report["outputs"]:
             print("wrote {}".format(report["outputs"][key]))
     return 0 if fleet["process_count"] else 1

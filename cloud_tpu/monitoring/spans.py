@@ -28,6 +28,14 @@ telemetry smoke, and the telemetry histograms all key on them):
     checkpoint_snapshot   the donation-safe host copy before a save
     async_reader_drain    the off-thread metric fetch
     decode                one generate()/beam/speculative call
+    serve_prefill         one serving prefill: gather + dense prefill
+                          + first-token fetch (the TTFT device side)
+    serve_tick            one engine tick: dispatch + d2h fetch of the
+                          committed tokens (the serving hot loop)
+
+Request-scoped serving observability (per-request lifecycles rather
+than host sections) lives in serving/reqtrace.py; its JSONL records
+merge into the same Perfetto view via `monitoring/collect.py --serve`.
 """
 
 import json

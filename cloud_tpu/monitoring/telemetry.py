@@ -62,6 +62,8 @@ SPAN_HISTOGRAMS = {
     "checkpoint_snapshot": "cloud_tpu_checkpoint_snapshot_seconds",
     "async_reader_drain": "cloud_tpu_async_reader_drain_seconds",
     "decode": "cloud_tpu_decode_seconds",
+    "serve_prefill": "cloud_tpu_serve_prefill_seconds",
+    "serve_tick": "cloud_tpu_serve_tick_wall_seconds",
 }
 
 DECODE_TOKEN_HISTOGRAM = "cloud_tpu_decode_token_latency_seconds"
@@ -77,6 +79,14 @@ SERVE_QUEUE_DEPTH = "cloud_tpu_serve_queue_depth"
 SERVE_ACTIVE_SLOTS = "cloud_tpu_serve_active_slots"
 SERVE_TTFT_HISTOGRAM = "cloud_tpu_serve_ttft_seconds"
 SERVE_TOKEN_HISTOGRAM = "cloud_tpu_serve_token_latency_seconds"
+#: graftlens (PR 13) latency decomposition: queue wait (submit ->
+#: admission pop) and KV-page reservation blocking time were previously
+#: folded into TTFT; splitting them out is the direct input ROADMAP
+#: item 4's predicted-TTFT admission needs, and the waiter gauge makes
+#: PagePool backpressure visible instead of masquerading as prefill.
+SERVE_QUEUE_WAIT_HISTOGRAM = "cloud_tpu_serve_queue_wait_seconds"
+SERVE_RESERVE_WAIT_HISTOGRAM = "cloud_tpu_serve_reserve_wait_seconds"
+SERVE_RESERVE_WAITERS = "cloud_tpu_serve_reserve_waiters"
 
 #: graftshare (prefix cache + CoW pages + tick speculation) names.
 #: Split TTFT: requests whose prompt hit the radix prefix cache prefill

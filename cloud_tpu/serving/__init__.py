@@ -6,18 +6,25 @@ See cloud_tpu/serving/README.md for the architecture. Public surface:
 - `DecodeEngine` — slot-indexed jitted tick/insert/evict (engine.py)
 - `Scheduler`/`ServeRequest`/`ServeResult` — threads, admission,
   backpressure, telemetry (scheduler.py)
+- `RequestTracer` — per-request lifecycle JSONL tracing behind
+  `CLOUD_TPU_REQTRACE` (reqtrace.py)
+- `LoadSpec` — open-arrival load generation (loadgen.py)
 """
 
 from cloud_tpu.serving.engine import (DecodeEngine, PrefillResult,
                                       RetraceError)
 from cloud_tpu.serving.kvpool import PagePool
+from cloud_tpu.serving.loadgen import LoadSpec
+from cloud_tpu.serving.reqtrace import RequestTracer
 from cloud_tpu.serving.scheduler import (Scheduler, ServeRequest,
                                          ServeResult)
 
 __all__ = [
     "DecodeEngine",
+    "LoadSpec",
     "PagePool",
     "PrefillResult",
+    "RequestTracer",
     "RetraceError",
     "Scheduler",
     "ServeRequest",

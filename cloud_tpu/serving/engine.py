@@ -43,11 +43,13 @@ enforced oracles.
 
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cloud_tpu.monitoring import spans
 from cloud_tpu.parallel import runtime
 
 
@@ -313,6 +315,7 @@ class DecodeEngine:
         from cloud_tpu.models.decoding import (acquire_cache,
                                                bucket_length)
 
+        t0_ns = time.monotonic_ns()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         prompt_len = int(prompt.shape[0])
         prefix_len = int(prefix_len)
@@ -360,6 +363,10 @@ class DecodeEngine:
             step_keys[:max_new_tokens - 1] = np.asarray(
                 jax.random.split(key, max_new_tokens - 1))
         first_host = int(runtime.device_fetch(first)[0])
+        # Span covers gather + dense prefill + the blocking first-token
+        # fetch — the device side of TTFT (no-op with no tracer).
+        spans.complete("serve_prefill", t0_ns,
+                       time.monotonic_ns() - t0_ns)
         return PrefillResult(first_token=first_host, pcache=pcache,
                              dpcache=dpcache, step_keys=step_keys,
                              bucket=bucket, n_steps=int(max_new_tokens),

@@ -59,6 +59,7 @@ class PagePool:
         self._free = list(range(1, self.num_pages))
         self._refs = {}  # page id -> holder count, allocated pages only
         self._cow_copies = 0
+        self._reserve_waiters = 0
         self._closed = False
 
     @property
@@ -104,9 +105,16 @@ class PagePool:
                 "cannot reserve {} pages from a pool of {} allocatable "
                 "pages.".format(n, self.capacity))
         with self._cond:
-            ok = self._cond.wait_for(
-                lambda: self._closed or len(self._free) >= n,
-                timeout=timeout)
+            # The waiter count only becomes observable while wait_for
+            # actually releases the lock, so the gauge reads as "threads
+            # currently blocked on page reservation" — live backpressure.
+            self._reserve_waiters += 1
+            try:
+                ok = self._cond.wait_for(
+                    lambda: self._closed or len(self._free) >= n,
+                    timeout=timeout)
+            finally:
+                self._reserve_waiters -= 1
             if self._closed or not ok:
                 return None
             pages = [self._free.pop() for _ in range(n)]
@@ -156,6 +164,11 @@ class PagePool:
             if recycled:
                 self._cond.notify_all()
 
+    def reserve_waiters(self):
+        """Threads currently blocked inside reserve() (backpressure)."""
+        with self._cond:
+            return self._reserve_waiters
+
     def note_cow(self, n=1):
         """Counts a copy-on-write page reconstruction (telemetry)."""
         with self._cond:
@@ -176,6 +189,7 @@ class PagePool:
                 "pages_shared": sum(1 for r in self._refs.values()
                                     if r >= 2),
                 "cow_copies": self._cow_copies,
+                "reserve_waiters": self._reserve_waiters,
                 "refcount_hist": hist,
             }
 

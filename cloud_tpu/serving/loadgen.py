@@ -1,0 +1,346 @@
+"""graftlens loadgen: open-arrival traffic against a live Scheduler.
+
+Closed-loop drivers (smoke.py's run_serve) submit the next request when
+the previous one finishes, so they can never observe queueing collapse:
+the system sets its own arrival rate. This generator is OPEN-LOOP — a
+fixed seed draws an arrival schedule (Poisson, or bursty Gamma renewal
+with CV^2 = `burstiness`), a prompt-length mix, a shared-prefix ratio,
+and per-request decode budgets, then submits each request at its
+scheduled wall time regardless of completions. Latency under load is
+then a property of the serving stack, not of the driver.
+
+Goodput is the serving SLO currency: the fraction of OFFERED requests
+that completed AND met both targets (TTFT <= --slo-ttft, TPOT <=
+--slo-tpot, TPOT = (latency - ttft) / (tokens - 1)). Shed or failed
+requests count against goodput by construction.
+
+The module is also the CI `serve-trace-smoke` driver: run with
+`CLOUD_TPU_REQTRACE=1` it produces the reqtrace JSONL that
+`monitoring/collect.py --serve` rolls into the per-request waterfall +
+`serve_report.json`, and `BENCH_SERVE_LOAD=1` (bench.py) records
+offered load vs. achieved goodput at several arrival rates.
+
+Usage (CPU-friendly):
+
+    JAX_PLATFORMS=cpu CLOUD_TPU_REQTRACE=1 \\
+        python -m cloud_tpu.serving.loadgen \\
+        --requests 20 --rate 8 --out-dir /tmp/lens
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import queue
+import time
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LoadSpec:
+    """One open-arrival run. All randomness flows from `seed`, so a
+    spec is a complete, reproducible description of the traffic."""
+    rate: float                     # mean arrivals per second
+    n_requests: int = 20
+    process: str = "poisson"        # "poisson" | "bursty"
+    burstiness: float = 4.0         # Gamma CV^2 (1.0 == poisson)
+    # Prompt-length mix: (length, weight) pairs, normalized.
+    prompt_buckets: tuple = ((6, 0.4), (12, 0.35), (24, 0.25))
+    max_new_lo: int = 2
+    max_new_hi: int = 8             # inclusive
+    shared_prefix_ratio: float = 0.0
+    shared_prefix_len: int = 16
+    seed: int = 0
+    submit_timeout: float = 0.05    # then shed (queue.Full -> rejected)
+
+    def validate(self):
+        if self.rate <= 0:
+            raise ValueError("rate must be > 0.")
+        if self.n_requests < 1:
+            raise ValueError("n_requests must be >= 1.")
+        if self.process not in ("poisson", "bursty"):
+            raise ValueError("process must be poisson|bursty; got "
+                             "{!r}.".format(self.process))
+        if self.burstiness <= 0:
+            raise ValueError("burstiness must be > 0.")
+        if not 0.0 <= self.shared_prefix_ratio <= 1.0:
+            raise ValueError("shared_prefix_ratio must be in [0, 1].")
+
+
+def build_arrivals(spec):
+    """Arrival times (seconds from run start), shape [n_requests].
+
+    poisson: exponential inter-arrivals, mean 1/rate. bursty: Gamma
+    inter-arrivals with shape 1/burstiness and scale burstiness/rate —
+    same mean 1/rate, CV^2 = burstiness, so load comes in clumps while
+    the offered rate stays comparable across processes.
+    """
+    spec.validate()
+    rng = np.random.default_rng(spec.seed)
+    if spec.process == "poisson":
+        gaps = rng.exponential(1.0 / spec.rate, spec.n_requests)
+    else:
+        gaps = rng.gamma(1.0 / spec.burstiness,
+                         spec.burstiness / spec.rate, spec.n_requests)
+    return np.cumsum(gaps)
+
+
+def build_requests(spec, vocab_size, max_seq_len):
+    """Deterministic request list for `spec`. Token ids stay in
+    [2, vocab); shared-prefix requests extend one common root (the
+    radix-cache hit population) and everything fits prompt + max_new
+    <= max_seq_len."""
+    from cloud_tpu.serving.scheduler import ServeRequest
+
+    spec.validate()
+    rng = np.random.default_rng(spec.seed + 1)
+    lengths = [int(length) for length, _ in spec.prompt_buckets]
+    weights = np.asarray([w for _, w in spec.prompt_buckets], float)
+    weights = weights / weights.sum()
+    hi = max(2, vocab_size)
+    root = rng.integers(2, hi, (spec.shared_prefix_len,)).tolist()
+    requests = []
+    for _ in range(spec.n_requests):
+        length = int(rng.choice(lengths, p=weights))
+        max_new = int(rng.integers(spec.max_new_lo,
+                                   spec.max_new_hi + 1))
+        length = min(length, max_seq_len - max_new)
+        shared = (rng.random() < spec.shared_prefix_ratio
+                  and length > spec.shared_prefix_len)
+        if shared:
+            tail = rng.integers(2, hi, (length
+                                        - spec.shared_prefix_len,))
+            prompt = root + tail.tolist()
+        else:
+            prompt = rng.integers(2, hi, (length,)).tolist()
+        requests.append(ServeRequest(
+            prompt=[int(t) for t in prompt],
+            max_new_tokens=max_new, temperature=0.0,
+            rng_seed=int(rng.integers(0, 2**31 - 1))))
+    return requests
+
+
+def _percentiles(values):
+    vals = sorted(v for v in values if v is not None)
+    if not vals:
+        return {"count": 0, "p50": None, "p95": None, "p99": None,
+                "mean": None}
+    arr = np.asarray(vals, float)
+    return {
+        "count": len(vals),
+        "p50": float(np.percentile(arr, 50)),
+        "p95": float(np.percentile(arr, 95)),
+        "p99": float(np.percentile(arr, 99)),
+        "mean": float(arr.mean()),
+    }
+
+
+def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
+             result_timeout=300.0):
+    """Drives one open-arrival run against a started, warmed Scheduler.
+
+    Returns the run report dict (format cloud_tpu.loadgen.v1): offered /
+    completed / rejected / failed counts, offered vs. achieved rps,
+    TTFT / TPOT / latency percentiles, goodput against the SLOs, and a
+    per-request row list (the collector's cross-check against the
+    reqtrace waterfall).
+    """
+    arrivals = build_arrivals(spec)
+    requests = build_requests(spec, scheduler.engine.model.vocab_size,
+                              scheduler.engine.max_seq_len)
+    inflight = []
+    t0 = time.monotonic()
+    for request, t_arr in zip(requests, arrivals):
+        delay = t0 + float(t_arr) - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t_sub = time.monotonic() - t0
+        try:
+            future = scheduler.submit(request,
+                                      timeout=spec.submit_timeout)
+        except queue.Full:
+            inflight.append((request, t_sub, None))
+            continue
+        inflight.append((request, t_sub, future))
+
+    rows = []
+    completed = rejected = failed = 0
+    t_last_done = t0
+    for request, t_sub, future in inflight:
+        row = {
+            "submit_s": round(t_sub, 6),
+            "prompt_len": len(request.prompt),
+            "max_new": request.max_new_tokens,
+        }
+        if future is None:
+            rejected += 1
+            row["status"] = "rejected"
+            rows.append(row)
+            continue
+        try:
+            result = future.result(timeout=result_timeout)
+        except BaseException as exc:  # noqa: BLE001
+            failed += 1
+            row["status"] = "failed"
+            row["error"] = "{}: {}".format(type(exc).__name__,
+                                           str(exc)[:200])
+            rows.append(row)
+            continue
+        completed += 1
+        t_last_done = max(t_last_done, time.monotonic())
+        n = request.max_new_tokens
+        tpot = ((result.latency_s - result.ttft_s) / (n - 1)
+                if n > 1 else None)
+        row.update(status="complete",
+                   ttft_s=round(result.ttft_s, 6),
+                   latency_s=round(result.latency_s, 6),
+                   tpot_s=None if tpot is None else round(tpot, 6),
+                   prefix_len=int(result.prefix_len),
+                   hit=bool(result.prefix_len > 0))
+        row["good"] = bool(
+            (slo_ttft is None or result.ttft_s <= slo_ttft)
+            and (slo_tpot is None or tpot is None or tpot <= slo_tpot))
+        rows.append(row)
+
+    wall = max(t_last_done - t0, 1e-9)
+    offered_span = max(float(arrivals[-1]), 1e-9)
+    good = sum(1 for r in rows if r.get("good"))
+    done_rows = [r for r in rows if r["status"] == "complete"]
+    return {
+        "format": "cloud_tpu.loadgen.v1",
+        "spec": {
+            "rate": spec.rate,
+            "n_requests": spec.n_requests,
+            "process": spec.process,
+            "burstiness": spec.burstiness,
+            "prompt_buckets": [list(b) for b in spec.prompt_buckets],
+            "max_new": [spec.max_new_lo, spec.max_new_hi],
+            "shared_prefix_ratio": spec.shared_prefix_ratio,
+            "shared_prefix_len": spec.shared_prefix_len,
+            "seed": spec.seed,
+        },
+        "offered": len(rows),
+        "completed": completed,
+        "rejected": rejected,
+        "failed": failed,
+        "offered_rps": len(rows) / offered_span,
+        "achieved_rps": completed / wall,
+        "duration_s": wall,
+        "slo": {"ttft_s": slo_ttft, "tpot_s": slo_tpot},
+        "goodput": good / max(len(rows), 1),
+        "ttft": _percentiles([r.get("ttft_s") for r in done_rows]),
+        "tpot": _percentiles([r.get("tpot_s") for r in done_rows]),
+        "latency": _percentiles([r.get("latency_s")
+                                 for r in done_rows]),
+        "hit_rate": (sum(1 for r in done_rows if r.get("hit"))
+                     / max(len(done_rows), 1)),
+        "per_request": rows,
+    }
+
+
+def _build_scheduler(args):
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.serving.scheduler import Scheduler
+    from cloud_tpu.serving.smoke import build_model
+
+    model = build_model(num_layers=args.layers)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    pages_per_slot = model.max_seq_len // args.page_size
+    return Scheduler(model, params, slots=args.slots,
+                     page_size=args.page_size,
+                     num_pages=(args.slots + 4) * pages_per_slot + 1,
+                     admission_window=args.slots,
+                     strict_no_retrace=False)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="open-arrival load generator for graftserve")
+    parser.add_argument("--rate", type=float, action="append",
+                        help="arrivals/sec; repeat for a load sweep "
+                        "(default: one run at 8.0)")
+    parser.add_argument("--requests", type=int, default=20)
+    parser.add_argument("--process", default="poisson",
+                        choices=("poisson", "bursty"))
+    parser.add_argument("--burstiness", type=float, default=4.0)
+    parser.add_argument("--shared-prefix-ratio", type=float,
+                        default=0.5)
+    parser.add_argument("--shared-prefix-len", type=int, default=16)
+    parser.add_argument("--slo-ttft", type=float, default=None)
+    parser.add_argument("--slo-tpot", type=float, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--layers", type=int, default=6,
+                        help="model depth (2 keeps CI fast)")
+    parser.add_argument("--out-dir", default="loadgen-out")
+    args = parser.parse_args(argv)
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    from cloud_tpu.serving import reqtrace
+    if reqtrace.env_enabled() and reqtrace.get() is None:
+        # Default the trace next to the report so one --out-dir is the
+        # whole artifact (CLOUD_TPU_REQTRACE_DIR still wins).
+        os.environ.setdefault("CLOUD_TPU_REQTRACE_DIR", args.out_dir)
+
+    scheduler = _build_scheduler(args)
+    scheduler.start()
+    rates = args.rate or [8.0]
+    specs = [LoadSpec(rate=rate, n_requests=args.requests,
+                      process=args.process,
+                      burstiness=args.burstiness,
+                      shared_prefix_ratio=args.shared_prefix_ratio,
+                      shared_prefix_len=args.shared_prefix_len,
+                      seed=args.seed + i)
+             for i, rate in enumerate(rates)]
+    runs = []
+    try:
+        all_requests = []
+        for spec in specs:
+            all_requests.extend(build_requests(
+                spec, scheduler.engine.model.vocab_size,
+                scheduler.engine.max_seq_len))
+        buckets = sorted({scheduler._bucket(r) for r in all_requests})
+        print("[loadgen] warmup over buckets {}".format(buckets))
+        scheduler.warmup(buckets,
+                         sampling_configs=[(("temperature", 0.0),)])
+        for spec in specs:
+            print("[loadgen] {} x{} @ {:.3g} req/s".format(
+                spec.process, spec.n_requests, spec.rate))
+            run = run_load(scheduler, spec, slo_ttft=args.slo_ttft,
+                           slo_tpot=args.slo_tpot)
+            print("[loadgen]   offered {:.3g} rps, achieved {:.3g} "
+                  "rps, goodput {:.3f}, ttft p95 {}".format(
+                      run["offered_rps"], run["achieved_rps"],
+                      run["goodput"], run["ttft"]["p95"]))
+            runs.append(run)
+        stats = scheduler.stats()
+    finally:
+        scheduler.close()
+        tracer = reqtrace.get()
+        if tracer is not None:
+            tracer.flush()
+
+    report = {
+        "format": "cloud_tpu.loadgen_sweep.v1",
+        "runs": runs,
+        "scheduler_stats": {
+            "queue_wait": stats["queue_wait"],
+            "reserve_wait": stats["reserve_wait"],
+            "ttft": stats["ttft"],
+            "prefix_hit_rate": stats["prefix_hit_rate"],
+        },
+    }
+    out_path = os.path.join(args.out_dir, "loadgen_report.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    print("[loadgen] wrote {}".format(out_path))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

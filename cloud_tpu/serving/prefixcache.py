@@ -35,6 +35,8 @@ position).
 import dataclasses
 import threading
 
+from cloud_tpu.serving import reqtrace
+
 
 @dataclasses.dataclass
 class PrefixMatch:
@@ -231,7 +233,16 @@ class PrefixCache:
         """Best-effort LRU eviction of `n_pages` (reclaim pressure from
         a blocked reservation). Returns pages freed."""
         with self._lock:
-            return self._evict_locked(int(n_pages))
+            freed = self._evict_locked(int(n_pages))
+        if freed:
+            tracer = reqtrace.get()
+            if tracer is not None:
+                # Global lane (rid=None): cache-pressure evictions are
+                # not owned by any one request but explain why the
+                # requests around them waited for pages.
+                tracer.emit(None, "prefix_evict", pages=freed,
+                            requested=int(n_pages))
+        return freed
 
     def clear(self):
         """Releases every indexed page (pool refs included). Pages

@@ -1,0 +1,197 @@
+"""graftlens request tracing: per-request lifecycle events for graftserve.
+
+Every request admitted to the Scheduler gets a process-unique request id
+(rid) stamped at ``submit()``; the serving path then annotates its
+lifecycle as typed events::
+
+    submitted -> queued -> radix_probe -> pages_reserved -> prefill
+              -> slot_insert -> tick_commit* -> complete | fail
+
+Events are buffered in-process and flushed as ``reqtrace`` JSONL records
+whose envelope matches ``cloud_tpu.utils.events`` job-event records
+(time / monotonic / host / pid / process_index / kind / payload), so
+``read_job_events()`` and the fleet collector consume them unchanged.
+``monitoring/collect.py --serve`` rolls them into a per-request waterfall
+trace plus ``serve_report.json`` (TTFT/TPOT percentiles, queue-wait
+breakdown, SLO goodput).
+
+Zero-cost discipline (same contract as spans.py): when
+``CLOUD_TPU_REQTRACE`` is unset nothing is installed — ``get()`` returns
+None, the Scheduler stamps no rids and emits no events, and no file or
+thread is ever created. The tracer itself never spawns threads either;
+buffered lines are appended synchronously on terminal events or when the
+buffer fills.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+from cloud_tpu.utils import storage
+
+_TRUTHY_OFF = ("", "0", "off", "false", "none")
+
+# Batched per-slot tick commits: one tick_commit event every N engine
+# ticks per active slot (overridable via CLOUD_TPU_REQTRACE_TICK_EVERY).
+DEFAULT_TICK_EVERY = 8
+
+_tracer = None
+_lock = threading.Lock()
+
+
+def env_enabled():
+    """True when CLOUD_TPU_REQTRACE asks for request tracing."""
+    value = os.environ.get("CLOUD_TPU_REQTRACE", "")
+    return value.strip().lower() not in _TRUTHY_OFF
+
+
+def default_path():
+    base = (os.environ.get("CLOUD_TPU_REQTRACE_DIR")
+            or os.environ.get("CLOUD_TPU_TELEMETRY_DIR")
+            or os.getcwd())
+    return os.path.join(base, "reqtrace.jsonl")
+
+
+def _process_index():
+    env = os.environ.get("CLOUD_TPU_PROCESS_INDEX")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return jax.process_index()
+        except Exception:
+            pass
+    return 0
+
+
+class RequestTracer:
+    """Buffered JSONL emitter for request lifecycle events.
+
+    Thread-safe; shared by the Scheduler's admission and tick threads.
+    Never spawns threads of its own — the env-unset pin in CI asserts
+    both zero events and zero threads.
+    """
+
+    def __init__(self, path=None, tick_every=None, flush_every=64):
+        self.path = path or default_path()
+        if tick_every is None:
+            raw = os.environ.get("CLOUD_TPU_REQTRACE_TICK_EVERY", "")
+            try:
+                tick_every = int(raw)
+            except ValueError:
+                tick_every = DEFAULT_TICK_EVERY
+        self.tick_every = max(1, int(tick_every))
+        self._flush_every = max(1, int(flush_every))
+        self._lock = threading.Lock()
+        self._buffer = []
+        self._next_rid = 0
+        self._emitted = 0
+        self._host = socket.gethostname()
+        self._pid = os.getpid()
+        self._process_index = _process_index()
+        if not storage.is_gcs_path(self.path):
+            parent = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(parent, exist_ok=True)
+
+    def new_request(self):
+        """Allocates a process-unique request id ("r000042")."""
+        with self._lock:
+            rid = "r%06d" % self._next_rid
+            self._next_rid += 1
+        return rid
+
+    def emit(self, rid, event, **fields):
+        """Records one lifecycle event. ``rid=None`` marks a global
+        (request-independent) event such as prefix_evict."""
+        payload = {"rid": rid, "event": event}
+        payload.update(fields)
+        record = {
+            "time": time.time(),
+            "monotonic": time.monotonic(),
+            "host": self._host,
+            "pid": self._pid,
+            "process_index": self._process_index,
+            "kind": "reqtrace",
+            "payload": payload,
+        }
+        line = json.dumps(record, sort_keys=True) + "\n"
+        terminal = event in ("complete", "fail")
+        with self._lock:
+            self._buffer.append(line)
+            self._emitted += 1
+            if terminal or len(self._buffer) >= self._flush_every:
+                self._flush_locked()
+
+    def events_emitted(self):
+        with self._lock:
+            return self._emitted
+
+    def _flush_locked(self):
+        if not self._buffer:
+            return
+        data = "".join(self._buffer).encode("utf-8")
+        self._buffer = []
+        storage.append_bytes(self.path, data)
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()
+
+    def close(self):
+        self.flush()
+
+
+def install(path=None, tick_every=None):
+    """Installs (or replaces) the ambient tracer and returns it."""
+    global _tracer
+    with _lock:
+        previous, _tracer = _tracer, RequestTracer(path=path,
+                                                   tick_every=tick_every)
+    if previous is not None:
+        previous.flush()
+    return _tracer
+
+
+def uninstall():
+    """Flushes and removes the ambient tracer; returns it (or None)."""
+    global _tracer
+    with _lock:
+        previous, _tracer = _tracer, None
+    if previous is not None:
+        previous.flush()
+    return previous
+
+
+def get():
+    """The ambient tracer, or None when tracing is off."""
+    return _tracer
+
+
+def maybe_enable():
+    """Scheduler.start() seam: returns the installed tracer; installs
+    one from the environment when CLOUD_TPU_REQTRACE is set; otherwise
+    returns None without touching the filesystem."""
+    if _tracer is not None:
+        return _tracer
+    if not env_enabled():
+        return None
+    return install()
+
+
+__all__ = [
+    "DEFAULT_TICK_EVERY",
+    "RequestTracer",
+    "default_path",
+    "env_enabled",
+    "get",
+    "install",
+    "maybe_enable",
+    "uninstall",
+]

@@ -191,3 +191,169 @@ class TestCLI:
         rc = collect.main([str(empty), "--out",
                            str(tmp_path / "fleet")])
         assert rc == 1
+
+# -- graftlens serve mode ---------------------------------------------
+
+
+def _reqtrace_line(monotonic, rid, event, **fields):
+    """One reqtrace JSONL record with a controlled monotonic stamp (the
+    envelope shape serving/reqtrace.py emits)."""
+    payload = {"rid": rid, "event": event}
+    payload.update(fields)
+    return json.dumps({
+        "time": 1.7e9 + monotonic, "monotonic": monotonic,
+        "host": "servehost", "pid": 42, "process_index": 0,
+        "kind": "reqtrace", "payload": payload})
+
+
+def _fabricate_reqtrace(path):
+    """Four lifecycles with hand-tiled timings: a fast hit (r0), a
+    slow miss (r1), a failure (r2), an orphan (r3), plus one global
+    prefix_evict. r0's phases sum to exactly its 34ms latency."""
+    lines = [
+        _reqtrace_line(10.000, "r000000", "submitted", prompt_len=8,
+                       max_new=4),
+        _reqtrace_line(10.002, "r000000", "queued", wait_s=0.002),
+        _reqtrace_line(10.0021, "r000000", "radix_probe", hit=True,
+                       matched_tokens=8),
+        _reqtrace_line(10.003, "r000000", "pages_reserved", pages=1,
+                       wait_s=0.0005),
+        _reqtrace_line(10.013, "r000000", "prefill", bucket=8,
+                       prefix_len=8, dur_s=0.01),
+        _reqtrace_line(10.014, "r000000", "slot_insert", slot=0),
+        _reqtrace_line(10.020, "r000000", "tick_commit",
+                       tokens_committed=2, active_slots=2, ticks=5),
+        _reqtrace_line(10.034, "r000000", "complete", ttft_s=0.014,
+                       latency_s=0.034, tokens=4, prefix_len=8),
+        _reqtrace_line(10.100, "r000001", "submitted", prompt_len=14,
+                       max_new=3),
+        _reqtrace_line(10.150, "r000001", "queued", wait_s=0.05),
+        _reqtrace_line(10.151, "r000001", "radix_probe", hit=False,
+                       matched_tokens=0),
+        _reqtrace_line(10.160, "r000001", "pages_reserved", pages=2,
+                       wait_s=0.009),
+        _reqtrace_line(10.360, "r000001", "prefill", bucket=16,
+                       prefix_len=0, dur_s=0.2),
+        _reqtrace_line(10.361, "r000001", "slot_insert", slot=1),
+        _reqtrace_line(10.420, "r000001", "complete", ttft_s=0.261,
+                       latency_s=0.32, tokens=3, prefix_len=0),
+        _reqtrace_line(10.200, "r000002", "submitted", prompt_len=4,
+                       max_new=2),
+        _reqtrace_line(10.210, "r000002", "queued", wait_s=0.01),
+        _reqtrace_line(10.220, "r000002", "fail",
+                       error="RuntimeError: scheduler closed"),
+        _reqtrace_line(10.300, "r000003", "submitted", prompt_len=6,
+                       max_new=2),
+        _reqtrace_line(10.250, None, "prefix_evict", pages=3,
+                       requested=2),
+    ]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+@pytest.fixture()
+def serve_dir(tmp_path):
+    directory = tmp_path / "serve"
+    directory.mkdir()
+    _fabricate_reqtrace(str(directory / "reqtrace.jsonl"))
+    return str(directory)
+
+
+class TestServeReport:
+    def _lifecycles(self, serve_dir):
+        jsonl_paths, _ = collect.discover_inputs([serve_dir])
+        by_process, _ = collect.load_process_records(jsonl_paths)
+        return collect.request_lifecycles(by_process)
+
+    def test_lifecycles_keyed_by_identity_and_sorted(self, serve_dir):
+        lifecycles, globals_ = self._lifecycles(serve_dir)
+        assert set(lifecycles) == {
+            "servehost/42/r00000{}".format(i) for i in range(4)}
+        r0 = lifecycles["servehost/42/r000000"]
+        assert [e["event"] for e in r0][0] == "submitted"
+        assert [e["event"] for e in r0][-1] == "complete"
+        assert [e["event"] for e in globals_] == ["prefix_evict"]
+
+    def test_report_counts_goodput_and_slo_split(self, serve_dir):
+        lifecycles, globals_ = self._lifecycles(serve_dir)
+        report = collect.serve_report(lifecycles, globals_,
+                                      slo_ttft=0.05)
+        assert report["format"] == "cloud_tpu.serve_report.v1"
+        assert report["requests"] == {
+            "submitted": 4, "completed": 2, "failed": 1,
+            "orphaned": 1, "orphans": ["servehost/42/r000003"]}
+        # r0 (hit, ttft 14ms) meets the 50ms target; r1 (miss, 261ms)
+        # misses it; the fail and the orphan count against goodput.
+        assert report["goodput"]["overall"] == pytest.approx(0.25)
+        assert report["goodput"]["hit"] == pytest.approx(1.0)
+        assert report["goodput"]["miss"] == pytest.approx(0.0)
+        assert report["ttft"]["hit"]["count"] == 1
+        assert report["ttft"]["hit"]["p50"] == pytest.approx(0.014)
+        assert report["ttft"]["miss"]["p50"] == pytest.approx(0.261)
+        assert set(report["ttft"]["by_bucket"]) == {"8", "16"}
+        assert report["tpot"]["overall"]["count"] == 2
+        assert report["prefix_evict_pages"] == 3
+        assert report["slot_occupancy"]["max"] == 2
+
+    def test_phase_tiling_accounts_for_latency(self, serve_dir):
+        lifecycles, globals_ = self._lifecycles(serve_dir)
+        report = collect.serve_report(lifecycles, globals_)
+        row = report["per_request"]["servehost/42/r000000"]
+        assert row["phases_s"] == pytest.approx({
+            "queue_wait": 0.002, "admit": 0.001, "prefill": 0.010,
+            "await_slot": 0.001, "decode": 0.020})
+        assert sum(row["phases_s"].values()) == pytest.approx(
+            row["latency_s"])
+        # Both completes were fabricated self-consistent: the residual
+        # between traced span and measured latency is ~0.
+        assert report["accounting_max_residual_s"] == pytest.approx(
+            0.0, abs=1e-9)
+        assert report["queue_wait"]["count"] == 3  # r0, r1, r2
+
+    def test_waterfall_lane_one_tid_per_request(self, serve_dir):
+        lifecycles, globals_ = self._lifecycles(serve_dir)
+        events_ = collect.serve_trace_lane(lifecycles, globals_, pid=9)
+        names = {e["args"]["name"] for e in events_
+                 if e.get("name") == "thread_name"}
+        assert names == {"prefix cache", "r000000", "r000001",
+                         "r000002", "r000003"}
+        xs = [e for e in events_ if e["ph"] == "X"]
+        assert all(e["pid"] == 9 for e in xs)
+        # r0 tiles all five phases; every X duration is non-negative.
+        assert sum(1 for e in xs) >= 5
+        assert all(e["dur"] >= 0 for e in xs)
+        instants = {e["name"] for e in events_ if e["ph"] == "i"}
+        assert {"prefix_evict", "tick_commit", "fail"} <= instants
+
+    def test_collect_serve_end_to_end(self, serve_dir, tmp_path):
+        out = str(tmp_path / "fleet")
+        report = collect.collect([serve_dir], out, serve=True,
+                                 slo_ttft=0.05, slo_tpot=0.1)
+        assert report["serve"]["requests"]["submitted"] == 4
+        serve_path = report["outputs"]["serve_report"]
+        assert serve_path.endswith("serve_report.json")
+        on_disk = json.load(open(serve_path))
+        assert on_disk["format"] == "cloud_tpu.serve_report.v1"
+        assert on_disk["slo"] == {"ttft_s": 0.05, "tpot_s": 0.1}
+        # No span traces were given: trace.json exists purely for the
+        # request waterfall lane.
+        trace = json.load(open(report["outputs"]["trace"]))
+        lanes = [e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert lanes == ["graftserve requests"]
+
+    def test_collect_without_serve_ignores_reqtrace(self, serve_dir,
+                                                    tmp_path):
+        report = collect.collect([serve_dir], str(tmp_path / "fleet"))
+        assert "serve" not in report
+        assert "serve_report" not in report["outputs"]
+
+    def test_cli_serve_summary(self, serve_dir, tmp_path, capsys):
+        rc = collect.main([serve_dir, "--out", str(tmp_path / "f"),
+                           "--serve", "--slo-ttft", "0.05"])
+        assert rc == 0
+        stdout = capsys.readouterr().out
+        assert ("serve: 4 submitted / 2 completed / 1 failed / 1 "
+                "orphaned, goodput 0.25") in stdout
+        assert "serve_report.json" in stdout

@@ -1,0 +1,315 @@
+"""graftlens: request tracing seam, loadgen determinism, SLO math.
+
+Two contracts under test. Zero-cost: with CLOUD_TPU_REQTRACE unset the
+tracer seam returns None, nothing touches the filesystem, and no thread
+is ever created — the serving hot path must be byte-identical to the
+pre-graftlens one. Reproducibility: a LoadSpec is a complete
+description of its traffic — same seed, same arrivals, same prompts —
+so a goodput number is re-derivable from the spec alone.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from cloud_tpu.serving import loadgen, reqtrace
+from cloud_tpu.utils import events
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer(monkeypatch):
+    """No ambient tracer and no enabling env leaks across tests."""
+    monkeypatch.delenv("CLOUD_TPU_REQTRACE", raising=False)
+    monkeypatch.delenv("CLOUD_TPU_REQTRACE_DIR", raising=False)
+    monkeypatch.delenv("CLOUD_TPU_REQTRACE_TICK_EVERY", raising=False)
+    reqtrace.uninstall()
+    yield
+    reqtrace.uninstall()
+
+
+class TestEnvSeam:
+
+    @pytest.mark.parametrize("value", ["", "0", "off", "OFF", "false",
+                                       "none", " 0 "])
+    def test_disabled_values(self, monkeypatch, value):
+        monkeypatch.setenv("CLOUD_TPU_REQTRACE", value)
+        assert not reqtrace.env_enabled()
+
+    @pytest.mark.parametrize("value", ["1", "true", "on", "jsonl"])
+    def test_enabled_values(self, monkeypatch, value):
+        monkeypatch.setenv("CLOUD_TPU_REQTRACE", value)
+        assert reqtrace.env_enabled()
+
+    def test_unset_maybe_enable_is_none_no_threads_no_files(
+            self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        before = threading.active_count()
+        assert reqtrace.maybe_enable() is None
+        assert reqtrace.get() is None
+        assert threading.active_count() == before
+        assert os.listdir(tmp_path) == []
+
+    def test_env_set_maybe_enable_installs_once(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_REQTRACE", "1")
+        monkeypatch.setenv("CLOUD_TPU_REQTRACE_DIR", str(tmp_path))
+        tracer = reqtrace.maybe_enable()
+        assert tracer is not None
+        assert reqtrace.maybe_enable() is tracer  # idempotent
+        assert tracer.path == os.path.join(str(tmp_path),
+                                           "reqtrace.jsonl")
+
+    def test_tracer_spawns_no_threads(self, tmp_path):
+        before = threading.active_count()
+        tracer = reqtrace.RequestTracer(
+            path=str(tmp_path / "reqtrace.jsonl"))
+        tracer.emit(tracer.new_request(), "submitted", prompt_len=4)
+        tracer.flush()
+        assert threading.active_count() == before
+
+    def test_default_path_precedence(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        assert reqtrace.default_path() == os.path.join(
+            str(tmp_path), "reqtrace.jsonl")
+        monkeypatch.setenv("CLOUD_TPU_TELEMETRY_DIR", "/tele")
+        assert reqtrace.default_path() == "/tele/reqtrace.jsonl"
+        monkeypatch.setenv("CLOUD_TPU_REQTRACE_DIR", "/lens")
+        assert reqtrace.default_path() == "/lens/reqtrace.jsonl"
+
+    def test_tick_every_env_override(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CLOUD_TPU_REQTRACE_TICK_EVERY", "3")
+        tracer = reqtrace.RequestTracer(path=str(tmp_path / "t.jsonl"))
+        assert tracer.tick_every == 3
+        monkeypatch.setenv("CLOUD_TPU_REQTRACE_TICK_EVERY", "junk")
+        tracer = reqtrace.RequestTracer(path=str(tmp_path / "t.jsonl"))
+        assert tracer.tick_every == reqtrace.DEFAULT_TICK_EVERY
+
+
+class TestRequestTracer:
+
+    def test_rids_unique_and_ordered(self, tmp_path):
+        tracer = reqtrace.RequestTracer(path=str(tmp_path / "t.jsonl"))
+        rids = [tracer.new_request() for _ in range(3)]
+        assert rids == ["r000000", "r000001", "r000002"]
+
+    def test_roundtrip_through_job_event_reader(self, tmp_path):
+        """The envelope is a utils.events job event: the PR 6 reader
+        and the fleet collector consume reqtrace lines unchanged."""
+        path = str(tmp_path / "reqtrace.jsonl")
+        tracer = reqtrace.RequestTracer(path=path)
+        rid = tracer.new_request()
+        tracer.emit(rid, "submitted", prompt_len=6, max_new=4)
+        tracer.emit(rid, "complete", ttft_s=0.01, latency_s=0.05,
+                    tokens=4, prefix_len=0)
+        records = events.read_job_events(path, kind="reqtrace")
+        assert len(records) == 2
+        for record in records:
+            assert {"time", "monotonic", "host", "pid",
+                    "process_index", "kind", "payload"} <= set(record)
+        assert records[0]["payload"] == {
+            "rid": rid, "event": "submitted", "prompt_len": 6,
+            "max_new": 4}
+        assert records[1]["payload"]["event"] == "complete"
+        assert (records[1]["monotonic"]
+                >= records[0]["monotonic"])
+
+    def test_terminal_event_flushes_buffer(self, tmp_path):
+        path = str(tmp_path / "reqtrace.jsonl")
+        tracer = reqtrace.RequestTracer(path=path, flush_every=1000)
+        rid = tracer.new_request()
+        tracer.emit(rid, "submitted", prompt_len=2)
+        tracer.emit(rid, "queued", wait_s=0.001)
+        assert not os.path.exists(path)  # buffered, not yet durable
+        tracer.emit(rid, "fail", error="ValueError: nope")
+        assert len(events.read_job_events(path)) == 3
+
+    def test_buffer_cap_flushes_without_terminal(self, tmp_path):
+        path = str(tmp_path / "reqtrace.jsonl")
+        tracer = reqtrace.RequestTracer(path=path, flush_every=4)
+        rid = tracer.new_request()
+        for _ in range(4):
+            tracer.emit(rid, "tick_commit", tokens_committed=1)
+        assert len(events.read_job_events(path)) == 4
+        assert tracer.events_emitted() == 4
+
+    def test_global_events_carry_rid_none(self, tmp_path):
+        path = str(tmp_path / "reqtrace.jsonl")
+        tracer = reqtrace.RequestTracer(path=path)
+        tracer.emit(None, "prefix_evict", pages=3, requested=2)
+        tracer.flush()
+        (record,) = events.read_job_events(path)
+        assert record["payload"]["rid"] is None
+
+
+class TestLoadgen:
+
+    def test_arrivals_deterministic_and_rate_calibrated(self):
+        spec = loadgen.LoadSpec(rate=50.0, n_requests=400, seed=9)
+        a = loadgen.build_arrivals(spec)
+        b = loadgen.build_arrivals(spec)
+        np.testing.assert_array_equal(a, b)
+        assert len(a) == 400
+        assert np.all(np.diff(a) >= 0)
+        # 400 exponential draws: the empirical mean gap sits within
+        # 25% of 1/rate with overwhelming probability.
+        assert np.mean(np.diff(a)) == pytest.approx(1 / 50.0, rel=0.25)
+        c = loadgen.build_arrivals(
+            loadgen.LoadSpec(rate=50.0, n_requests=400, seed=10))
+        assert not np.array_equal(a, c)
+
+    def test_bursty_same_mean_higher_variance(self):
+        n = 2000
+        poisson = loadgen.build_arrivals(
+            loadgen.LoadSpec(rate=20.0, n_requests=n, seed=3))
+        bursty = loadgen.build_arrivals(
+            loadgen.LoadSpec(rate=20.0, n_requests=n, seed=3,
+                             process="bursty", burstiness=8.0))
+        gp, gb = np.diff(poisson), np.diff(bursty)
+        assert np.mean(gb) == pytest.approx(np.mean(gp), rel=0.2)
+        # CV^2 = burstiness: the bursty gaps are far spikier.
+        assert np.var(gb) > 3 * np.var(gp)
+
+    def test_requests_deterministic_and_bounded(self):
+        spec = loadgen.LoadSpec(rate=4.0, n_requests=60, seed=2,
+                                shared_prefix_ratio=0.5)
+        a = loadgen.build_requests(spec, vocab_size=64, max_seq_len=32)
+        b = loadgen.build_requests(spec, vocab_size=64, max_seq_len=32)
+        assert [r.prompt for r in a] == [r.prompt for r in b]
+        assert [r.rng_seed for r in a] == [r.rng_seed for r in b]
+        for req in a:
+            assert len(req.prompt) + req.max_new_tokens <= 32
+            assert all(2 <= t < 64 for t in req.prompt)
+
+    def test_shared_prefix_population(self):
+        spec = loadgen.LoadSpec(
+            rate=4.0, n_requests=80, seed=5, shared_prefix_ratio=1.0,
+            shared_prefix_len=4, prompt_buckets=((6, 0.5), (12, 0.5)))
+        requests = loadgen.build_requests(spec, vocab_size=64,
+                                          max_seq_len=64)
+        roots = {tuple(r.prompt[:4]) for r in requests
+                 if len(r.prompt) > 4}
+        assert len(roots) == 1  # everyone long enough shares one root
+        none_shared = loadgen.build_requests(
+            loadgen.LoadSpec(rate=4.0, n_requests=80, seed=5,
+                             shared_prefix_ratio=0.0,
+                             shared_prefix_len=4),
+            vocab_size=64, max_seq_len=64)
+        assert len({tuple(r.prompt[:4])
+                    for r in none_shared if len(r.prompt) > 4}) > 1
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            loadgen.build_arrivals(loadgen.LoadSpec(rate=0.0))
+        with pytest.raises(ValueError):
+            loadgen.build_arrivals(
+                loadgen.LoadSpec(rate=1.0, process="uniform"))
+        with pytest.raises(ValueError):
+            loadgen.build_arrivals(
+                loadgen.LoadSpec(rate=1.0, shared_prefix_ratio=1.5))
+
+
+# -- scheduler integration (jit-heavy: slow tier) ---------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import TransformerLM
+    return TransformerLM(vocab_size=64, num_layers=2, num_heads=2,
+                         d_model=32, d_ff=64, max_seq_len=32,
+                         compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    import jax
+    import jax.numpy as jnp
+    return model.init(jax.random.PRNGKey(1),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+
+
+@pytest.mark.slow
+class TestSchedulerTracing:
+
+    def test_lifecycle_tiles_latency_and_report_rolls_up(
+            self, model, params, tmp_path):
+        from cloud_tpu.monitoring import collect
+        from cloud_tpu.serving import Scheduler
+
+        path = str(tmp_path / "reqtrace.jsonl")
+        reqtrace.install(path=path)
+        spec = loadgen.LoadSpec(rate=50.0, n_requests=6, seed=0,
+                                shared_prefix_ratio=0.5,
+                                shared_prefix_len=4,
+                                prompt_buckets=((5, 0.5), (9, 0.5)),
+                                max_new_lo=2, max_new_hi=4)
+        with Scheduler(model, params, slots=2, page_size=8,
+                       num_pages=17, admission_window=4) as sched:
+            run = loadgen.run_load(sched, spec, slo_ttft=60.0,
+                                   slo_tpot=60.0)
+        reqtrace.get().flush()
+
+        assert run["completed"] == 6
+        assert run["goodput"] == 1.0  # generous SLO: every request good
+
+        records = events.read_job_events(path, kind="reqtrace")
+        by_rid = {}
+        for record in records:
+            rid = record["payload"]["rid"]
+            if rid is not None:
+                by_rid.setdefault(rid, []).append(
+                    record["payload"]["event"])
+        assert len(by_rid) == 6
+        for rid, names in by_rid.items():
+            assert names[0] == "submitted"
+            assert names[-1] in ("complete", "fail"), rid
+            assert "radix_probe" in names
+            assert "prefill" in names
+            assert "slot_insert" in names
+
+        lifecycles, globals_ = collect.request_lifecycles(
+            {("host", 0): records})
+        report = collect.serve_report(lifecycles, globals_)
+        assert report["requests"]["submitted"] == 6
+        assert report["requests"]["completed"] == 6
+        assert report["requests"]["orphaned"] == 0
+        # The boundary tiling must account for each request's measured
+        # latency: phases telescope submitted->complete exactly, and
+        # the future resolves within ms of the complete event.
+        assert report["accounting_max_residual_s"] < 0.05
+        for row in report["per_request"].values():
+            phase_sum = sum(row["phases_s"].values())
+            assert phase_sum == pytest.approx(row["trace_span_s"],
+                                              abs=1e-6)
+
+    def test_untrace_scheduler_emits_nothing(self, model, params,
+                                             tmp_path, monkeypatch):
+        from cloud_tpu.serving import Scheduler, ServeRequest
+        monkeypatch.chdir(tmp_path)
+        with Scheduler(model, params, slots=2, page_size=8) as sched:
+            sched.submit(ServeRequest(
+                prompt=[3, 5], max_new_tokens=2, temperature=0.0,
+                rng_seed=1), timeout=30).result(timeout=300)
+            assert sched._trace is None
+        assert "reqtrace.jsonl" not in os.listdir(tmp_path)
+
+    def test_warmup_traffic_not_traced(self, model, params, tmp_path):
+        from cloud_tpu.serving import Scheduler, ServeRequest
+        path = str(tmp_path / "reqtrace.jsonl")
+        reqtrace.install(path=path)
+        with Scheduler(model, params, slots=2, page_size=8) as sched:
+            sched.warmup([8], sampling_configs=[(("temperature",
+                                                  0.0),)])
+            sched.submit(ServeRequest(
+                prompt=[3, 5], max_new_tokens=2, temperature=0.0,
+                rng_seed=1), timeout=30).result(timeout=300)
+        reqtrace.get().flush()
+        rids = {r["payload"]["rid"]
+                for r in events.read_job_events(path, kind="reqtrace")}
+        # Exactly the one real request: warmup rode through with
+        # rid=None suppressed, so the CI zero-orphan check stays sharp.
+        assert rids == {"r000000"}

@@ -115,6 +115,31 @@ class TestPagePool:
         assert vec.dtype == np.int32
         np.testing.assert_array_equal(vec, [3, 1, 0, 0])
 
+    def test_reserve_waiters_gauge_tracks_blocked_reserve(self):
+        """graftlens starvation signal: the waiter count is live while
+        a reserve blocks and returns to zero on every exit path."""
+        pool = PagePool(3, 16, 2)
+        assert pool.reserve_waiters() == 0
+        assert pool.pool_stats()["reserve_waiters"] == 0
+        held = pool.reserve(2)
+        seen = []
+        waiter = threading.Thread(
+            target=lambda: pool.reserve(1, timeout=10) and None)
+        waiter.start()
+        for _ in range(100):
+            time.sleep(0.005)
+            count = pool.reserve_waiters()
+            if count:
+                seen.append(count)
+                break
+        assert seen == [1]
+        pool.free(held[:1])
+        waiter.join(timeout=10)
+        assert pool.reserve_waiters() == 0
+        # The timeout path decrements too (no leaked waiter).
+        assert pool.reserve(2, timeout=0.05) is None
+        assert pool.reserve_waiters() == 0
+
 
 # -- scheduler end-to-end (jit-heavy: slow tier) ----------------------
 
@@ -299,3 +324,49 @@ class TestBackpressure:
         sched.submit(req, timeout=1)
         with pytest.raises(queue.Full):
             sched.submit(req, timeout=0.05)
+
+
+class TestSchedulerStats:
+    """stats() is the bench/loadgen readout: it must be total — no
+    traffic, hit-only traffic, and miss-only traffic all snapshot
+    cleanly (empty histograms read count 0, never raise)."""
+
+    def test_zero_request_snapshot(self, model, params):
+        from cloud_tpu.serving import Scheduler
+        sched = Scheduler(model, params, slots=2)  # never started
+        stats = sched.stats()
+        assert stats["requests_completed"] == 0
+        assert stats["prefix_hit_rate"] == 0.0
+        assert stats["spec_accept_rate"] == 0.0
+        for key in ("ttft", "ttft_hit", "ttft_miss", "token_latency",
+                    "queue_wait", "reserve_wait"):
+            assert stats[key]["count"] == 0
+        assert stats["pool"]["reserve_waiters"] == 0
+
+    def test_hit_only_traffic(self, model, params):
+        from cloud_tpu.serving import Scheduler
+        sched = Scheduler(model, params, slots=2)
+        sched._record_ttft(0.01, hit=True)
+        sched._record_ttft(0.03, hit=True)
+        stats = sched.stats()
+        assert stats["prefix_hit_rate"] == 1.0
+        assert stats["ttft_hit"]["count"] == 2
+        assert stats["ttft_miss"]["count"] == 0
+        assert stats["ttft"]["count"] == 2
+
+    def test_miss_only_traffic(self, model, params):
+        from cloud_tpu.serving import Scheduler
+        sched = Scheduler(model, params, slots=2)
+        sched._record_ttft(0.02, hit=False)
+        stats = sched.stats()
+        assert stats["prefix_hit_rate"] == 0.0
+        assert stats["ttft_hit"]["count"] == 0
+        assert stats["ttft_miss"]["count"] == 1
+
+    def test_partial_wait_histograms(self, model, params):
+        from cloud_tpu.serving import Scheduler
+        sched = Scheduler(model, params, slots=2)
+        sched._queue_wait_hist.observe(0.004)
+        stats = sched.stats()
+        assert stats["queue_wait"]["count"] == 1
+        assert stats["reserve_wait"]["count"] == 0
