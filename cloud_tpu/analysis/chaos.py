@@ -28,11 +28,32 @@ Kinds:
                        the digest check (or orbax itself) must catch
                        as `CheckpointCorrupt` on restore.
 
+Serving kinds (graftstorm) reuse the same grammar with the step index
+meaning the graftserve ENGINE TICK (post-warmup; the scheduler resets
+its tick counter after warmup). They are consumed by the Scheduler's
+tick loop via `pre_tick(tick)` — never by the training `pre_dispatch`
+hook — and each describes WHAT breaks; serving/scheduler.py owns the
+recovery:
+
+  `slot_hang@T`           The lowest-index active slot at tick T stops
+                          making progress; it drains via the evict
+                          scatter and its request requeues.
+  `prefill_fail@T`        The next prefill attempted at tick >= T
+                          raises `serving.PrefillFailed` once; pages
+                          release and the prefill retries.
+  `slot_evict@T:S`        Slot S's pages are reclaimed at tick T (arg
+                          = slot index); its request requeues.
+  `pool_squeeze@T:P`      Up to P free KV pages are confiscated at
+                          tick T (arg = page count) and returned after
+                          a hold window — admission backpressure must
+                          absorb the shrunken pool.
+
 Example: `CLOUD_TPU_CHAOS="hang@12:30,corrupt@9"` hangs the host 30 s
 before step 12 and tears the first checkpoint written at step >= 9 —
-the chaos-smoke CI scenario. Fired events emit "graftchaos" JSONL job
-events (CLOUD_TPU_EVENT_LOG) so post-hoc assertions can line injected
-faults up against graftguard's responses.
+the chaos-smoke CI scenario; `"slot_hang@6,pool_squeeze@10:8"` is its
+serving twin. Fired events emit "graftchaos" JSONL job events
+(CLOUD_TPU_EVENT_LOG) so post-hoc assertions can line injected faults
+up against graftguard's/graftstorm's responses.
 """
 
 import logging
@@ -43,7 +64,12 @@ from cloud_tpu.training import resilience
 
 logger = logging.getLogger("cloud_tpu")
 
-KINDS = ("hang", "preempt", "fetch", "nan", "corrupt")
+#: Serving-scoped kinds: tick-indexed, consumed by Scheduler.pre_tick,
+#: invisible to the training pre_dispatch hook.
+SERVE_KINDS = ("slot_hang", "prefill_fail", "slot_evict",
+               "pool_squeeze")
+
+KINDS = ("hang", "preempt", "fetch", "nan", "corrupt") + SERVE_KINDS
 
 #: Default hang duration, seconds — long enough that any sane
 #: graftwatch deadline fires first.
@@ -134,10 +160,32 @@ class ChaosPlan:
             return
         due = [e for e in self.events
                if not e.fired and e.kind != "corrupt"
+               and e.kind not in SERVE_KINDS
                and step <= e.step < step + n_steps]
         for event in sorted(due, key=lambda e: e.step):
             event.fired = True
             self._fire(event)
+
+    def pre_tick(self, tick):
+        """Fires serving events whose configured tick has arrived
+        (tick >= e.step — a tick loop that idles between requests must
+        not skip past an injection) and RETURNS them: chaos describes
+        the fault, the Scheduler owns the recovery, so serving kinds
+        are handed back instead of raised here. One-shot like
+        everything else in the plan."""
+        if tick is None:
+            return []
+        due = [e for e in self.events
+               if not e.fired and e.kind in SERVE_KINDS
+               and tick >= e.step]
+        due.sort(key=lambda e: e.step)
+        for event in due:
+            event.fired = True
+            _log_event(event, extra={"tick": int(tick)})
+            logger.warning(
+                "graftchaos: injected %s at serve tick %d.",
+                event.kind, tick)
+        return due
 
     def _fire(self, event):
         _log_event(event)

@@ -417,8 +417,10 @@ def _summarize_request(events):
         summary["max_new"] = submitted.get("max_new")
     complete = first.get("complete")
     fail = first.get("fail")
+    shed = first.get("shed")
     summary["terminal"] = ("complete" if complete is not None
-                           else "fail" if fail is not None else None)
+                           else "fail" if fail is not None
+                           else "shed" if shed is not None else None)
     prefill = first.get("prefill")
     probe = first.get("radix_probe")
     prefix_len = None
@@ -455,6 +457,22 @@ def _summarize_request(events):
                                   - summary["ttft_s"]) / (tokens - 1))
     if fail is not None:
         summary["error"] = fail.get("error")
+    if shed is not None:
+        summary["shed_reason"] = shed.get("reason")
+        summary["predicted_ttft_s"] = shed.get("predicted_ttft")
+    # graftstorm chaos census: a requeued rid emits slot_fault/requeue
+    # mid-lifecycle and then terminates normally — never an orphan.
+    faults = {}
+    for event in events:
+        if event["event"] == "slot_fault":
+            kind = event.get("kind") or "unknown"
+            faults[kind] = faults.get(kind, 0) + 1
+    requeues = sum(1 for e in events if e["event"] == "requeue")
+    if faults:
+        summary["faults"] = faults
+    if requeues:
+        summary["requeues"] = requeues
+    summary["chaos"] = bool(faults or requeues)
     present = [(name, first[name]["_monotonic"])
                for name in _BOUNDARIES if name in first]
     phases = {}
@@ -489,6 +507,7 @@ def serve_report(lifecycles, globals_=(), slo_ttft=None, slo_tpot=None):
     rows = list(requests.values())
     completed = [r for r in rows if r["terminal"] == "complete"]
     failed = [r for r in rows if r["terminal"] == "fail"]
+    shed_rows = [r for r in rows if r["terminal"] == "shed"]
     orphans = sorted(key for key, r in requests.items()
                      if r["terminal"] is None)
 
@@ -538,6 +557,7 @@ def serve_report(lifecycles, globals_=(), slo_ttft=None, slo_tpot=None):
             "submitted": len(rows),
             "completed": len(completed),
             "failed": len(failed),
+            "shed": len(shed_rows),
             "orphaned": len(orphans),
             "orphans": orphans,
         },
@@ -576,6 +596,29 @@ def serve_report(lifecycles, globals_=(), slo_ttft=None, slo_tpot=None):
         "prefix_evict_pages": sum(e.get("pages", 0) for e in globals_
                                   if e["event"] == "prefix_evict"),
         "per_request": requests,
+    }
+    # graftstorm: fault/requeue/shed census + goodput-under-chaos. A
+    # chaos row saw >= 1 slot_fault or requeue; its goodput shows the
+    # recovery-path tax relative to untouched (clean) requests.
+    chaos_rows = [r for r in rows if r.get("chaos")]
+    clean_rows = [r for r in rows if not r.get("chaos")]
+    fault_census = {}
+    for row in rows:
+        for kind, count in row.get("faults", {}).items():
+            fault_census[kind] = fault_census.get(kind, 0) + count
+    shed_census = {}
+    for row in shed_rows:
+        reason = row.get("shed_reason") or "unknown"
+        shed_census[reason] = shed_census.get(reason, 0) + 1
+    report["chaos"] = {
+        "faults": fault_census,
+        "requeues": sum(r.get("requeues", 0) for r in rows),
+        "shed_by_reason": shed_census,
+        "requests_touched": len(chaos_rows),
+        "goodput": {
+            "chaos": _goodput(chaos_rows, len(chaos_rows)),
+            "clean": _goodput(clean_rows, len(clean_rows)),
+        },
     }
     return report
 

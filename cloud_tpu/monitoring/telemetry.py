@@ -105,6 +105,19 @@ SERVE_COW_COPIES = "cloud_tpu_serve_cow_copies_total"
 #: per-slot speculation (models/speculative.py observe_accept_rate).
 SERVE_SPEC_ACCEPT_HISTOGRAM = "cloud_tpu_serve_spec_accepted_rate"
 
+#: graftstorm (serving chaos) names. Fault/requeue/shed counters label
+#: by taxonomy kind / shed reason via the `%s` suffix (the single-
+#: registry renderer has no label support — the KERNEL gauge idiom).
+#: The predicted-TTFT gauge is the admission controller's latest
+#: estimate: what the NEXT admitted request is expected to wait.
+SERVE_FAULTS_TOTAL = "cloud_tpu_serve_faults_total_%s"
+SERVE_REQUEUES_TOTAL = "cloud_tpu_serve_requeues_total"
+SERVE_SHED_TOTAL = "cloud_tpu_serve_shed_total_%s"
+SERVE_PREDICTED_TTFT = "cloud_tpu_serve_predicted_ttft"
+#: Always-on host prefill-latency histogram: the predicted-TTFT model
+#: needs a live prefill estimate even when telemetry export is off.
+SERVE_PREFILL_HISTOGRAM = "cloud_tpu_serve_prefill_seconds"
+
 #: Per-kernel cost rows (ops/ Pallas kernels: "paged_attention",
 #: "fused_norm"). Fed by `Telemetry.record_kernel_cost` from the jit
 #: cost-analysis hook (the PR 6 MFU idiom, per-kernel): the serving

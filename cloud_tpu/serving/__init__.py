@@ -9,10 +9,16 @@ See cloud_tpu/serving/README.md for the architecture. Public surface:
 - `RequestTracer` — per-request lifecycle JSONL tracing behind
   `CLOUD_TPU_REQTRACE` (reqtrace.py)
 - `LoadSpec` — open-arrival load generation (loadgen.py)
+- `ServeFault` taxonomy (`SlotHang`, `SlotEvicted`, `PrefillFailed`,
+  `PoolSqueezed`, `ServeShed`) — typed serving faults for graftstorm
+  chaos recovery and SLO-aware admission (faults.py)
 """
 
 from cloud_tpu.serving.engine import (DecodeEngine, PrefillResult,
                                       RetraceError)
+from cloud_tpu.serving.faults import (PoolSqueezed, PrefillFailed,
+                                      ServeFault, ServeShed,
+                                      SlotEvicted, SlotHang)
 from cloud_tpu.serving.kvpool import PagePool
 from cloud_tpu.serving.loadgen import LoadSpec
 from cloud_tpu.serving.reqtrace import RequestTracer
@@ -23,10 +29,16 @@ __all__ = [
     "DecodeEngine",
     "LoadSpec",
     "PagePool",
+    "PoolSqueezed",
+    "PrefillFailed",
     "PrefillResult",
     "RequestTracer",
     "RetraceError",
     "Scheduler",
+    "ServeFault",
     "ServeRequest",
     "ServeResult",
+    "ServeShed",
+    "SlotEvicted",
+    "SlotHang",
 ]

@@ -298,7 +298,7 @@ class DecodeEngine:
     # -- prefill ------------------------------------------------------
 
     def prefill(self, prompt, max_new_tokens, rng, sampling,
-                prefix_len=0, gather_vec=None):
+                prefix_len=0, gather_vec=None, key_override=None):
         """Canonical right-pad prefill for one request. `sampling` is a
         normalized dict: temperature (float), top_k (int|None), top_p
         (float|None), eos_token (int|None).
@@ -309,6 +309,16 @@ class DecodeEngine:
         cached positions, and the model runs over the suffix only.
         The rng schedule is unchanged — prefix reuse never moves a
         sample draw, which is the bit-identity contract.
+
+        `key_override=(prefill_key, step_keys_rest)` is the graftstorm
+        requeue hook: instead of deriving the schedule by splitting
+        `rng`, the prefill samples with the exact uint32[2] key the
+        faulted run would have used for this position and arms the
+        remaining original schedule (shifted so the continuation's
+        first tick reads row 0). That re-bases a request interrupted
+        after n tokens onto keys n, n+1, ... of its original split —
+        the per-slot graftguard resume discipline, so the continuation
+        completes bit-identical to the uninterrupted decode.
 
         Returns a `PrefillResult`; blocks until the first token is on
         host (the TTFT point)."""
@@ -335,7 +345,14 @@ class DecodeEngine:
         tokens[0, :n_suffix] = prompt[prefix_len:]
         mask = np.zeros((1, bucket), bool)
         mask[0, :n_suffix] = True
-        key, prefill_rng = jax.random.split(rng)
+        if key_override is None:
+            key, prefill_rng = jax.random.split(rng)
+        else:
+            # Same aval as a split key row (uint32[2], the legacy raw
+            # key layout categorical accepts), so the override path
+            # reuses the warmed prefill executable — no retrace.
+            prefill_rng = jnp.asarray(key_override[0], jnp.uint32)
+            key = None
 
         cache = _plain(acquire_cache(self._dense, 1))
         gvec = None
@@ -359,7 +376,12 @@ class DecodeEngine:
                 self._draft_params, dcache, jnp.asarray(tokens),
                 jnp.asarray(mask))
         step_keys = np.zeros((self.max_new_cap - 1, 2), np.uint32)
-        if max_new_tokens > 1:
+        if key_override is not None:
+            rest = np.asarray(key_override[1], np.uint32).reshape(-1, 2)
+            if max_new_tokens > 1:
+                step_keys[:max_new_tokens - 1] = \
+                    rest[:max_new_tokens - 1]
+        elif max_new_tokens > 1:
             step_keys[:max_new_tokens - 1] = np.asarray(
                 jax.random.split(key, max_new_tokens - 1))
         first_host = int(runtime.device_fetch(first)[0])

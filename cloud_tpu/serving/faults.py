@@ -1,0 +1,86 @@
+"""graftstorm fault taxonomy: typed serving faults, parallel to
+training/resilience.py's TrainingFault.
+
+graftguard answers training faults by KIND (rescue checkpoint for a
+Preemption, quarantine+rollback for corruption, …); the serving stack
+needs the same discipline per slot. Every chaos injection or runtime
+failure that hits an in-flight request is surfaced as one of these
+types so the Scheduler can answer mechanically:
+
+  SlotHang / SlotEvicted / PoolSqueezed -> drain the victim slot via
+      the fixed-shape evict scatter, free its pages exactly once, and
+      REQUEUE the request: re-prefill from retained prompt + tokens
+      generated so far with the original per-slot rng schedule
+      re-based, completing bit-identical to an uninterrupted run.
+  PrefillFailed -> transient; release any reserved pages and retry the
+      prefill (the request never entered a slot, nothing to drain).
+  ServeShed -> terminal by POLICY, not failure: SLO-aware admission
+      predicted the request cannot meet its TTFT target and refused
+      it. Carries the prediction so callers/loadgen can report shed
+      separately from genuine failures.
+
+`fault_kind(exc)` mirrors resilience.fault_kind: a stable string for
+telemetry labels and reqtrace payloads.
+"""
+
+__all__ = ["ServeFault", "SlotHang", "SlotEvicted", "PrefillFailed",
+           "PoolSqueezed", "ServeShed", "fault_kind"]
+
+
+class ServeFault(RuntimeError):
+    """Base class for typed serving faults (taxonomy root)."""
+
+    fault_kind = "serve_fault"
+
+
+class SlotHang(ServeFault):
+    """A decode slot stopped making progress (wedged dispatch, chaos
+    `slot_hang@tick`); the slot drains and its request requeues."""
+
+    fault_kind = "slot_hang"
+
+
+class SlotEvicted(ServeFault):
+    """A slot's pages were reclaimed out from under it (preempted
+    hardware, chaos `slot_evict@tick:slot`); the request requeues."""
+
+    fault_kind = "slot_evict"
+
+
+class PrefillFailed(ServeFault):
+    """A prefill dispatch failed transiently (chaos
+    `prefill_fail@tick`); reserved pages are released and the prefill
+    retries — the request stays queued, never lost."""
+
+    fault_kind = "prefill_fail"
+
+
+class PoolSqueezed(ServeFault):
+    """Free KV pages were confiscated (chaos `pool_squeeze@tick:pages`
+    — a neighbor claiming HBM); admission backpressure absorbs it, and
+    any slot drained to cover the squeeze requeues."""
+
+    fault_kind = "pool_squeeze"
+
+
+class ServeShed(ServeFault):
+    """Admission control refused the request: predicted TTFT exceeds
+    the SLO target. Not a malfunction — the policy outcome callers
+    asked for with CLOUD_TPU_SERVE_SLO_TTFT + CLOUD_TPU_SERVE_SHED."""
+
+    fault_kind = "shed"
+
+    def __init__(self, message, reason="predicted", predicted_ttft=None,
+                 slo_ttft=None):
+        super().__init__(message)
+        self.reason = reason
+        self.predicted_ttft = predicted_ttft
+        self.slo_ttft = slo_ttft
+
+
+def fault_kind(exc):
+    """Stable taxonomy label for an exception: the ServeFault kind, or
+    "unknown" for anything outside the taxonomy."""
+    if isinstance(exc, ServeFault):
+        return type(exc).fault_kind
+    return "unknown"

@@ -169,6 +169,21 @@ class PagePool:
         with self._cond:
             return self._reserve_waiters
 
+    def squeeze(self, n):
+        """Confiscates up to `n` FREE pages immediately (no blocking, a
+        partial take is fine) — the chaos `pool_squeeze` primitive: a
+        noisy neighbor claiming HBM that admission backpressure must
+        absorb. The taken pages are ordinary refcount-1 allocations, so
+        returning them is a plain free() and the leak detector treats a
+        squeeze holder like any other."""
+        n = int(n)
+        with self._cond:
+            take = min(n, len(self._free))
+            pages = [self._free.pop() for _ in range(take)]
+            for pid in pages:
+                self._refs[pid] = 1
+            return pages
+
     def note_cow(self, n=1):
         """Counts a copy-on-write page reconstruction (telemetry)."""
         with self._cond:

@@ -36,6 +36,8 @@ import time
 
 import numpy as np
 
+from cloud_tpu.serving.faults import fault_kind
+
 
 @dataclasses.dataclass
 class LoadSpec:
@@ -141,7 +143,8 @@ def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
     """Drives one open-arrival run against a started, warmed Scheduler.
 
     Returns the run report dict (format cloud_tpu.loadgen.v1): offered /
-    completed / rejected / failed counts, offered vs. achieved rps,
+    completed / rejected / failed / shed counts (shed = refused by the
+    SLO admission gate, a typed ServeShed), offered vs. achieved rps,
     TTFT / TPOT / latency percentiles, goodput against the SLOs, and a
     per-request row list (the collector's cross-check against the
     reqtrace waterfall).
@@ -165,7 +168,7 @@ def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
         inflight.append((request, t_sub, future))
 
     rows = []
-    completed = rejected = failed = 0
+    completed = rejected = failed = shed = 0
     t_last_done = t0
     for request, t_sub, future in inflight:
         row = {
@@ -181,8 +184,13 @@ def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
         try:
             result = future.result(timeout=result_timeout)
         except BaseException as exc:  # noqa: BLE001
-            failed += 1
-            row["status"] = "failed"
+            if fault_kind(exc) == "shed":
+                shed += 1
+                row["status"] = "shed"
+                row["reason"] = getattr(exc, "reason", None)
+            else:
+                failed += 1
+                row["status"] = "failed"
             row["error"] = "{}: {}".format(type(exc).__name__,
                                            str(exc)[:200])
             rows.append(row)
@@ -224,6 +232,7 @@ def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
         "completed": completed,
         "rejected": rejected,
         "failed": failed,
+        "shed": shed,
         "offered_rps": len(rows) / offered_span,
         "achieved_rps": completed / wall,
         "duration_s": wall,

@@ -5,7 +5,16 @@ Every request admitted to the Scheduler gets a process-unique request id
 lifecycle as typed events::
 
     submitted -> queued -> radix_probe -> pages_reserved -> prefill
-              -> slot_insert -> tick_commit* -> complete | fail
+              -> slot_insert -> tick_commit* -> complete | fail | shed
+
+graftstorm (serving chaos) adds mid-lifecycle fault events: a chaos
+injection that hits an in-flight request emits ``slot_fault`` (with the
+taxonomy ``kind`` and the victim slot) followed by ``requeue`` (with
+``tokens_done``, the retained progress) — the request then re-enters at
+``pages_reserved``/``prefill`` and still terminates normally, so a
+requeued rid is NOT an orphan. ``shed`` (with ``reason`` and
+``predicted_ttft``) is the SLO-admission terminal: refused by policy,
+never prefilled.
 
 Events are buffered in-process and flushed as ``reqtrace`` JSONL records
 whose envelope matches ``cloud_tpu.utils.events`` job-event records
@@ -122,7 +131,7 @@ class RequestTracer:
             "payload": payload,
         }
         line = json.dumps(record, sort_keys=True) + "\n"
-        terminal = event in ("complete", "fail")
+        terminal = event in ("complete", "fail", "shed")
         with self._lock:
             self._buffer.append(line)
             self._emitted += 1

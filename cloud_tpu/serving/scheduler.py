@@ -50,10 +50,29 @@ no file, no threads — the PR 6 zero-hooks discipline, test-pinned.
 Queue-wait and page-reservation-wait histograms are host-side and
 always on (warm-reset like TTFT), feeding `stats()` and ROADMAP item
 4's predicted-TTFT admission.
+
+Fault handling (graftstorm): chaos serving injections (analysis/
+chaos.py SERVE_KINDS, tick-indexed) are consumed at the top of every
+tick iteration. A faulted slot drains through the same fixed-shape
+evict scatter finished slots use — the persistent tick never stops —
+its pages return to the pool exactly once (prefix-trie references
+survive untouched), and its request re-enters the tick thread's ready
+deque as a typed requeue: re-prefill from retained prompt + tokens
+generated so far, with the slot's ORIGINAL rng schedule re-based via
+the engine's `key_override` so the continuation completes bit-identical
+to an uninterrupted decode (graftguard's resume discipline, per slot).
+A `prefill_fail` releases any reserved pages and retries — transient,
+never lost. SLO-aware admission: with `CLOUD_TPU_SERVE_SLO_TTFT` set
+(or the `slo_ttft` ctor arg), the admission thread predicts each
+candidate's TTFT from the live queue-wait/prefill histograms plus pool
+occupancy, and sheds (typed `ServeShed`) or defers
+(`CLOUD_TPU_SERVE_SHED=defer`) work it cannot serve within SLO instead
+of plain-FCFS admitting it.
 """
 
 import collections
 import dataclasses
+import os
 import queue
 import threading
 import time
@@ -67,8 +86,20 @@ from cloud_tpu.monitoring import spans
 from cloud_tpu.parallel import runtime
 from cloud_tpu.serving import reqtrace
 from cloud_tpu.serving.engine import DecodeEngine
+from cloud_tpu.serving.faults import (PoolSqueezed, PrefillFailed,
+                                      ServeShed, SlotEvicted, SlotHang,
+                                      fault_kind)
 from cloud_tpu.serving.kvpool import PagePool
 from cloud_tpu.serving.prefixcache import PrefixCache
+
+#: pool_squeeze hold window: confiscated pages return after this many
+#: ticks OR this much wall time, whichever first — the wall-clock bound
+#: keeps a squeeze from deadlocking a pool so starved that no slot is
+#: active and ticks stop advancing.
+SQUEEZE_HOLD_TICKS = 8
+SQUEEZE_HOLD_S = 2.0
+
+_OFF_VALUES = ("", "0", "off", "false", "none")
 
 
 @dataclasses.dataclass
@@ -99,7 +130,8 @@ class ServeResult:
 
 class _Slot:
     __slots__ = ("request", "pages", "emitted", "future", "t_submit",
-                 "ttft_s", "prefix_len", "rid", "trace_ticks")
+                 "ttft_s", "prefix_len", "rid", "trace_ticks",
+                 "step_keys", "result_prefix_len")
 
     def __init__(self, request, pages, future, t_submit, ttft_s,
                  prefix_len, rid=None):
@@ -112,6 +144,15 @@ class _Slot:
         self.prefix_len = prefix_len
         self.rid = rid
         self.trace_ticks = 0  # ticks since the last tick_commit event
+        # Retained per-slot rng schedule (the PrefillResult's host
+        # uint32[max_new_cap-1, 2] array): a fault after n emitted
+        # tokens re-bases the continuation onto rows n-1 (its prefill
+        # key) and n.. (its tick schedule) — graftstorm bit-identity.
+        self.step_keys = None
+        # prefix_len the final ServeResult reports: survives requeue
+        # (the continuation cold-prefills, but the REQUEST's cache-hit
+        # status is a property of its original admission).
+        self.result_prefix_len = prefix_len
 
 
 class _ReadyItem:
@@ -148,6 +189,28 @@ class _HitTicket:
         self.t_reserve0 = None
 
 
+class _RequeueItem:
+    """A faulted request re-entering the tick thread's ready deque
+    (graftstorm). `request` is the CONTINUATION: original prompt +
+    tokens generated so far, max_new reduced by the same count — so
+    prompt + emitted at completion reassembles the original row.
+    `key`/`rest` are the original schedule rows the continuation's
+    prefill and ticks must consume (engine.prefill key_override)."""
+    __slots__ = ("request", "key", "rest", "future", "t_submit",
+                 "ttft_s", "result_prefix_len", "rid")
+
+    def __init__(self, request, key, rest, future, t_submit, ttft_s,
+                 result_prefix_len, rid=None):
+        self.request = request
+        self.key = key
+        self.rest = rest
+        self.future = future
+        self.t_submit = t_submit
+        self.ttft_s = ttft_s
+        self.result_prefix_len = result_prefix_len
+        self.rid = rid
+
+
 def _registry():
     """graftscope registry when telemetry is enabled, else None — the
     decode hooks' zero-cost-when-off discipline."""
@@ -169,7 +232,8 @@ class Scheduler:
                  num_pages=None, max_new_cap=None, max_queue=64,
                  admission_window=8, strict_no_retrace=False,
                  prefix_cache=True, prefix_cache_pages=None,
-                 draft_model=None, draft_params=None, spec_k=0):
+                 draft_model=None, draft_params=None, spec_k=0,
+                 slo_ttft=None, shed_policy=None):
         if num_pages is None:
             # Default: every slot can hold a full-length sequence, plus
             # scratch — paging then bounds fragmentation, not memory.
@@ -219,11 +283,37 @@ class Scheduler:
         self._token_hist = Histogram("token_latency")
         self._queue_wait_hist = Histogram("queue_wait")
         self._reserve_wait_hist = Histogram("reserve_wait")
+        # Host prefill-latency histogram: always on (like queue wait),
+        # because the predicted-TTFT admission model samples its p50
+        # even when telemetry export is off.
+        self._prefill_hist = Histogram("prefill")
         # graftlens request tracer; installed at start() when
         # CLOUD_TPU_REQTRACE asks for it, else stays None and every
         # rid in the pipeline stays None (zero events, zero file).
         self._trace = None
         self._trace_suppress = False  # warmup traffic is not traced
+        # -- graftstorm: SLO-aware admission + chaos state ------------
+        if slo_ttft is None:
+            env = os.environ.get("CLOUD_TPU_SERVE_SLO_TTFT", "").strip()
+            slo_ttft = float(env) if env else None
+        self._slo_ttft = slo_ttft
+        if shed_policy is None:
+            shed_policy = os.environ.get("CLOUD_TPU_SERVE_SHED", "shed")
+        shed_policy = str(shed_policy).strip().lower()
+        if shed_policy in _OFF_VALUES:
+            shed_policy = "off"
+        elif shed_policy != "defer":
+            shed_policy = "shed"
+        self._shed_policy = shed_policy
+        self._defer_max = 2
+        self._fault_counts = {}
+        self._requeues = 0
+        self._shed_counts = {}
+        self._last_predicted_ttft = None
+        self._chaos_lock = threading.Lock()
+        self._prefill_fail_armed = 0
+        # Squeezed page holds: (pages, release_tick, release_deadline).
+        self._squeezed = []
 
     # -- lifecycle ----------------------------------------------------
 
@@ -252,6 +342,7 @@ class Scheduler:
         self._wake.set()
         self._prefill_thread.join(timeout=30)
         self._tick_thread.join(timeout=30)
+        self._release_squeezes(force=True)
         error = self._failure or RuntimeError("scheduler closed")
         self._fail_pending(error)
         if self._trace is not None:
@@ -292,8 +383,8 @@ class Scheduler:
         if request.max_new_tokens > 1:
             self._pending_inserts += 1
         try:
-            self._admit_q.put((request, future, t_submit, rid),
-                              timeout=timeout)
+            self._admit_q.put((request, future, t_submit, rid,
+                               {"defers": 0}), timeout=timeout)
         except queue.Full:
             if request.max_new_tokens > 1:
                 self._pending_inserts -= 1
@@ -392,9 +483,25 @@ class Scheduler:
             # tail latency.
             window.sort(key=lambda item: (-self._probe(item[0]),
                                           -self._bucket(item[0])))
-            for request, future, t_submit, rid in window:
+            admitted = 0
+            for request, future, t_submit, rid, meta in window:
                 if self._stop.is_set():
                     return
+                verdict, reason, predicted = self._admission_decision(
+                    request, t_submit, admitted, meta)
+                if verdict == "defer":
+                    meta["defers"] += 1
+                    try:
+                        self._admit_q.put_nowait(
+                            (request, future, t_submit, rid, meta))
+                        self._observe_queue()
+                        continue
+                    except queue.Full:
+                        verdict, reason = "shed", "queue_full"
+                if verdict == "shed":
+                    self._shed(request, future, rid, reason, predicted)
+                    continue
+                admitted += 1
                 try:
                     self._admit_one(request, future, t_submit, rid)
                 except BaseException as exc:  # noqa: BLE001
@@ -420,7 +527,7 @@ class Scheduler:
         now = time.monotonic()
         reg = _registry()
         trace = self._trace
-        for _, _, t_submit, rid in window:
+        for _, _, t_submit, rid, _ in window:
             wait = max(now - t_submit, 0.0)
             self._queue_wait_hist.observe(wait)
             if reg is not None:
@@ -441,6 +548,75 @@ class Scheduler:
             self.trie.evict(need)
         return pages
 
+    # -- SLO-aware admission (graftstorm) -----------------------------
+
+    def _predict_ttft(self, request, t_submit, position, now=None):
+        """TTFT estimate for a candidate at admission time: queue wait
+        already accrued + serialization behind the `position` requests
+        admitted ahead of it this window + its own prefill (live p50 of
+        the always-on host histogram) + expected page-reservation wait
+        (reserve-wait p95) when the pool cannot satisfy it right now.
+        All inputs are live histograms, so the estimate tracks the
+        current regime instead of a configured constant."""
+        now = time.monotonic() if now is None else now
+        accrued = max(now - t_submit, 0.0)
+        prefill_p50 = self._prefill_hist.percentile(50)
+        predicted = accrued + (position + 1) * prefill_p50
+        if request.max_new_tokens > 1:
+            need = self.pool.pages_needed(len(request.prompt),
+                                          request.max_new_tokens,
+                                          slack=self._spec_slack())
+            if self.pool.available() < need:
+                predicted += self._reserve_wait_hist.percentile(95)
+        return predicted
+
+    def _admission_decision(self, request, t_submit, position, meta,
+                            now=None):
+        """(verdict, reason, predicted_ttft) for one candidate:
+        "admit" when the SLO policy is off or the prediction fits,
+        "defer" (policy=defer, bounded retries, SLO not yet blown) to
+        re-queue behind fresh arrivals, else "shed"."""
+        if (self._slo_ttft is None or self._shed_policy == "off"
+                or self._trace_suppress):
+            return ("admit", None, None)
+        now = time.monotonic() if now is None else now
+        predicted = self._predict_ttft(request, t_submit, position,
+                                       now=now)
+        self._last_predicted_ttft = predicted
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.gauge(telemetry.SERVE_PREDICTED_TTFT).set(predicted)
+        if predicted <= self._slo_ttft:
+            return ("admit", None, predicted)
+        accrued = now - t_submit
+        if accrued > self._slo_ttft:
+            return ("shed", "expired", predicted)
+        if (self._shed_policy == "defer"
+                and meta.get("defers", 0) < self._defer_max):
+            return ("defer", "predicted", predicted)
+        reason = "deferred" if meta.get("defers", 0) else "predicted"
+        return ("shed", reason, predicted)
+
+    def _shed(self, request, future, rid, reason, predicted):
+        """Refuses one candidate by policy: typed ServeShed to the
+        caller, `shed` terminal trace event, census counters."""
+        if request.max_new_tokens > 1:
+            self._pending_inserts -= 1
+        self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.counter(telemetry.SERVE_SHED_TOTAL % reason).inc()
+        self._trace_emit(rid, "shed", reason=reason,
+                         predicted_ttft=predicted)
+        future.set_exception(ServeShed(
+            "admission shed ({}): predicted TTFT {:.3f}s > SLO {:.3f}s"
+            .format(reason, -1.0 if predicted is None else predicted,
+                    self._slo_ttft),
+            reason=reason, predicted_ttft=predicted,
+            slo_ttft=self._slo_ttft))
+
     def _admit_one(self, request, future, t_submit, rid=None):
         sampling = self._sampling(request)
         matched = self._probe(request)
@@ -456,39 +632,52 @@ class Scheduler:
                                               rid=rid))
             self._wake.set()
             return
-        pages = []
-        if request.max_new_tokens > 1:
-            need = self.pool.pages_needed(len(request.prompt),
-                                          request.max_new_tokens,
-                                          slack=self._spec_slack())
-            pages = None
-            t_reserve0 = time.monotonic()
-            while not self._stop.is_set():
-                pages = self._reserve_with_pressure(need, timeout=0.2)
-                if pages is not None:
-                    break
-            if pages is None:  # shutdown while blocked on the pool
-                self._pending_inserts -= 1
-                error = RuntimeError("scheduler closed")
-                self._trace_fail(rid, error)
-                future.set_exception(error)
-                return
-            wait = time.monotonic() - t_reserve0
-            self._observe_reserve_wait(wait)
-            self._trace_emit(rid, "pages_reserved", pages=len(pages),
-                             wait_s=wait)
-        t_prefill0 = time.monotonic()
-        try:
-            result = self.engine.prefill(
-                np.asarray(request.prompt, np.int32),
-                request.max_new_tokens,
-                jax.random.PRNGKey(request.rng_seed), sampling)
-        except BaseException:
-            if pages:
-                self.pool.free(pages)
-            raise
+        while True:
+            # Re-entered on a transient PrefillFailed: the reservation
+            # is released and retaken, so the retry re-queues behind
+            # live backpressure instead of squatting on pages.
+            pages = []
+            if request.max_new_tokens > 1:
+                need = self.pool.pages_needed(len(request.prompt),
+                                              request.max_new_tokens,
+                                              slack=self._spec_slack())
+                pages = None
+                t_reserve0 = time.monotonic()
+                while not self._stop.is_set():
+                    pages = self._reserve_with_pressure(need,
+                                                        timeout=0.2)
+                    if pages is not None:
+                        break
+                if pages is None:  # shutdown while blocked on the pool
+                    self._pending_inserts -= 1
+                    error = RuntimeError("scheduler closed")
+                    self._trace_fail(rid, error)
+                    future.set_exception(error)
+                    return
+                wait = time.monotonic() - t_reserve0
+                self._observe_reserve_wait(wait)
+                self._trace_emit(rid, "pages_reserved",
+                                 pages=len(pages), wait_s=wait)
+            t_prefill0 = time.monotonic()
+            try:
+                result = self._engine_prefill(
+                    np.asarray(request.prompt, np.int32),
+                    request.max_new_tokens,
+                    jax.random.PRNGKey(request.rng_seed), sampling)
+            except PrefillFailed as exc:
+                if pages:
+                    self.pool.free(pages)
+                self._note_fault(exc, rid=rid, slot=None)
+                self._note_requeue(rid, tokens_done=0)
+                continue
+            except BaseException:
+                if pages:
+                    self.pool.free(pages)
+                raise
+            break
         ttft = time.monotonic() - t_submit
         self._record_ttft(ttft, hit=False)
+        self._observe_prefill(time.monotonic() - t_prefill0)
         self._trace_emit(rid, "prefill", bucket=int(result.bucket),
                          prefix_len=0,
                          dur_s=time.monotonic() - t_prefill0)
@@ -523,6 +712,166 @@ class Scheduler:
             reg.gauge(telemetry.SERVE_PREFIX_HIT_RATE).set(
                 self._hits / total if total else 0.0)
 
+    # -- graftstorm: chaos + slot fault recovery ----------------------
+
+    def _engine_prefill(self, *args, **kwargs):
+        """Every prefill dispatch funnels here so an armed chaos
+        `prefill_fail` hits whichever thread prefills next (admission
+        thread for misses, tick thread for hits/requeues)."""
+        with self._chaos_lock:
+            armed = self._prefill_fail_armed > 0
+            if armed:
+                self._prefill_fail_armed -= 1
+        if armed:
+            raise PrefillFailed("graftchaos: injected prefill_fail")
+        return self.engine.prefill(*args, **kwargs)
+
+    def _chaos_pre_tick(self):
+        """Tick-loop chaos hook: returns squeezed pages whose hold
+        expired, then consumes due serving injections. Warm-up traffic
+        is exempt (the tick counter resets after warmup, so configured
+        ticks index post-warmup traffic only)."""
+        self._release_squeezes()
+        if self._trace_suppress:
+            return
+        from cloud_tpu.analysis import chaos
+        plan = chaos.active_plan()
+        if plan is None:
+            return
+        for event in plan.pre_tick(self._ticks):
+            self._apply_chaos(event)
+
+    def _apply_chaos(self, event):
+        if event.kind == "prefill_fail":
+            with self._chaos_lock:
+                self._prefill_fail_armed += 1
+            return
+        if event.kind == "pool_squeeze":
+            n = 1 if event.arg is None else int(event.arg)
+            pages = self.pool.squeeze(n)
+            self._note_fault(PoolSqueezed(
+                "graftchaos: squeezed {} page(s) at tick {}".format(
+                    len(pages), self._ticks)))
+            if pages:
+                self._squeezed.append(
+                    (pages, self._ticks + SQUEEZE_HOLD_TICKS,
+                     time.monotonic() + SQUEEZE_HOLD_S))
+            return
+        victim = None
+        if event.kind == "slot_evict" and event.arg is not None:
+            idx = int(event.arg)
+            if 0 <= idx < len(self._slots) and \
+                    self._slots[idx] is not None:
+                victim = idx
+        else:
+            for idx, state in enumerate(self._slots):
+                if state is not None:
+                    victim = idx
+                    break
+        if victim is None:
+            # Nothing in flight to fault — the one-shot still fired
+            # (logged by the plan), the injection is a no-op.
+            return
+        cls = SlotHang if event.kind == "slot_hang" else SlotEvicted
+        self._fault_slot(victim, self._slots[victim], cls(
+            "graftchaos: {} slot {} at tick {}".format(
+                event.kind, victim, self._ticks)))
+
+    def _release_squeezes(self, force=False):
+        if not self._squeezed:
+            return
+        now = time.monotonic()
+        keep = []
+        for pages, release_tick, deadline in self._squeezed:
+            if force or self._ticks >= release_tick or now >= deadline:
+                self.pool.free(pages)
+            else:
+                keep.append((pages, release_tick, deadline))
+        self._squeezed = keep
+
+    def _fault_slot(self, slot, state, fault):
+        """Slot-level fault recovery: drain the victim through the
+        SAME fixed-shape evict scatter finished slots use (the
+        persistent tick never stops), return its pages exactly once
+        (prefix-trie references survive untouched), and requeue its
+        request with retained progress."""
+        self._note_fault(fault, rid=state.rid, slot=slot)
+        evict_mask = np.zeros((self.engine.slots,), bool)
+        evict_mask[slot] = True
+        self.engine.evict(evict_mask)
+        self._slots[slot] = None
+        self._free_slots.append(slot)
+        self.pool.free(state.pages)
+        self._requeue_slot(state)
+        self._observe_gauges()
+
+    def _requeue_slot(self, state):
+        """Builds the typed continuation: original prompt + emitted
+        tokens become the new prompt, max_new shrinks by the same
+        count, and the ORIGINAL schedule rows n-1 / n.. ride along as
+        the engine's key_override — so the continuation's first token
+        samples with exactly the key the uninterrupted run would have
+        consumed (bit-identity). Front of the ready deque: a faulted
+        request has already waited once."""
+        request = state.request
+        emitted = [int(t) for t in state.emitted]
+        n = len(emitted)
+        eos = request.eos_token
+        if eos is not None and eos in emitted:
+            # eos already latched: the remaining decode is pure eos
+            # replay, which _complete's fill reproduces on host.
+            done = emitted[:emitted.index(eos) + 1]
+            self._complete(request, state.future, state.t_submit,
+                           state.ttft_s, done,
+                           prefix_len=state.result_prefix_len,
+                           rid=state.rid)
+            return
+        if n >= request.max_new_tokens:
+            self._complete(request, state.future, state.t_submit,
+                           state.ttft_s, emitted,
+                           prefix_len=state.result_prefix_len,
+                           rid=state.rid)
+            return
+        self._note_requeue(state.rid, tokens_done=n)
+        cont = dataclasses.replace(
+            request,
+            prompt=[int(t) for t in request.prompt] + emitted,
+            max_new_tokens=request.max_new_tokens - n)
+        item = _RequeueItem(
+            cont, np.array(state.step_keys[n - 1], np.uint32),
+            np.array(state.step_keys[n:], np.uint32),
+            state.future, state.t_submit, state.ttft_s,
+            state.result_prefix_len, rid=state.rid)
+        with self._ready_lock:
+            self._ready.appendleft(item)
+        self._wake.set()
+
+    def _note_fault(self, fault, rid=None, slot=None):
+        kind = fault_kind(fault)
+        self._fault_counts[kind] = self._fault_counts.get(kind, 0) + 1
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.counter(telemetry.SERVE_FAULTS_TOTAL % kind).inc()
+        if rid is not None:
+            self._trace_emit(rid, "slot_fault", kind=kind, slot=slot)
+
+    def _note_requeue(self, rid, tokens_done):
+        self._requeues += 1
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.counter(telemetry.SERVE_REQUEUES_TOTAL).inc()
+        self._trace_emit(rid, "requeue", tokens_done=int(tokens_done))
+
+    def _observe_prefill(self, dur):
+        self._prefill_hist.observe(dur)
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.histogram(
+                telemetry.SERVE_PREFILL_HISTOGRAM).observe(dur)
+
     # -- tick thread --------------------------------------------------
 
     def _tick_loop(self):
@@ -539,6 +888,7 @@ class Scheduler:
                 if watch.enabled():
                     watch.heartbeat()
                     watch.check()
+                self._chaos_pre_tick()
                 self._insert_ready()
                 if not any(s is not None for s in self._slots):
                     if not self._wake.wait(timeout=0.05):
@@ -591,6 +941,10 @@ class Scheduler:
                     if not self._admit_hit(item):
                         blocked.append(item)
                     continue
+                if isinstance(item, _RequeueItem):
+                    if not self._insert_requeue(item):
+                        blocked.append(item)
+                    continue
                 self._insert_miss_item(item)
         finally:
             if blocked:
@@ -603,6 +957,7 @@ class Scheduler:
                       item.t_submit, item.ttft_s, prefix_len=0,
                       rid=item.rid)
         state.emitted.append(item.result.first_token)
+        state.step_keys = item.result.step_keys
         self._slots[slot] = state
         vec = self.pool.page_vec(item.pages)
         self.engine.insert(slot, item.result, vec, vec,
@@ -611,6 +966,79 @@ class Scheduler:
         self._register(item.request, item.pages)
         self._pending_inserts -= 1
         self._observe_gauges()
+
+    def _insert_requeue(self, item):
+        """Tick-thread re-admission of a faulted request's continuation:
+        reserve (non-blocking — a starved requeue stays queued), cold
+        re-prefill under the key_override schedule, insert. No new TTFT
+        observation — the request's TTFT happened at its ORIGINAL
+        prefill and is carried through. Returns False when pages are
+        not available yet."""
+        request = item.request
+        if self._stop.is_set():
+            if not item.future.done():
+                error = (self._failure
+                         or RuntimeError("scheduler closed"))
+                self._trace_fail(item.rid, error)
+                item.future.set_exception(error)
+            return True
+        key_override = (item.key, item.rest)
+        if request.max_new_tokens == 1:
+            # Single remaining token: completes at prefill, no slot.
+            try:
+                result = self._engine_prefill(
+                    np.asarray(request.prompt, np.int32), 1,
+                    jax.random.PRNGKey(request.rng_seed),
+                    self._sampling(request),
+                    key_override=key_override)
+            except PrefillFailed as exc:
+                self._note_fault(exc, rid=item.rid, slot=None)
+                return False
+            self.engine.release_prefill(result)
+            self._complete(request, item.future, item.t_submit,
+                           item.ttft_s, [result.first_token],
+                           prefix_len=item.result_prefix_len,
+                           rid=item.rid)
+            return True
+        need = self.pool.pages_needed(len(request.prompt),
+                                      request.max_new_tokens,
+                                      slack=self._spec_slack())
+        pages = self._reserve_with_pressure(need, timeout=0.01)
+        if pages is None:
+            return False
+        self._trace_emit(item.rid, "pages_reserved", pages=len(pages),
+                         wait_s=0.0)
+        t_prefill0 = time.monotonic()
+        try:
+            result = self._engine_prefill(
+                np.asarray(request.prompt, np.int32),
+                request.max_new_tokens,
+                jax.random.PRNGKey(request.rng_seed),
+                self._sampling(request), key_override=key_override)
+        except PrefillFailed as exc:
+            self.pool.free(pages)
+            self._note_fault(exc, rid=item.rid, slot=None)
+            return False
+        except BaseException:
+            self.pool.free(pages)
+            raise
+        self._observe_prefill(time.monotonic() - t_prefill0)
+        self._trace_emit(item.rid, "prefill",
+                         bucket=int(result.bucket), prefix_len=0,
+                         dur_s=time.monotonic() - t_prefill0)
+        slot = self._free_slots.pop()
+        state = _Slot(request, pages, item.future, item.t_submit,
+                      item.ttft_s, prefix_len=0, rid=item.rid)
+        state.result_prefix_len = item.result_prefix_len
+        state.emitted.append(result.first_token)
+        state.step_keys = result.step_keys
+        self._slots[slot] = state
+        vec = self.pool.page_vec(pages)
+        self.engine.insert(slot, result, vec, vec,
+                           self._sampling(request))
+        self._trace_emit(item.rid, "slot_insert", slot=slot)
+        self._observe_gauges()
+        return True
 
     def _admit_hit(self, ticket):
         """Tick-thread admission of a prefix-cache hit: match (taking
@@ -673,16 +1101,22 @@ class Scheduler:
                          pages=len(fresh), wait_s=wait)
         t_prefill0 = time.monotonic()
         try:
-            result = self.engine.prefill(
+            result = self._engine_prefill(
                 np.asarray(prompt, np.int32), request.max_new_tokens,
                 jax.random.PRNGKey(request.rng_seed),
                 self._sampling(request), prefix_len=prefix_len,
                 gather_vec=self.pool.page_vec(held))
+        except PrefillFailed as exc:
+            self.pool.free(held + fresh)
+            self._note_fault(exc, rid=ticket.rid, slot=None)
+            self._note_requeue(ticket.rid, tokens_done=0)
+            return False
         except BaseException:
             self.pool.free(held + fresh)
             raise
         ttft = time.monotonic() - ticket.t_submit
         self._record_ttft(ttft, hit=True)
+        self._observe_prefill(time.monotonic() - t_prefill0)
         self._trace_emit(ticket.rid, "prefill",
                          bucket=int(result.bucket),
                          prefix_len=int(prefix_len),
@@ -693,6 +1127,7 @@ class Scheduler:
                       ticket.t_submit, ttft, prefix_len=prefix_len,
                       rid=ticket.rid)
         state.emitted.append(result.first_token)
+        state.step_keys = result.step_keys
         self._slots[slot] = state
         page_vec = self.pool.page_vec(shared + fresh)
         scatter_vec = self.pool.page_vec([0] * len(shared) + fresh)
@@ -724,16 +1159,22 @@ class Scheduler:
                          pages=len(pages), wait_s=wait)
         t_prefill0 = time.monotonic()
         try:
-            result = self.engine.prefill(
+            result = self._engine_prefill(
                 np.asarray(request.prompt, np.int32),
                 request.max_new_tokens,
                 jax.random.PRNGKey(request.rng_seed),
                 self._sampling(request))
+        except PrefillFailed as exc:
+            self.pool.free(pages)
+            self._note_fault(exc, rid=ticket.rid, slot=None)
+            self._note_requeue(ticket.rid, tokens_done=0)
+            return False
         except BaseException:
             self.pool.free(pages)
             raise
         ttft = time.monotonic() - ticket.t_submit
         self._record_ttft(ttft, hit=False)
+        self._observe_prefill(time.monotonic() - t_prefill0)
         self._trace_emit(ticket.rid, "prefill",
                          bucket=int(result.bucket), prefix_len=0,
                          dur_s=time.monotonic() - t_prefill0)
@@ -741,6 +1182,7 @@ class Scheduler:
         state = _Slot(request, pages, ticket.future, ticket.t_submit,
                       ttft, prefix_len=0, rid=ticket.rid)
         state.emitted.append(result.first_token)
+        state.step_keys = result.step_keys
         self._slots[slot] = state
         vec = self.pool.page_vec(pages)
         self.engine.insert(slot, result, vec, vec,
@@ -843,7 +1285,8 @@ class Scheduler:
         self.pool.free(state.pages)
         self._complete(state.request, state.future, state.t_submit,
                        state.ttft_s, state.emitted,
-                       prefix_len=state.prefix_len, rid=state.rid)
+                       prefix_len=state.result_prefix_len,
+                       rid=state.rid)
 
     def _complete(self, request, future, t_submit, ttft, emitted,
                   prefix_len, rid=None):
@@ -948,7 +1391,7 @@ class Scheduler:
             self._slots[slot] = None
         while True:
             try:
-                _, future, _, rid = self._admit_q.get_nowait()
+                _, future, _, rid, _ = self._admit_q.get_nowait()
             except queue.Empty:
                 break
             if not future.done():
@@ -1058,6 +1501,7 @@ class Scheduler:
         self._token_hist = Histogram("token_latency")
         self._queue_wait_hist = Histogram("queue_wait")
         self._reserve_wait_hist = Histogram("reserve_wait")
+        self._prefill_hist = Histogram("prefill")
         self._completed = 0
         self._tokens_out = 0
         self._ticks = 0
@@ -1109,7 +1553,14 @@ class Scheduler:
             "token_latency": self._token_hist.snapshot(),
             "queue_wait": self._queue_wait_hist.snapshot(),
             "reserve_wait": self._reserve_wait_hist.snapshot(),
+            "prefill": self._prefill_hist.snapshot(),
             "queue_depth": self._admit_q.qsize(),
+            "faults": dict(self._fault_counts),
+            "requeues": self._requeues,
+            "shed": dict(self._shed_counts),
+            "predicted_ttft": self._last_predicted_ttft,
+            "slo_ttft": self._slo_ttft,
+            "shed_policy": self._shed_policy,
             "prefix_hits": self._hits,
             "prefix_misses": self._misses,
             "prefix_hit_rate": self._hits / lookups if lookups else 0.0,

@@ -1,8 +1,9 @@
 """graftserve smoke: the serving acceptance contracts, CPU-sized.
 
-`python -m cloud_tpu.serving.smoke [--scenario base|prefix|spec|all]`
-runs the continuous-batching scheduler through three end-to-end
-scenarios, each enforcing its slice of the serving contract:
+`python -m cloud_tpu.serving.smoke [--scenario
+base|prefix|spec|chaos|all]` runs the continuous-batching scheduler
+through four end-to-end scenarios, each enforcing its slice of the
+serving contract:
 
 base (ISSUE 10) — ≥8 concurrent mixed-length requests:
   1. THROUGHPUT — aggregate tokens/sec >= MIN_SPEEDUP (2.0) times a
@@ -40,6 +41,18 @@ spec (ISSUE 11, speculative tick) — greedy fleet served twice, plain
   7. Bit-identity to solo generate() (the pinned accept/reject math),
      zero post-warmup traces, drained pool.
 
+chaos (ISSUE 14, graftstorm) — a mixed greedy/top-p fleet served twice,
+  clean then under injected serving faults (`prefill_fail`,
+  `slot_hang`, `pool_squeeze` at exact post-warmup ticks):
+  8. ZERO LOST — every offered request completes; a faulted slot is
+     evicted mid-flight and its request re-prefills from retained
+     progress, finishing BIT-IDENTICAL to solo generate() (the rng
+     schedule is re-based, not restarted).
+  9. Bounded blast radius — the chaos leg's token-latency p99 stays
+     within CHAOS_P99_FACTOR of the clean leg's, zero post-warmup
+     traces/compiles (recovery reuses warmed shapes), and the pool
+     drains leak-free (the faulted slot's pages return exactly once).
+
 Each scenario writes `serving_smoke[_<name>].json` next to the
 graftscope artifacts in --out-dir; CI uploads the directory.
 """
@@ -55,6 +68,8 @@ import numpy as np
 MIN_SPEEDUP = 2.0
 MIN_TTFT_RATIO = 5.0
 MIN_SPEC_SPEEDUP = 1.5
+CHAOS_P99_FACTOR = 10.0
+CHAOS_PLAN = "prefill_fail@2,slot_hang@5,pool_squeeze@9:8,slot_hang@14"
 
 
 def build_model(max_seq_len=64, num_layers=6):
@@ -529,12 +544,153 @@ def run_spec(args):
     return _check(failures, "spec")
 
 
+def build_chaos_requests(n_requests=12, seed=5):
+    """Mixed greedy/top-p fleet for the chaos leg. Every third request
+    samples (temperature + nucleus), so a requeue must re-base the rng
+    schedule — greedy alone would pass trivially."""
+    from cloud_tpu.serving import ServeRequest
+
+    rng = np.random.default_rng(seed)
+    requests = []
+    for i in range(n_requests):
+        plen = int(rng.integers(6, 17))
+        prompt = rng.integers(1, 512, (plen,)).astype(np.int32).tolist()
+        if i % 3 == 2:
+            requests.append(ServeRequest(
+                prompt=prompt, max_new_tokens=int(rng.integers(8, 15)),
+                temperature=0.8, top_p=0.9, rng_seed=4000 + i))
+        else:
+            requests.append(ServeRequest(
+                prompt=prompt, max_new_tokens=int(rng.integers(8, 21)),
+                temperature=0.0, rng_seed=4000 + i))
+    return requests
+
+
+def run_chaos(args):
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.analysis import chaos
+    from cloud_tpu.models.decoding import bucket_length
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.serving import Scheduler
+
+    model = build_model()
+    requests = build_chaos_requests()
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    print("[smoke:chaos] solo oracle ({} requests)".format(len(requests)))
+    oracle = solo_oracle(model, params, requests)
+
+    def _serve(plan):
+        slots = 4
+        pages_per_slot = model.max_seq_len // 16
+        scheduler = Scheduler(model, params, slots=slots, page_size=16,
+                              num_pages=(slots + 3) * pages_per_slot + 1,
+                              admission_window=len(requests),
+                              strict_no_retrace=True).start()
+        try:
+            # A requeued request re-prefills its prompt + tokens-so-far,
+            # which can land in a LARGER bucket than any original
+            # prompt — warm those continuation buckets too or the
+            # recovery path itself would retrace.
+            buckets = {scheduler._bucket(r) for r in requests}
+            buckets |= {bucket_length(
+                len(r.prompt) + r.max_new_tokens - 1,
+                model.max_seq_len) for r in requests}
+            scheduler.warmup(sorted(buckets), sampling_configs=[
+                (("temperature", 0.0),),
+                (("temperature", 0.8), ("top_p", 0.9)),
+            ])
+            warm = runtime.compile_stats()
+            if plan:
+                chaos.install(plan)
+            results, errors = [], []
+            futures = [scheduler.submit(r, timeout=30) for r in requests]
+            for i, future in enumerate(futures):
+                try:
+                    results.append(future.result(timeout=600))
+                except BaseException as exc:  # noqa: BLE001
+                    results.append(None)
+                    errors.append("request {}: {}: {}".format(
+                        i, type(exc).__name__, str(exc)[:120]))
+            after = runtime.compile_stats()
+            stats = scheduler.stats()
+            time.sleep(0.3)
+            scheduler.assert_drained(clear_prefix=True)
+            leaked = scheduler.pool.leak_report()
+            return results, errors, stats, leaked, (
+                after["n_traces"] - warm["n_traces"],
+                after["n_compiles"] - warm["n_compiles"])
+        finally:
+            chaos.uninstall()
+            scheduler.close()
+
+    print("[smoke:chaos] serve pass (clean control)")
+    _, clean_errs, clean_stats, _, _ = _serve(None)
+    print("[smoke:chaos] serve pass (chaos: {})".format(args.chaos_plan))
+    results, errors, stats, leaked, traces = _serve(args.chaos_plan)
+
+    mismatches = [i for i, (res, ref) in enumerate(zip(results, oracle))
+                  if res is None or not np.array_equal(res.tokens, ref)]
+    clean_p99 = clean_stats["token_latency"].get("p99") or 0.0
+    chaos_p99 = stats["token_latency"].get("p99") or 0.0
+    p99_bound = max(args.chaos_p99_factor * clean_p99, 0.5)
+
+    summary = {
+        "requests": len(requests),
+        "chaos_plan": args.chaos_plan,
+        "faults": stats["faults"],
+        "requeues": stats["requeues"],
+        "shed": stats["shed"],
+        "lost_requests": len(errors),
+        "errors": errors + clean_errs,
+        "mismatched_requests": mismatches,
+        "clean_token_p99_s": clean_p99,
+        "chaos_token_p99_s": chaos_p99,
+        "chaos_p99_bound_s": p99_bound,
+        "new_traces_post_warmup": traces[0],
+        "new_compiles_post_warmup": traces[1],
+        "leaked_pages": leaked,
+    }
+    _write_summary(args.out_dir, "serving_smoke_chaos.json", summary)
+
+    print("[smoke:chaos] faults {} | requeues {} | token p99 clean "
+          "{:.4f}s chaos {:.4f}s (bound {:.4f}s)".format(
+              stats["faults"], stats["requeues"], clean_p99, chaos_p99,
+              p99_bound))
+    failures = []
+    if errors or clean_errs:
+        failures.append("lost requests: {}".format(errors + clean_errs))
+    if mismatches:
+        failures.append("requests {} diverged from solo generate() "
+                        "after requeue (rng re-base drift)".format(
+                            mismatches))
+    for kind in ("prefill_fail", "slot_hang", "pool_squeeze"):
+        if not stats["faults"].get(kind):
+            failures.append("chaos kind {} never fired".format(kind))
+    if stats["requeues"] < 2:
+        failures.append("expected >= 2 requeues, saw {}".format(
+            stats["requeues"]))
+    if chaos_p99 > p99_bound:
+        failures.append("chaos token p99 {:.4f}s > bound {:.4f}s".format(
+            chaos_p99, p99_bound))
+    if traces[0] or traces[1]:
+        failures.append("retrace during fault recovery ({} traces, {} "
+                        "compiles)".format(*traces))
+    if leaked:
+        failures.append("page refcount leak after chaos drain: {}"
+                        .format(leaked))
+    return _check(failures, "chaos")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out-dir", default=os.environ.get(
         "CLOUD_TPU_TELEMETRY_DIR", "serving-smoke-out"))
     parser.add_argument("--scenario", default="base",
-                        choices=["base", "prefix", "spec", "all"])
+                        choices=["base", "prefix", "spec", "chaos",
+                                 "all"])
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--spec-k", type=int, default=3)
     parser.add_argument("--min-speedup", type=float, default=float(
@@ -545,12 +701,17 @@ def main(argv=None):
     parser.add_argument("--min-spec-speedup", type=float, default=float(
         os.environ.get("CLOUD_TPU_SMOKE_MIN_SPEC_SPEEDUP",
                        MIN_SPEC_SPEEDUP)))
+    parser.add_argument("--chaos-plan", default=CHAOS_PLAN)
+    parser.add_argument("--chaos-p99-factor", type=float, default=float(
+        os.environ.get("CLOUD_TPU_SMOKE_CHAOS_P99_FACTOR",
+                       CHAOS_P99_FACTOR)))
     args = parser.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     scenarios = {"base": [run_base], "prefix": [run_prefix],
-                 "spec": [run_spec],
-                 "all": [run_base, run_prefix, run_spec]}[args.scenario]
+                 "spec": [run_spec], "chaos": [run_chaos],
+                 "all": [run_base, run_prefix, run_spec,
+                         run_chaos]}[args.scenario]
     rc = 0
     for scenario in scenarios:
         rc = scenario(args) or rc

@@ -281,7 +281,7 @@ class TestServeReport:
                                       slo_ttft=0.05)
         assert report["format"] == "cloud_tpu.serve_report.v1"
         assert report["requests"] == {
-            "submitted": 4, "completed": 2, "failed": 1,
+            "submitted": 4, "completed": 2, "failed": 1, "shed": 0,
             "orphaned": 1, "orphans": ["servehost/42/r000003"]}
         # r0 (hit, ttft 14ms) meets the 50ms target; r1 (miss, 261ms)
         # misses it; the fail and the orphan count against goodput.
