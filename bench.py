@@ -219,6 +219,14 @@ COMPILE_CACHE_DIR = os.path.join(_HERE, "benchmarks", ".jax_cache")
 
 
 def _metric_name():
+    if os.environ.get("BENCH_SWEEP", "0") == "1":
+        # graftsweep series: trial throughput of a warm-cache ASHA
+        # sweep (tuner/sweep.py), with the cold-vs-warm compile split
+        # and guard fault census in the record. Foreign metric name ->
+        # its own cache slot; never pin-eligible (best_pin.json only
+        # carries the flagship training knobs, none of which this
+        # worker reads).
+        return "graftsweep_trials_per_hour"
     if os.environ.get("BENCH_SERVE_LOAD", "0") == "1":
         # graftlens open-loop load series: goodput (fraction of offered
         # requests meeting the TTFT+TPOT SLOs) at the highest swept
@@ -268,6 +276,8 @@ def _metric_name():
 
 
 def _unit():
+    if os.environ.get("BENCH_SWEEP", "0") == "1":
+        return "trials/hour"
     if os.environ.get("BENCH_SERVE_LOAD", "0") == "1":
         return "goodput_frac"
     return ("tokens/sec" if os.environ.get("BENCH_SERVE", "0") == "1"
@@ -466,6 +476,20 @@ def _requested_config():
     mismatch). Values reflect the post-pin environment; `pinned` lists
     the keys best_pin.json supplied.
     """
+    if os.environ.get("BENCH_SWEEP", "0") == "1":
+        # The sweep series' fair-game knobs: trial budget and the ASHA
+        # ladder geometry. The chaos spec is recorded when set so a
+        # fault-census record is self-describing.
+        cfg = {
+            "sweep": True,
+            "trials": _env_int("BENCH_SWEEP_TRIALS", 12),
+            "min_budget": _env_int("BENCH_SWEEP_MIN_BUDGET", 1),
+            "eta": _env_int("BENCH_SWEEP_ETA", 3),
+            "max_budget": _env_int("BENCH_SWEEP_MAX_BUDGET", 9),
+        }
+        if os.environ.get("CLOUD_TPU_CHAOS"):
+            cfg["chaos"] = os.environ["CLOUD_TPU_CHAOS"]
+        return cfg
     if os.environ.get("BENCH_SERVE_LOAD", "0") == "1":
         # The loadgen series' fair-game knobs: the arrival process and
         # the SLO envelope the goodput number is measured against.
@@ -1090,7 +1114,121 @@ def _serve_load_worker():
     print(json.dumps(record))
 
 
+def _sweep_worker():
+    """BENCH_SWEEP=1: the graftsweep trial-throughput series.
+
+    Runs the CI smoke's sweep shape — an ASHA ladder over a
+    runtime-only learning-rate axis on the CPU-scale MLP, so every
+    trial after the first rides the cold trial's warm executables —
+    and reports trials/hour as the `value`. `vs_baseline` is the run's
+    own cold-vs-warm contrast (cold trial wall over mean warm trial
+    wall: the multiplicative win the shared compile cache buys per
+    trial), and the guard fault/retry census fields make a
+    CLOUD_TPU_CHAOS run self-describing. Foreign metric name -> own
+    cache slot; never pin-eligible.
+    """
+    import tempfile
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    from cloud_tpu.parallel import compile_cache
+    compile_cache.enable(COMPILE_CACHE_DIR, min_compile_time_secs=1.0)
+    import optax
+
+    from cloud_tpu.models.mnist import MLP
+    from cloud_tpu.parallel import runtime as runtime_lib
+    from cloud_tpu.training import Trainer
+    from cloud_tpu.tuner import (ASHA, HyperParameters, Objective,
+                                 RandomOracle, Sweep)
+
+    trials = _env_int("BENCH_SWEEP_TRIALS", 12)
+    min_budget = _env_int("BENCH_SWEEP_MIN_BUDGET", 1)
+    eta = _env_int("BENCH_SWEEP_ETA", 3)
+    max_budget = _env_int("BENCH_SWEEP_MAX_BUDGET", 9)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    y = rng.integers(0, 8, size=256).astype(np.int32)
+    hp = HyperParameters()
+    hp.Float("learning_rate", 1e-3, 1e-1, sampling="log")
+
+    def build(hp):
+        return Trainer(
+            MLP(hidden=32, num_classes=8),
+            optimizer=optax.inject_hyperparams(optax.sgd)(
+                learning_rate=hp.get("learning_rate")),
+            metrics=())
+
+    objective = Objective("loss", "min")
+    sweep = Sweep(build, hp, objective,
+                  directory=tempfile.mkdtemp(prefix="bench_sweep_"),
+                  oracle=RandomOracle(hp, trials, seed=7),
+                  scheduler=ASHA(objective, min_budget=min_budget,
+                                 eta=eta, max_budget=max_budget),
+                  shape_keys=(), seed=0, name="bench")
+    result = sweep.run(x, y, batch_size=64, verbose=False)
+
+    rows = result["trials"]
+    cold_walls = [t["wall_s"] for t in rows if t["cold"]]
+    warm_walls = [t["wall_s"] for t in rows if not t["cold"]]
+    mean_warm = (sum(warm_walls) / len(warm_walls)) if warm_walls else None
+    trials_per_hour = (len(rows) / (result["wall_s"] / 3600.0)
+                       if result["wall_s"] else 0.0)
+    _pstats = compile_cache.stats()
+    compile_stats = runtime_lib.compile_stats()
+    record = {
+        "metric": _metric_name(),
+        "value": round(trials_per_hour, 2),
+        "unit": "trials/hour",
+        "vs_baseline": (round(cold_walls[0] / mean_warm, 3)
+                        if cold_walls and mean_warm else None),
+        "method": "warm_vs_cold_trial_wall",
+        "trials": len(rows),
+        "statuses": result["statuses"],
+        "best_score": (result["best"] or {}).get("score"),
+        "budgets": list(sweep.scheduler.budgets),
+        "sweep_wall_s": result["wall_s"],
+        "train_s": result["train_s"],
+        # The multiplicative compile win, as numbers: ONE cold start
+        # for the whole sweep, zero compiles on every warm trial.
+        "cold_trials": result["compile"]["cold_trials"],
+        "warm_trials": result["compile"]["warm_trials"],
+        "cold_compile_seconds": result["compile"]["cold_seconds"],
+        "warm_compile_seconds": result["compile"]["warm_seconds"],
+        "warm_new_compiles": result["compile"]["warm_new_compiles"],
+        "warm_new_traces": result["compile"]["warm_new_traces"],
+        "cold_trial_wall_s": (round(cold_walls[0], 4)
+                              if cold_walls else None),
+        "mean_warm_trial_wall_s": (round(mean_warm, 4)
+                                   if mean_warm else None),
+        # Guard census (zeros on a clean run; the CLOUD_TPU_CHAOS
+        # contrast shows the recovery-path tax per series).
+        "faults": result["census"]["faults"],
+        "retries": result["census"]["retries"],
+        "rollbacks": result["census"]["rollbacks"],
+        "resumes": result["census"]["resumes"],
+        "fault_kinds": result["census"]["by_kind"],
+        "lost_trials": len(result["census"]["lost_trials"]),
+        "n_traces": compile_stats["n_traces"],
+        "n_compiles": compile_stats["n_compiles"],
+        "compile_seconds": round(compile_stats["compile_seconds"], 3),
+        "compile_cache_hits": compile_stats["cache_hits"],
+        "persistent_cache_hits": _pstats["persistent_hits"],
+        "persistent_cache_misses": _pstats["persistent_misses"],
+        "platform": jax.default_backend(),
+        "requested_config": _requested_config(),
+    }
+    if compile_cache.is_enabled():
+        record["compile_cache_dir"] = compile_cache.cache_dir()
+    print(json.dumps(record))
+
+
 def worker():
+    if os.environ.get("BENCH_SWEEP", "0") == "1":
+        _sweep_worker()
+        return
     if os.environ.get("BENCH_SERVE_LOAD", "0") == "1":
         _serve_load_worker()
         return

@@ -14,6 +14,24 @@ from cloud_tpu.core.run import remote
 from cloud_tpu.core.run import run
 from cloud_tpu.version import __version__
 
-from cloud_tpu.tuner import (CloudOracle, CloudTuner,
-                             DistributingCloudTuner, HyperParameters,
-                             Objective)
+# Tuner names resolve lazily (PEP 562, mirroring cloud_tpu.tuner's own
+# lazy table): `import cloud_tpu` must not decide whether the process
+# gets the hosted Vizier path or a local sweep.
+_TUNER_NAMES = ("CloudOracle", "CloudTuner", "DistributingCloudTuner",
+                "HyperParameters", "Objective", "Sweep", "RandomOracle",
+                "GridOracle", "ASHA")
+
+
+def __getattr__(name):
+    if name in _TUNER_NAMES:
+        import importlib
+
+        value = getattr(importlib.import_module("cloud_tpu.tuner"), name)
+        globals()[name] = value
+        return value
+    raise AttributeError(
+        "module {!r} has no attribute {!r}".format(__name__, name))
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_TUNER_NAMES))

@@ -139,8 +139,26 @@ class ChaosPlan:
     counter by the fit loops and against committed checkpoint writes
     by checkpoint.save."""
 
+    #: `pre_dispatch` step interpretations: "global" matches the fit
+    #: loop's own step counter (single training run — the default);
+    #: "cumulative" matches a plan-private dispatch index that only
+    #: ever grows, so `preempt@N` means "the N-th dispatch THIS PLAN
+    #: has seen" even when many short runs (graftsweep trials) each
+    #: restart their step counter at 0. Replayed dispatches after a
+    #: resume count too — the index tracks work offered, keeping the
+    #: injection point a deterministic function of the spec alone.
+    STEP_MODES = ("global", "cumulative")
+
     def __init__(self, events):
         self.events = list(events)
+        self.step_mode = "global"
+        self._dispatched = 0
+
+    def set_step_mode(self, mode):
+        if mode not in self.STEP_MODES:
+            raise ValueError("step_mode must be one of {}; got {!r}."
+                             .format(self.STEP_MODES, mode))
+        self.step_mode = mode
 
     @classmethod
     def parse(cls, spec):
@@ -155,9 +173,18 @@ class ChaosPlan:
         — the window the NEXT dispatch will execute. A grouped or
         device-resident dispatch covers several steps per call, so the
         injection lands at the nearest dispatch boundary at or before
-        its configured step (dispatch is the abort granularity)."""
+        its configured step (dispatch is the abort granularity).
+
+        Under `step_mode == "cumulative"` the caller's step is ignored
+        in favor of the plan's own dispatch index, which advances by
+        `n_steps` per call — including the call an injection aborts:
+        the window is claimed either way, so resume re-entries see
+        fresh windows and the schedule stays deterministic."""
         if step is None:
             return
+        if self.step_mode == "cumulative":
+            step = self._dispatched
+            self._dispatched += n_steps
         due = [e for e in self.events
                if not e.fired and e.kind != "corrupt"
                and e.kind not in SERVE_KINDS

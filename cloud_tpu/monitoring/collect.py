@@ -623,6 +623,148 @@ def serve_report(lifecycles, globals_=(), slo_ttft=None, slo_tpot=None):
     return report
 
 
+def sweep_events(by_process):
+    """graftsweep records -> payload events (emit-time ordered), each
+    stamped "_monotonic"/"_time" like the reqtrace gatherer."""
+    out = []
+    for records in by_process.values():
+        for record in records:
+            if record.get("kind") != "graftsweep":
+                continue
+            payload = record.get("payload")
+            if not isinstance(payload, dict) or "event" not in payload:
+                continue
+            event = dict(payload)
+            event["_monotonic"] = float(record.get("monotonic", 0.0))
+            event["_time"] = record.get("time")
+            out.append(event)
+    out.sort(key=lambda e: e["_monotonic"])
+    return out
+
+
+def sweep_report(events):
+    """graftsweep events -> the sweep report dict
+    (`cloud_tpu.sweep_report.v1`).
+
+    One entry per sweep name seen in the log. Per-trial rows come from
+    each trial's single `complete` event (the authoritative ledger:
+    status, score, guard census, compile census, lineage); the
+    lifecycle stream cross-checks it — a `trial_start` with no
+    `complete` is an ORPHAN (a lost trial: the engine guarantees every
+    trial terminal, so CI asserts this list empty), and per-trial
+    rung_report/promote/fault/resume counts are reconciled into the
+    row so the report and the raw log can't silently disagree.
+    """
+    sweeps = {}
+    order = []
+    for event in events:
+        name = event.get("sweep", "sweep")
+        if name not in sweeps:
+            order.append(name)
+            sweeps[name] = {"start": None, "end": None, "complete": {},
+                            "started": [], "counts": {}}
+        agg = sweeps[name]
+        etype = event["event"]
+        if etype == "sweep_start":
+            agg["start"] = event
+        elif etype == "sweep_complete":
+            agg["end"] = event
+        elif etype == "trial_start":
+            agg["started"].append(event["trial"])
+        elif etype == "complete":
+            agg["complete"][event["trial"]] = event
+        if etype in ("rung_report", "promote", "prune", "fault",
+                     "resume"):
+            per_trial = agg["counts"].setdefault(event["trial"], {})
+            per_trial[etype] = per_trial.get(etype, 0) + 1
+
+    report = {"format": "cloud_tpu.sweep_report.v1", "sweeps": []}
+    for name in order:
+        agg = sweeps[name]
+        start = agg["start"] or {}
+        end = agg["end"] or {}
+        objective = start.get("objective") or {}
+        direction = objective.get("direction", "min")
+        trials = []
+        for trial_id in sorted(set(agg["started"])
+                               | set(agg["complete"])):
+            complete = agg["complete"].get(trial_id)
+            row = {"trial": trial_id}
+            if complete is not None:
+                row.update({k: v for k, v in complete.items()
+                            if not k.startswith("_")
+                            and k not in ("event", "sweep")})
+            row["events"] = agg["counts"].get(trial_id, {})
+            trials.append(row)
+        orphans = sorted(set(agg["started"]) - set(agg["complete"]))
+        scored = [t for t in trials
+                  if t.get("status") == "COMPLETED"
+                  and t.get("score") is not None]
+        best = None
+        if scored:
+            best = (max if direction == "max" else min)(
+                scored, key=lambda t: t["score"])
+        statuses = {}
+        for t in trials:
+            status = t.get("status", "ORPHANED")
+            statuses[status] = statuses.get(status, 0) + 1
+        cold = [t for t in trials if t.get("cold")]
+        warm = [t for t in trials if t.get("cold") is False]
+
+        def _total(rows, key):
+            return sum(t.get(key) or 0 for t in rows)
+
+        fault_kinds = {}
+        for t in trials:
+            for kind in t.get("fault_kinds") or ():
+                fault_kinds[kind] = fault_kinds.get(kind, 0) + 1
+        wall_s = end.get("wall_s")
+        train_s = end.get("train_s")
+        sweep_entry = {
+            "sweep": name,
+            "oracle": start.get("oracle"),
+            "scheduler": start.get("scheduler"),
+            "objective": objective or None,
+            "budgets": start.get("budgets"),
+            "max_trials": start.get("max_trials"),
+            "directory": start.get("directory"),
+            "complete": agg["end"] is not None,
+            "trials": trials,
+            "statuses": statuses,
+            "orphans": orphans,
+            "best": ({"trial": best["trial"], "score": best["score"],
+                      "hp": best.get("hp"), "seed": best.get("seed"),
+                      "rungs": best.get("rungs")}
+                     if best is not None else None),
+            "census": {
+                "faults": _total(trials, "faults"),
+                "retries": _total(trials, "retries"),
+                "rollbacks": _total(trials, "rollbacks"),
+                "resumes": _total(trials, "resumes"),
+                "by_kind": fault_kinds,
+            },
+            "compile": {
+                "cold_trials": len(cold),
+                "warm_trials": len(warm),
+                "cold_seconds": round(_total(cold, "compile_seconds"),
+                                      6),
+                "warm_seconds": round(_total(warm, "compile_seconds"),
+                                      6),
+                "warm_new_compiles": _total(warm, "new_compiles"),
+                "warm_new_traces": _total(warm, "new_traces"),
+            },
+            "wall": {
+                "sweep_s": wall_s,
+                "train_s": train_s,
+                "overhead_s": (round(wall_s - train_s, 6)
+                               if wall_s is not None
+                               and train_s is not None else None),
+            },
+        }
+        report["sweeps"].append(sweep_entry)
+    return report
+
+
 def serve_trace_lane(lifecycles, globals_=(), pid=0):
     """Per-request waterfall as Chrome trace events on one pid lane.
 
@@ -685,11 +827,13 @@ def serve_trace_lane(lifecycles, globals_=(), pid=0):
     return events
 
 
-def collect(inputs, out_dir, serve=False, slo_ttft=None, slo_tpot=None):
+def collect(inputs, out_dir, serve=False, slo_ttft=None, slo_tpot=None,
+            sweep=False):
     """The full pass: discover -> group -> report -> merge -> write.
     Returns the fleet report dict (with an extra "outputs" section
     naming what was written). `serve=True` additionally rolls reqtrace
-    records into serve_report.json and a waterfall lane in trace.json.
+    records into serve_report.json and a waterfall lane in trace.json;
+    `sweep=True` rolls graftsweep records into sweep_report.json.
     """
     jsonl_paths, trace_paths = discover_inputs(inputs)
     by_process, corrupt = load_process_records(jsonl_paths)
@@ -710,6 +854,24 @@ def collect(inputs, out_dir, serve=False, slo_ttft=None, slo_tpot=None):
         report["serve"] = {
             "requests": sreport["requests"],
             "goodput": sreport["goodput"],
+        }
+
+    if sweep:
+        swreport = sweep_report(sweep_events(by_process))
+        sweep_path = os.path.join(out_dir, "sweep_report.json")
+        with open(sweep_path, "w") as f:
+            json.dump(swreport, f, indent=2, sort_keys=True)
+            f.write("\n")
+        outputs["sweep_report"] = sweep_path
+        report["sweep"] = {
+            "sweeps": len(swreport["sweeps"]),
+            "trials": sum(len(s["trials"])
+                          for s in swreport["sweeps"]),
+            "orphans": sum(len(s["orphans"])
+                           for s in swreport["sweeps"]),
+            "faults": sum(s["census"]["faults"]
+                          for s in swreport["sweeps"]),
+            "best": [s["best"] for s in swreport["sweeps"]],
         }
 
     report_path = os.path.join(out_dir, "fleet_report.json")
@@ -756,9 +918,13 @@ def main(argv=None):
                         help="goodput TTFT target, seconds")
     parser.add_argument("--slo-tpot", type=float, default=None,
                         help="goodput per-token target, seconds")
+    parser.add_argument("--sweep", action="store_true",
+                        help="also roll graftsweep trial events into "
+                             "sweep_report.json")
     args = parser.parse_args(argv)
     report = collect(args.inputs, args.out, serve=args.serve,
-                     slo_ttft=args.slo_ttft, slo_tpot=args.slo_tpot)
+                     slo_ttft=args.slo_ttft, slo_tpot=args.slo_tpot,
+                     sweep=args.sweep)
     fleet = report["fleet"]
     print("fleet: {} process(es)".format(fleet["process_count"]))
     serve = report.get("serve")
@@ -768,6 +934,16 @@ def main(argv=None):
               "orphaned, goodput {}".format(
                   reqs["submitted"], reqs["completed"], reqs["failed"],
                   reqs["orphaned"], serve["goodput"]["overall"]))
+    sweep = report.get("sweep")
+    if sweep is not None:
+        best = [b for b in sweep["best"] if b]
+        print("sweep: {} sweep(s), {} trial(s), {} orphan(s), {} "
+              "fault(s){}".format(
+                  sweep["sweeps"], sweep["trials"], sweep["orphans"],
+                  sweep["faults"],
+                  ", best {} = {}".format(best[0]["trial"],
+                                          best[0]["score"])
+                  if best else ""))
     if "step_p50_skew_pct" in fleet:
         print("step p50 skew: {:.1f}% (straggler: {})".format(
             fleet["step_p50_skew_pct"], fleet["straggler"]))
@@ -777,7 +953,8 @@ def main(argv=None):
             (report.get("corrupt_inputs") or {}).items()):
         print("torn input: {} ({} corrupt line(s))".format(
             path, "unreadable" if count < 0 else count))
-    for key in ("report", "serve_report", "trace", "prom"):
+    for key in ("report", "serve_report", "sweep_report", "trace",
+                "prom"):
         if key in report["outputs"]:
             print("wrote {}".format(report["outputs"][key]))
     return 0 if fleet["process_count"] else 1

@@ -118,6 +118,19 @@ SERVE_PREDICTED_TTFT = "cloud_tpu_serve_predicted_ttft"
 #: needs a live prefill estimate even when telemetry export is off.
 SERVE_PREFILL_HISTOGRAM = "cloud_tpu_serve_prefill_seconds"
 
+#: graftsweep (tuner/sweep.py) names. Counters accrue across every
+#: sweep a process runs; the gauges hold the LATEST sweep's values.
+#: `_warm_trials_total` counts reused-Trainer trials that finished
+#: with zero new compiles — the shared-warm-cache win, pinned.
+SWEEP_TRIALS_TOTAL = "cloud_tpu_sweep_trials_total"
+SWEEP_TRIALS_PRUNED_TOTAL = "cloud_tpu_sweep_trials_pruned_total"
+SWEEP_TRIALS_FAILED_TOTAL = "cloud_tpu_sweep_trials_failed_total"
+SWEEP_FAULTS_TOTAL = "cloud_tpu_sweep_faults_total"
+SWEEP_RESUMES_TOTAL = "cloud_tpu_sweep_resumes_total"
+SWEEP_WARM_TRIALS_TOTAL = "cloud_tpu_sweep_warm_trials_total"
+SWEEP_BEST_SCORE = "cloud_tpu_sweep_best_score"
+SWEEP_COMPILE_SECONDS = "cloud_tpu_sweep_compile_seconds"
+
 #: Per-kernel cost rows (ops/ Pallas kernels: "paged_attention",
 #: "fused_norm"). Fed by `Telemetry.record_kernel_cost` from the jit
 #: cost-analysis hook (the PR 6 MFU idiom, per-kernel): the serving

@@ -157,6 +157,56 @@ def reset_guard_stats():
     _stats.update(_STATS_ZERO)
 
 
+class _GuardScope:
+    """Snapshot/delta view over the process-global guard counters —
+    see `guard_scope()`."""
+
+    _COUNTERS = ("faults", "retries", "rollbacks", "giveups", "resumes")
+    _LAST_RESUME = ("last_resume_latency_seconds",
+                    "last_resume_new_traces",
+                    "last_resume_new_compiles")
+
+    def __init__(self):
+        self._base = None
+        self._final = None
+
+    def __enter__(self):
+        self._base = guard_stats()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._final = guard_stats()
+        return False
+
+    def stats(self):
+        """The delta accrued inside the scope: integer counters as
+        differences; `last_fault` / `last_resume_*` only when this
+        scope saw a fault / resume (else None — a previous scope's
+        leftovers never leak in). Valid mid-scope (live delta) and
+        after exit (frozen at `__exit__`)."""
+        if self._base is None:
+            raise RuntimeError("guard_scope stats read before entry.")
+        end = self._final if self._final is not None else guard_stats()
+        out = {key: end[key] - self._base[key] for key in self._COUNTERS}
+        out["last_fault"] = end["last_fault"] if out["faults"] else None
+        for key in self._LAST_RESUME:
+            out[key] = end[key] if out["resumes"] else None
+        return out
+
+
+def guard_scope():
+    """Context manager scoping `guard_stats()` to one supervised run.
+
+    The module-global counters are process-wide by design (telemetry,
+    bench records); anything running MANY supervised fits in one
+    process — a graftsweep trial, a test — needs per-run attribution.
+    `with guard_scope() as guard:` snapshots on entry and `guard.stats()`
+    returns only what accrued inside the scope, so trial K's faults
+    never bleed into trial K+1's census. Nestable (each scope deltas
+    independently); never resets the globals."""
+    return _GuardScope()
+
+
 def _registry():
     # graftscope is optional: touch it only when the process already
     # imported it AND a Telemetry is active (same discipline as watch).
@@ -232,7 +282,9 @@ def backoff_delay(attempt, base=1.0, cap=30.0, rng=None):
     """
     if rng is None:
         rng = random
-    raw = min(float(cap), float(base) * (2.0 ** attempt))
+    # 2.0**attempt overflows a float past attempt 1023; any exponent
+    # beyond 64 is already astronomically over every sane cap.
+    raw = min(float(cap), float(base) * (2.0 ** min(int(attempt), 64)))
     return raw * (0.5 + 0.5 * rng.random())
 
 

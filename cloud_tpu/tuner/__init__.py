@@ -1,7 +1,49 @@
-from cloud_tpu.tuner.hyperparameters import HyperParameters, Objective
-from cloud_tpu.tuner.optimizer_client import (OptimizerClient,
-                                              SuggestionInactiveError,
-                                              create_or_load_study)
-from cloud_tpu.tuner.tuner import (CloudOracle, CloudTuner,
-                                   DistributingCloudTuner, Trial,
-                                   TrialStatus)
+"""Hyperparameter search: local-first graftsweep + Vizier-backed tuner.
+
+Every name resolves lazily (PEP 562): `import cloud_tpu.tuner` touches
+nothing — not googleapiclient, not cloud_fit (whose remote module pulls
+jax), not the sweep engine. The hosted-path classes
+(CloudOracle/CloudTuner/DistributingCloudTuner) import their GCP
+machinery only inside the methods that reach the service, so an
+offline process pays for exactly what it uses.
+"""
+
+_LAZY = {
+    # The search-space / objective surface (pure python).
+    "HyperParameters": "cloud_tpu.tuner.hyperparameters",
+    "Objective": "cloud_tpu.tuner.hyperparameters",
+    # graftsweep: local-first supervised sweeps.
+    "Sweep": "cloud_tpu.tuner.sweep",
+    "SweepTrial": "cloud_tpu.tuner.sweep",
+    "SweepTrialStatus": "cloud_tpu.tuner.sweep",
+    "RandomOracle": "cloud_tpu.tuner.schedulers",
+    "GridOracle": "cloud_tpu.tuner.schedulers",
+    "ASHA": "cloud_tpu.tuner.schedulers",
+    # The Vizier-backed hosted path.
+    "CloudOracle": "cloud_tpu.tuner.tuner",
+    "CloudTuner": "cloud_tpu.tuner.tuner",
+    "DistributingCloudTuner": "cloud_tpu.tuner.tuner",
+    "Trial": "cloud_tpu.tuner.tuner",
+    "TrialStatus": "cloud_tpu.tuner.tuner",
+    "OptimizerClient": "cloud_tpu.tuner.optimizer_client",
+    "SuggestionInactiveError": "cloud_tpu.tuner.optimizer_client",
+    "create_or_load_study": "cloud_tpu.tuner.optimizer_client",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    module_name = _LAZY.get(name)
+    if module_name is None:
+        raise AttributeError(
+            "module {!r} has no attribute {!r}".format(__name__, name))
+    import importlib
+
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value  # cache: resolve once per process
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

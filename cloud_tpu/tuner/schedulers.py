@@ -1,0 +1,223 @@
+"""graftsweep trial sources and the ASHA rung scheduler.
+
+The sweep engine (cloud_tpu/tuner/sweep.py) separates WHAT to try from
+WHEN to stop trying it:
+
+- An **oracle** proposes hyperparameter assignments from the existing
+  `hyperparameters.py` search space: `RandomOracle` (seeded i.i.d.
+  samples, deterministic per trial index) and `GridOracle` (the full
+  cartesian product over discrete axes, mixed-radix enumeration so
+  trial k is a pure function of k). Both are offline/local — the
+  Vizier-backed `CloudOracle` stays in tuner.py for the hosted path.
+
+- A **scheduler** decides budgets and early stopping. `ASHA` is
+  asynchronous successive halving (Li et al., "A System for Massively
+  Parallel Hyperparameter Tuning"): rung r runs trials to
+  `min_budget * eta**r` epochs; whenever any rung holds at least
+  `eta * (promotions so far + 1)` reported trials, its best unpromoted
+  top-1/eta trial is promoted to the next rung — no synchronization
+  barrier, so one worker (or many) always has a job. Trials that reach
+  the top rung COMPLETE; trials still paused at a lower rung when the
+  sweep drains are PRUNED (terminal, never lost).
+
+Scores flow through `report(trial_id, rung, score)` in the objective's
+raw units; direction ("min"/"max") comes from the `Objective` so the
+promotion math never sees negated values.
+"""
+
+import logging
+
+logger = logging.getLogger("cloud_tpu")
+
+
+# --------------------------------------------------------------------------
+# Oracles: trial index -> HyperParameters (or None when exhausted)
+# --------------------------------------------------------------------------
+
+
+class RandomOracle:
+    """Seeded random search over a `HyperParameters` space.
+
+    Trial k samples with seed `seed * 1_000_003 + k`, so a proposal is
+    a pure function of (seed, k): a re-run — or a bit-identity control
+    re-running one trial of a finished sweep — reproduces the exact
+    assignment without replaying the sweep.
+    """
+
+    name = "random"
+
+    def __init__(self, hyperparameters, max_trials, seed=0):
+        if not hyperparameters.space:
+            raise ValueError("The hyperparameter search space is empty.")
+        if max_trials < 1:
+            raise ValueError("max_trials must be >= 1; got {}."
+                             .format(max_trials))
+        self.hyperparameters = hyperparameters
+        self.max_trials = int(max_trials)
+        self.seed = int(seed)
+
+    def propose(self, index):
+        if index >= self.max_trials:
+            return None
+        return self.hyperparameters.random_sample(
+            self.seed * 1_000_003 + index)
+
+
+class GridOracle:
+    """Exhaustive cartesian product over discrete axes.
+
+    Axis values per parameter kind: Choice -> its values, Boolean ->
+    (False, True), Fixed -> its single value, Int/Float -> the stepped
+    range (both require `step`; an unstepped continuous axis has no
+    finite grid and raises up front rather than silently subsampling).
+    Trial k decodes k in mixed radix over the axes in space-insertion
+    order — last axis fastest, like itertools.product.
+    """
+
+    name = "grid"
+
+    def __init__(self, hyperparameters):
+        if not hyperparameters.space:
+            raise ValueError("The hyperparameter search space is empty.")
+        self.hyperparameters = hyperparameters
+        self.axes = [(name, self._axis_values(param))
+                     for name, param in hyperparameters.space.items()]
+        self.max_trials = 1
+        for _, values in self.axes:
+            self.max_trials *= len(values)
+
+    @staticmethod
+    def _axis_values(param):
+        kind = getattr(param, "kind", None)
+        if kind == "choice":
+            return list(param.values)
+        if kind == "boolean":
+            return [False, True]
+        if kind == "fixed":
+            return [param.value]
+        if kind == "int":
+            if param.step:
+                return list(range(param.min_value, param.max_value + 1,
+                                  int(param.step)))
+            return list(range(param.min_value, param.max_value + 1))
+        if kind == "float":
+            if not param.step:
+                raise ValueError(
+                    "GridOracle needs a finite axis for {!r}: give the "
+                    "Float a step= or use Choice.".format(param.name))
+            n = int(round((param.max_value - param.min_value)
+                          / param.step))
+            return [param.min_value + i * param.step
+                    for i in range(n + 1)]
+        raise ValueError("GridOracle cannot enumerate parameter kind "
+                         "{!r} ({!r}).".format(kind, param.name))
+
+    def propose(self, index):
+        if index >= self.max_trials:
+            return None
+        hp = self.hyperparameters.copy()
+        rem = index
+        for name, values in reversed(self.axes):
+            rem, digit = divmod(rem, len(values))
+            hp.values[name] = values[digit]
+        return hp
+
+
+# --------------------------------------------------------------------------
+# ASHA: asynchronous successive halving
+# --------------------------------------------------------------------------
+
+
+class ASHA:
+    """Asynchronous successive-halving rung scheduler.
+
+    Rung budgets are `min_budget * eta**r` epochs, capped at
+    `max_budget` (which always terminates the ladder, so a trial that
+    reaches the top rung is COMPLETE). `next_rung()` is checked before
+    every new proposal — the async rule: promote whenever some rung's
+    top 1/eta holds an unpromoted trial, scanning the highest rung
+    first so near-finished trials finish ahead of fresh starts.
+    """
+
+    name = "asha"
+
+    def __init__(self, objective, min_budget=1, eta=3, max_budget=None):
+        if eta < 2:
+            raise ValueError("eta must be >= 2; got {}.".format(eta))
+        if min_budget < 1:
+            raise ValueError("min_budget must be >= 1; got {}."
+                             .format(min_budget))
+        if max_budget is None:
+            max_budget = min_budget * eta ** 2
+        if max_budget < min_budget:
+            raise ValueError(
+                "max_budget {} < min_budget {}.".format(max_budget,
+                                                        min_budget))
+        self.objective = objective
+        self.eta = int(eta)
+        self.budgets = []
+        budget = int(min_budget)
+        while budget < int(max_budget):
+            self.budgets.append(budget)
+            budget *= self.eta
+        self.budgets.append(int(max_budget))
+        # rung index -> {trial_id: score}; promotions out of each rung.
+        self.results = [dict() for _ in self.budgets]
+        self.promoted = [set() for _ in self.budgets]
+
+    @property
+    def top_rung(self):
+        return len(self.budgets) - 1
+
+    def report(self, trial_id, rung, score):
+        """Records a trial's score at rung `rung` (its budget's epoch
+        count reached). Re-reports overwrite — the score at a rung is
+        the trial's value AT that budget, whatever path got it there."""
+        self.results[rung][trial_id] = float(score)
+
+    def _ranked(self, rung):
+        reverse = self.objective.direction == "max"
+        return sorted(self.results[rung].items(),
+                      key=lambda item: item[1], reverse=reverse)
+
+    def next_promotion(self):
+        """(trial_id, next_rung) for the best promotable trial, or
+        None. A rung can promote its i-th trial once it holds at least
+        `eta * i` reports — the top-1/eta rule applied online."""
+        for rung in range(self.top_rung - 1, -1, -1):
+            quota = len(self.results[rung]) // self.eta
+            if quota <= len(self.promoted[rung]):
+                continue
+            for trial_id, _ in self._ranked(rung)[:quota]:
+                if trial_id not in self.promoted[rung]:
+                    return trial_id, rung + 1
+        return None
+
+    def promote(self, trial_id, next_rung):
+        """Commits a promotion returned by `next_promotion`."""
+        self.promoted[next_rung - 1].add(trial_id)
+
+    def paused(self):
+        """Trial ids reported at some rung but neither promoted out of
+        it nor at the top rung — the set a draining sweep prunes."""
+        out = []
+        for rung in range(self.top_rung):
+            for trial_id, score in self.results[rung].items():
+                if trial_id not in self.promoted[rung]:
+                    out.append((trial_id, rung, score))
+        # A trial sits unpromoted in at most one rung (reporting at
+        # rung r+1 implies promotion out of r), so no dedup needed.
+        return sorted(out)
+
+    def cutoff(self, rung):
+        """The score a trial must beat to sit in rung `rung`'s current
+        top 1/eta (None while the rung holds fewer than eta reports) —
+        recorded in prune events so a pruned trial's event row shows
+        what it lost to."""
+        quota = len(self.results[rung]) // self.eta
+        if quota == 0:
+            return None
+        return self._ranked(rung)[quota - 1][1]
+
+
+__all__ = ["RandomOracle", "GridOracle", "ASHA"]

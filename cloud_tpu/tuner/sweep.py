@@ -1,0 +1,667 @@
+"""graftsweep: fault-tolerant local-first hyperparameter sweeps.
+
+ROADMAP item 5. The Vizier-backed `CloudTuner` (tuner.py) round-trips
+a hosted service per trial and predates every piece of machinery from
+PRs 1-14; this engine is the local-first rebuild that actually reaches
+it all:
+
+- **Trials are graftguard-supervised.** Every trial segment runs under
+  `resilience.resilient_fit` with a per-trial checkpoint directory
+  (`<directory>/<trial_id>/`), so the typed fault taxonomy (Preemption,
+  CheckpointCorrupt, NaNLoss, BackendUnavailable) is answered per kind
+  exactly as in training: a preempted trial RESUMES mid-epoch
+  bit-identical instead of being re-scored from scratch, and the
+  deterministic `CLOUD_TPU_CHAOS` injector exercises it in CI. Per-
+  trial fault/retry/rollback attribution comes from
+  `resilience.guard_scope()` deltas — the process-global counters
+  never bleed between trials.
+
+- **Trials of one shape signature share one warm Trainer.** The first
+  trial of a signature builds via the user's `build(hp)` and pays the
+  cold compile; every later same-signature trial REUSES that Trainer —
+  state nulled and re-initialized from the trial's seed (plain
+  jax.random + optimizer init: the instrumented compile census does
+  not move), runtime-only hyperparameters applied to the live
+  `opt_state` (optax `inject_hyperparams` — the traced graph reads
+  them from state, so no retrace) or via a user `apply(trainer, hp)`
+  hook. The step executables live in the Trainer's per-shape caches
+  and the AOT warm table, so trial N>1 reports
+  `new_traces == new_compiles == 0` — the compile census pins it.
+
+- **ASHA rungs early-stop via the metric stream.** With an `ASHA`
+  scheduler (schedulers.py), rung-0 trials run `min_budget` epochs;
+  promotions literally resume the trial's checkpoint through the warm
+  executables (`initial_epoch`/`resume_from`) up to the next rung's
+  budget. Paused trials that never promote are PRUNED at drain —
+  every trial ends terminal (COMPLETED / PRUNED / FAILED), never lost.
+
+- **Everything lands in the JSONL job-event log** (`kind="graftsweep"`
+  via CLOUD_TPU_EVENT_LOG): sweep_start, trial_start, rung_report
+  (per epoch), promote, prune, fault, resume, complete,
+  sweep_complete. `python -m cloud_tpu.monitoring.collect --sweep`
+  rolls the log into `sweep_report.json`
+  (`cloud_tpu.sweep_report.v1`); `cloud_tpu_sweep_*` telemetry
+  counters/gauges ride the graftscope registry when one is active.
+
+Usage::
+
+    hp = HyperParameters()
+    hp.Float("learning_rate", 1e-3, 1e-1, sampling="log")
+
+    def build(hp):
+        opt = optax.inject_hyperparams(optax.sgd)(
+            learning_rate=hp.get("learning_rate"))
+        return Trainer(MLP(hidden=32, num_classes=4), optimizer=opt)
+
+    sweep = Sweep(build, hp, Objective("loss", "min"),
+                  directory="/tmp/sweep",
+                  oracle=RandomOracle(hp, max_trials=12),
+                  scheduler=ASHA(Objective("loss", "min"),
+                                 min_budget=1, eta=3, max_budget=9))
+    result = sweep.run(x, y, batch_size=32)
+"""
+
+import json
+import logging
+import os
+import sys
+import time
+
+from cloud_tpu.parallel import runtime
+from cloud_tpu.training import callbacks as callbacks_lib
+from cloud_tpu.training import resilience
+from cloud_tpu.tuner import schedulers as schedulers_lib
+
+logger = logging.getLogger("cloud_tpu")
+
+
+class SweepTrialStatus:
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    COMPLETED = "COMPLETED"
+    PRUNED = "PRUNED"
+    FAILED = "FAILED"
+
+    TERMINAL = (COMPLETED, PRUNED, FAILED)
+
+
+# --------------------------------------------------------------------------
+# Telemetry / events (graftguard's soft-dependency discipline)
+# --------------------------------------------------------------------------
+
+
+def _registry():
+    telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+    if telemetry is None:
+        return None
+    try:
+        tele = telemetry.get()
+        if tele is None or not tele.active:
+            return None
+        return tele.registry
+    except Exception:
+        return None
+
+
+def _count(name, delta=1):
+    reg = _registry()
+    if reg is None or not delta:
+        return
+    try:
+        reg.counter(name).inc(delta)
+    except Exception:
+        logger.debug("graftsweep: counter %s export failed", name,
+                     exc_info=True)
+
+
+def _gauge(name, value):
+    reg = _registry()
+    if reg is None or value is None:
+        return
+    try:
+        reg.gauge(name).set(value)
+    except Exception:
+        logger.debug("graftsweep: gauge %s export failed", name,
+                     exc_info=True)
+
+
+def _log_event(payload):
+    try:
+        from cloud_tpu.utils import events
+
+        events.log_job_event("graftsweep", payload)
+    except Exception:
+        logger.debug("graftsweep: job event export failed",
+                     exc_info=True)
+
+
+# --------------------------------------------------------------------------
+# Trial record
+# --------------------------------------------------------------------------
+
+
+class SweepTrial:
+    """One hyperparameter evaluation and its full lifecycle ledger."""
+
+    def __init__(self, index, trial_id, hp, seed, signature):
+        self.index = index
+        self.trial_id = trial_id
+        self.hp = hp
+        self.seed = seed
+        self.signature = signature
+        self.status = SweepTrialStatus.RUNNING
+        self.score = None
+        self.history = {}
+        self.rungs = []          # [{"rung", "budget_epochs", "score"}]
+        self.epochs = 0          # highest budget reached
+        self.cold = False        # this trial built its signature's Trainer
+        self.error = None
+        # Guard census, accumulated across segments.
+        self.faults = 0
+        self.retries = 0
+        self.rollbacks = 0
+        self.resumes = 0
+        self.fault_kinds = []
+        # Compile census, accumulated across segments.
+        self.new_traces = 0
+        self.new_compiles = 0
+        self.compile_seconds = 0.0
+        self.wall_s = 0.0
+
+    def spec(self):
+        return {
+            "trial": self.trial_id,
+            "index": self.index,
+            "hp": dict(self.hp.values),
+            "seed": self.seed,
+            "signature": self.signature,
+            "status": self.status,
+            "score": self.score,
+            "rungs": list(self.rungs),
+            "epochs": self.epochs,
+            "cold": self.cold,
+            "error": self.error,
+            "faults": self.faults,
+            "retries": self.retries,
+            "rollbacks": self.rollbacks,
+            "resumes": self.resumes,
+            "fault_kinds": list(self.fault_kinds),
+            "new_traces": self.new_traces,
+            "new_compiles": self.new_compiles,
+            "compile_seconds": round(self.compile_seconds, 6),
+            "wall_s": round(self.wall_s, 6),
+        }
+
+
+class _RungReporter(callbacks_lib.Callback):
+    """Per-epoch rung_report events off the (async) metric stream —
+    the score a rung decision will read, visible while the trial is
+    still running, not only at its end."""
+
+    def __init__(self, sweep, trial, rung):
+        self.sweep = sweep
+        self.trial = trial
+        self.rung = rung
+
+    def on_epoch_end(self, epoch, logs):
+        name = self.sweep.objective.name
+        value = (logs or {}).get(name)
+        if value is None:
+            return
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return
+        self.trial.score = value
+        _log_event({"event": "rung_report", "sweep": self.sweep.name,
+                    "trial": self.trial.trial_id, "rung": self.rung,
+                    "epoch": int(epoch), "score": value})
+
+
+# --------------------------------------------------------------------------
+# The engine
+# --------------------------------------------------------------------------
+
+
+class Sweep:
+    """Local-first, graftguard-supervised hyperparameter sweep.
+
+    Args:
+        build: callable(hp: HyperParameters) -> Trainer (the
+            hypermodel). Called once per SHAPE SIGNATURE, not once per
+            trial — same-signature trials reuse the warm Trainer.
+        hyperparameters: The search space (used for the default
+            signature keys; proposals come from the oracle).
+        objective: `Objective(name, direction)` — the history metric
+            rung decisions and best-trial selection read.
+        directory: Sweep root; trial t's checkpoints live at
+            `<directory>/<trial_id>/`.
+        oracle: Trial source (`RandomOracle` / `GridOracle` /
+            anything with `.propose(index)` and `.max_trials`).
+            Defaults to `RandomOracle(hyperparameters, max_trials)`.
+        scheduler: Optional `ASHA`. None runs every trial to `epochs`
+            in one segment (plain random/grid search).
+        max_trials: Budget for the default oracle (ignored when an
+            oracle is passed).
+        epochs: Per-trial epochs WITHOUT a scheduler (with one, rung
+            budgets rule).
+        seed: Base seed; trial k trains with seed `seed + k` (param
+            init AND shuffle stream — the bit-identity control re-runs
+            a trial from its recorded seed alone).
+        shape_keys: Names of hyperparameters that change compiled
+            shapes (model width, batch geometry, ...). Default None
+            treats EVERY non-Fixed parameter as shape-affecting —
+            correct for any build(), no cross-trial reuse unless
+            values collide. Pass an explicit tuple (often `()`) to
+            declare the rest runtime-only and unlock Trainer sharing;
+            runtime-only values are applied to a reused Trainer via
+            optax `inject_hyperparams` state (or `apply`).
+        apply: Optional callable(trainer, hp) applying runtime-only
+            hyperparameters to a REUSED warm Trainer. Default edits
+            `state.opt_state.hyperparams` entries matching hp names
+            (optax.inject_hyperparams).
+        retries: graftguard retry budget per segment (default:
+            `CLOUD_TPU_RETRIES`).
+        name: Sweep id stamped on every event (default "sweep").
+    """
+
+    def __init__(self, build, hyperparameters, objective, directory,
+                 oracle=None, scheduler=None, max_trials=None, epochs=1,
+                 seed=0, shape_keys=None, apply=None, retries=None,
+                 name="sweep"):
+        if oracle is None:
+            if max_trials is None:
+                raise ValueError("Pass an oracle or max_trials.")
+            oracle = schedulers_lib.RandomOracle(
+                hyperparameters, max_trials, seed=seed)
+        self.build = build
+        self.hyperparameters = hyperparameters
+        self.objective = objective
+        self.directory = str(directory)
+        self.oracle = oracle
+        self.scheduler = scheduler
+        self.epochs = int(epochs)
+        self.seed = int(seed)
+        self.shape_keys = (None if shape_keys is None
+                           else tuple(shape_keys))
+        self.apply = apply
+        self.retries = retries
+        self.name = str(name)
+
+        self.trials = []
+        self._by_id = {}
+        self._trainers = {}       # signature -> warm Trainer
+        self._warned_inert = set()
+        self._wall_s = 0.0
+        self._train_s = 0.0
+
+    # -- signatures / trainer cache -------------------------------------
+
+    def signature(self, hp):
+        """Stable identity of the compiled-shape-affecting values."""
+        keys = self.shape_keys
+        if keys is None:
+            keys = [n for n, p in hp.space.items()
+                    if getattr(p, "kind", None) != "fixed"]
+        sig = {k: hp.values[k] for k in sorted(keys) if k in hp.values}
+        return json.dumps(sig, sort_keys=True, default=repr)
+
+    def _apply_hp(self, trainer, hp):
+        """Applies runtime-only hyperparameters to a reused Trainer's
+        freshly initialized state. The default path targets optax
+        `inject_hyperparams`: those live in `opt_state.hyperparams`,
+        which the traced step reads as state — a host-side dict edit,
+        never a retrace."""
+        if self.apply is not None:
+            self.apply(trainer, hp)
+            return
+        applied = set()
+        state = getattr(trainer, "state", None)
+        hyperparams = getattr(getattr(state, "opt_state", None),
+                              "hyperparams", None)
+        if isinstance(hyperparams, dict):
+            import jax.numpy as jnp
+
+            for pname, value in hp.values.items():
+                if pname in hyperparams:
+                    old = hyperparams[pname]
+                    hyperparams[pname] = jnp.asarray(
+                        value, getattr(old, "dtype", None))
+                    applied.add(pname)
+        sig_keys = (set(hp.space) if self.shape_keys is None
+                    else set(self.shape_keys))
+        inert = [n for n, p in hp.space.items()
+                 if n not in sig_keys and n not in applied
+                 and getattr(p, "kind", None) != "fixed"]
+        for pname in inert:
+            if pname not in self._warned_inert:
+                self._warned_inert.add(pname)
+                logger.warning(
+                    "graftsweep: hyperparameter %r is neither a "
+                    "shape_key nor applied to the reused Trainer "
+                    "(no opt_state.hyperparams entry and no apply= "
+                    "hook) — its values have no effect on warm "
+                    "trials.", pname)
+
+    def _trainer_for(self, trial, sample_x):
+        """The signature's warm Trainer; builds it on first ask (the
+        cold trial). A reused Trainer gets fresh state from the
+        trial's seed — plain init calls on the host path, so the
+        instrumented compile census does not move — and keeps its
+        step executables (state is an argument; they close over model
+        and optimizer only)."""
+        trainer = self._trainers.get(trial.signature)
+        if trainer is None:
+            trainer = self.build(trial.hp.copy())
+            trainer.seed = trial.seed
+            self._trainers[trial.signature] = trainer
+            trial.cold = True
+            return trainer
+        trainer.state = None
+        trainer.seed = trial.seed
+        trainer.build(sample_x)
+        self._apply_hp(trainer, trial.hp)
+        return trainer
+
+    # -- segments --------------------------------------------------------
+
+    def _trial_dir(self, trial):
+        return os.path.join(self.directory, trial.trial_id)
+
+    def _run_segment(self, trial, rung, initial_epoch, epochs, x, y,
+                     sample_x, fit_kwargs):
+        """One supervised segment: [initial_epoch, epochs) under
+        graftguard, scored at its end. Returns the score, or None when
+        the trial FAILED (terminal; the complete event is emitted)."""
+        trainer = self._trainer_for(trial, sample_x)
+        reporter = _RungReporter(self, trial, rung)
+        kwargs = dict(fit_kwargs)
+        kwargs["callbacks"] = (tuple(kwargs.get("callbacks") or ())
+                               + (reporter,))
+        kwargs.setdefault("verbose", False)
+        kwargs.setdefault("warm_start", True)
+        cs0 = runtime.compile_stats()
+        t0 = time.monotonic()
+        error = None
+        with resilience.guard_scope() as guard:
+            try:
+                resilience.resilient_fit(
+                    trainer, directory=self._trial_dir(trial),
+                    retries=self.retries, x=x, y=y, epochs=epochs,
+                    initial_epoch=initial_epoch, history=trial.history,
+                    **kwargs)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as exc:  # noqa: BLE001 - trial isolation
+                error = exc
+            census = guard.stats()
+        cs1 = runtime.compile_stats()
+        wall = time.monotonic() - t0
+        trial.wall_s += wall
+        self._train_s += wall
+        trial.faults += census["faults"]
+        trial.retries += census["retries"]
+        trial.rollbacks += census["rollbacks"]
+        trial.resumes += census["resumes"]
+        if census["last_fault"]:
+            trial.fault_kinds.append(census["last_fault"])
+        new_traces = cs1["n_traces"] - cs0["n_traces"]
+        new_compiles = cs1["n_compiles"] - cs0["n_compiles"]
+        trial.new_traces += new_traces
+        trial.new_compiles += new_compiles
+        trial.compile_seconds += (cs1["compile_seconds"]
+                                  - cs0["compile_seconds"])
+        _count("cloud_tpu_sweep_faults_total", census["faults"])
+        _count("cloud_tpu_sweep_resumes_total", census["resumes"])
+        if census["faults"]:
+            _log_event({"event": "fault", "sweep": self.name,
+                        "trial": trial.trial_id, "rung": rung,
+                        "faults": census["faults"],
+                        "retries": census["retries"],
+                        "rollbacks": census["rollbacks"],
+                        "last_fault": census["last_fault"]})
+        if census["resumes"]:
+            _log_event({
+                "event": "resume", "sweep": self.name,
+                "trial": trial.trial_id, "rung": rung,
+                "resumes": census["resumes"],
+                "resume_latency_seconds":
+                    census["last_resume_latency_seconds"],
+                "new_traces": census["last_resume_new_traces"],
+                "new_compiles": census["last_resume_new_compiles"]})
+        if error is not None:
+            trial.error = "{}: {}".format(type(error).__name__, error)
+            logger.warning("graftsweep: trial %s failed terminally: %s",
+                           trial.trial_id, trial.error, exc_info=error)
+            self._finish(trial, SweepTrialStatus.FAILED)
+            return None
+        trial.epochs = epochs
+        score = self._score(trial)
+        if score is None:
+            trial.error = ("objective {!r} never appeared in the "
+                           "history (keys: {})".format(
+                               self.objective.name,
+                               sorted(trial.history)))
+            self._finish(trial, SweepTrialStatus.FAILED)
+            return None
+        trial.score = score
+        trial.rungs.append({"rung": rung, "budget_epochs": epochs,
+                            "score": score})
+        return score
+
+    def _score(self, trial):
+        values = trial.history.get(self.objective.name) or []
+        if not values:
+            return None
+        try:
+            return float(values[-1])
+        except (TypeError, ValueError):
+            return None
+
+    def _finish(self, trial, status):
+        trial.status = status
+        _count("cloud_tpu_sweep_trials_total")
+        if status == SweepTrialStatus.PRUNED:
+            _count("cloud_tpu_sweep_trials_pruned_total")
+        elif status == SweepTrialStatus.FAILED:
+            _count("cloud_tpu_sweep_trials_failed_total")
+        if not trial.cold and trial.new_compiles == 0:
+            _count("cloud_tpu_sweep_warm_trials_total")
+        payload = dict(trial.spec())
+        payload["event"] = "complete"
+        payload["sweep"] = self.name
+        _log_event(payload)
+
+    # -- the sweep loop --------------------------------------------------
+
+    def run(self, x=None, y=None, **fit_kwargs):
+        """Runs the sweep to drain; returns the result dict (also the
+        shape `collect --sweep` reconstructs from the event log).
+        Extra kwargs forward to every trial's fit (batch_size,
+        shuffle, steps_per_epoch, ...)."""
+        import jax
+
+        from cloud_tpu.analysis import chaos
+
+        t_start = time.monotonic()
+        plan = chaos.active_plan()
+        if plan is not None:
+            # Trial-local step counters restart at 0 every trial; the
+            # cumulative dispatch index makes `preempt@N` land at one
+            # deterministic point of the SWEEP, whichever trial covers
+            # it.
+            plan.set_step_mode("cumulative")
+        batch_size = fit_kwargs.get("batch_size", 32)
+        if hasattr(x, "shape") or isinstance(x, (dict, list, tuple)):
+            sample_x = jax.tree_util.tree_map(
+                lambda a: a[:batch_size], x)
+        else:
+            sample = next(iter(x))
+            sample_x = sample[0] if isinstance(sample, tuple) else sample
+
+        budgets = (list(self.scheduler.budgets) if self.scheduler
+                   else [self.epochs])
+        _log_event({
+            "event": "sweep_start", "sweep": self.name,
+            "oracle": getattr(self.oracle, "name",
+                              type(self.oracle).__name__),
+            "scheduler": (getattr(self.scheduler, "name", None)
+                          if self.scheduler else None),
+            "objective": {"name": self.objective.name,
+                          "direction": self.objective.direction},
+            "max_trials": getattr(self.oracle, "max_trials", None),
+            "budgets": budgets,
+            "directory": self.directory,
+            "space": {n: getattr(p, "kind", "?")
+                      for n, p in self.hyperparameters.space.items()},
+        })
+
+        index = 0
+        while True:
+            promo = (self.scheduler.next_promotion()
+                     if self.scheduler else None)
+            if promo is not None:
+                trial_id, rung = promo
+                self.scheduler.promote(trial_id, rung)
+                trial = self._by_id[trial_id]
+                budget = self.scheduler.budgets[rung]
+                start = self.scheduler.budgets[rung - 1]
+                _log_event({"event": "promote", "sweep": self.name,
+                            "trial": trial_id, "rung": rung,
+                            "budget_epochs": budget,
+                            "score": trial.score})
+                trial.status = SweepTrialStatus.RUNNING
+                score = self._run_segment(trial, rung, start, budget,
+                                          x, y, sample_x, fit_kwargs)
+                if score is not None:
+                    self.scheduler.report(trial_id, rung, score)
+                    if rung == self.scheduler.top_rung:
+                        self._finish(trial, SweepTrialStatus.COMPLETED)
+                    else:
+                        trial.status = SweepTrialStatus.PAUSED
+                continue
+            hp = self.oracle.propose(index)
+            if hp is None:
+                break
+            trial = SweepTrial(
+                index, "t{:04d}".format(index), hp,
+                seed=self.seed + index, signature=self.signature(hp))
+            index += 1
+            self.trials.append(trial)
+            self._by_id[trial.trial_id] = trial
+            budget = budgets[0]
+            _log_event({"event": "trial_start", "sweep": self.name,
+                        "trial": trial.trial_id, "hp": dict(hp.values),
+                        "seed": trial.seed,
+                        "signature": trial.signature,
+                        "rung": 0, "budget_epochs": budget})
+            score = self._run_segment(trial, 0, 0, budget, x, y,
+                                      sample_x, fit_kwargs)
+            if score is None:
+                continue
+            if self.scheduler is None:
+                self._finish(trial, SweepTrialStatus.COMPLETED)
+            else:
+                self.scheduler.report(trial.trial_id, 0, score)
+                if self.scheduler.top_rung == 0:
+                    self._finish(trial, SweepTrialStatus.COMPLETED)
+                else:
+                    trial.status = SweepTrialStatus.PAUSED
+
+        # Drain: paused trials that never earned a promotion are
+        # pruned — terminal, with the cutoff they lost to on record.
+        if self.scheduler is not None:
+            for trial_id, rung, score in self.scheduler.paused():
+                trial = self._by_id[trial_id]
+                if trial.status in SweepTrialStatus.TERMINAL:
+                    continue
+                _log_event({"event": "prune", "sweep": self.name,
+                            "trial": trial_id, "rung": rung,
+                            "score": score,
+                            "cutoff": self.scheduler.cutoff(rung)})
+                self._finish(trial, SweepTrialStatus.PRUNED)
+
+        self._wall_s = time.monotonic() - t_start
+        result = self.result()
+        _log_event({
+            "event": "sweep_complete", "sweep": self.name,
+            "trials": len(self.trials),
+            "statuses": result["statuses"],
+            "best": (result["best"] or {}).get("trial"),
+            "best_score": (result["best"] or {}).get("score"),
+            "census": result["census"],
+            "compile": result["compile"],
+            "wall_s": round(self._wall_s, 6),
+            "train_s": round(self._train_s, 6),
+        })
+        if result["best"] is not None:
+            _gauge("cloud_tpu_sweep_best_score",
+                   result["best"]["score"])
+        _gauge("cloud_tpu_sweep_compile_seconds",
+               result["compile"]["total_seconds"])
+        return result
+
+    # -- rollups ---------------------------------------------------------
+
+    def best_trial(self):
+        """Best terminal COMPLETED trial by the objective (falls back
+        to any scored trial when nothing completed)."""
+        scored = [t for t in self.trials
+                  if t.status == SweepTrialStatus.COMPLETED
+                  and t.score is not None]
+        if not scored:
+            scored = [t for t in self.trials if t.score is not None]
+        if not scored:
+            return None
+        best = (max if self.objective.direction == "max" else min)(
+            scored, key=lambda t: t.score)
+        return best
+
+    def result(self):
+        statuses = {}
+        for trial in self.trials:
+            statuses[trial.status] = statuses.get(trial.status, 0) + 1
+        fault_kind_census = {}
+        for trial in self.trials:
+            for kind in trial.fault_kinds:
+                fault_kind_census[kind] = (
+                    fault_kind_census.get(kind, 0) + 1)
+        cold = [t for t in self.trials if t.cold]
+        warm = [t for t in self.trials if not t.cold]
+        best = self.best_trial()
+        return {
+            "format": "cloud_tpu.sweep_result.v1",
+            "sweep": self.name,
+            "objective": {"name": self.objective.name,
+                          "direction": self.objective.direction},
+            "trials": [t.spec() for t in self.trials],
+            "statuses": statuses,
+            "best": best.spec() if best is not None else None,
+            "census": {
+                "faults": sum(t.faults for t in self.trials),
+                "retries": sum(t.retries for t in self.trials),
+                "rollbacks": sum(t.rollbacks for t in self.trials),
+                "resumes": sum(t.resumes for t in self.trials),
+                "by_kind": fault_kind_census,
+                "lost_trials": [
+                    t.trial_id for t in self.trials
+                    if t.status not in SweepTrialStatus.TERMINAL],
+            },
+            "compile": {
+                "cold_trials": len(cold),
+                "warm_trials": len(warm),
+                "cold_seconds": round(
+                    sum(t.compile_seconds for t in cold), 6),
+                "warm_seconds": round(
+                    sum(t.compile_seconds for t in warm), 6),
+                "warm_new_compiles": sum(t.new_compiles for t in warm),
+                "warm_new_traces": sum(t.new_traces for t in warm),
+                "total_seconds": round(
+                    sum(t.compile_seconds for t in self.trials), 6),
+            },
+            "wall_s": round(self._wall_s, 6),
+            "train_s": round(self._train_s, 6),
+        }
+
+
+__all__ = ["Sweep", "SweepTrial", "SweepTrialStatus"]

@@ -21,13 +21,40 @@ import json
 import logging
 import time
 
-from cloud_tpu.cloud_fit import client as cloud_fit_client
-from cloud_tpu.cloud_fit import remote as cloud_fit_remote
-from cloud_tpu.core import gcp
-from cloud_tpu.tuner import optimizer_client
 from cloud_tpu.tuner import utils as tuner_utils
-from cloud_tpu.utils import google_api_client
-from cloud_tpu.utils import storage
+
+# The GCP/cloud_fit/storage machinery (googleapiclient discovery, the
+# remote-trial channel, gs:// IO) is imported INSIDE the methods that
+# reach for it: importing this module — e.g. for `CloudOracle` with an
+# injected offline client, or from a local graftsweep process — must
+# never touch google-api plumbing or pull jax via cloud_fit.remote.
+#
+# `tuner.cloud_fit_client` etc. stay reachable as module attributes
+# (tests patch the seams through them) via PEP 562 — resolving one
+# imports only that dependency, on first touch.
+
+_LAZY_MODULES = {
+    "cloud_fit_client": ("cloud_tpu.cloud_fit", "client"),
+    "cloud_fit_remote": ("cloud_tpu.cloud_fit", "remote"),
+    "storage": ("cloud_tpu.utils", "storage"),
+    "gcp": ("cloud_tpu.core", "gcp"),
+    "google_api_client": ("cloud_tpu.utils", "google_api_client"),
+    "optimizer_client": ("cloud_tpu.tuner", "optimizer_client"),
+}
+
+
+def __getattr__(name):
+    try:
+        package, attr = _LAZY_MODULES[name]
+    except KeyError:
+        raise AttributeError(
+            "module {!r} has no attribute {!r}".format(__name__, name))
+    import importlib
+
+    module = getattr(importlib.import_module(package), attr)
+    globals()[name] = module
+    return module
+
 
 logger = logging.getLogger("cloud_tpu")
 
@@ -74,6 +101,8 @@ class CloudOracle:
             self.project_id = project_id
             self.region = region
         else:
+            from cloud_tpu.core import gcp
+
             self.project_id = project_id or gcp.get_project_name()
             self.region = region or gcp.get_region()
 
@@ -105,9 +134,14 @@ class CloudOracle:
         # Two injection seams: `service_client` fakes the REST transport
         # under the real OptimizerClient; `client` replaces the
         # OptimizerClient surface wholesale (offline demos, unit tests).
-        self.client = client or optimizer_client.create_or_load_study(
-            self.project_id, self.region, self.study_id, self.study_config,
-            service_client=service_client)
+        if client is not None:
+            self.client = client
+        else:
+            from cloud_tpu.tuner import optimizer_client
+
+            self.client = optimizer_client.create_or_load_study(
+                self.project_id, self.region, self.study_id,
+                self.study_config, service_client=service_client)
 
         self.trials = {}
         self._start_times = {}
@@ -272,6 +306,7 @@ class CloudTuner:
         with per-trial checkpoints (reference tuner.py:470-487,
         576-605)."""
         from cloud_tpu.training import callbacks as callbacks_lib
+        from cloud_tpu.utils import storage
 
         trainer = self.hypermodel(trial.hyperparameters)
         trial_dir = storage.join(self.directory, str(trial.trial_id))
@@ -354,6 +389,10 @@ class DistributingCloudTuner(CloudTuner):
         self._job_api_client = job_api_client
 
     def run_trial(self, trial, x=None, y=None, **fit_kwargs):
+        from cloud_tpu.cloud_fit import client as cloud_fit_client
+        from cloud_tpu.utils import google_api_client
+        from cloud_tpu.utils import storage
+
         trainer = self.hypermodel(trial.hyperparameters)
         trial_dir = storage.join(self.remote_dir, str(trial.trial_id))
         job_id = "{}_{}".format(self.oracle.study_id, trial.trial_id)
@@ -380,6 +419,9 @@ class DistributingCloudTuner(CloudTuner):
         return history
 
     def _get_remote_training_metrics(self, trial_dir):
+        from cloud_tpu.cloud_fit import remote as cloud_fit_remote
+        from cloud_tpu.utils import storage
+
         history_path = storage.join(trial_dir, cloud_fit_remote.OUTPUT_DIR,
                                     cloud_fit_remote.HISTORY_FILE)
         return json.loads(storage.read_bytes(history_path))
@@ -395,7 +437,10 @@ class DistributingCloudTuner(CloudTuner):
         """
         import pickle
 
+        from cloud_tpu.cloud_fit import client as cloud_fit_client
+        from cloud_tpu.cloud_fit import remote as cloud_fit_remote
         from cloud_tpu.training import checkpoint as checkpoint_lib
+        from cloud_tpu.utils import storage
 
         trial_dir = storage.join(self.remote_dir, str(trial.trial_id))
         spec = pickle.loads(storage.read_bytes(

@@ -118,6 +118,40 @@ class TestJobEventLog:
                          if r["payload"]["tag"] == tag)
             assert got == list(range(n_records))
 
+    def test_kind_filter_under_concurrent_multi_kind_writers(
+            self, tmp_path):
+        # The graftsweep supervisor, a reqtrace-enabled scheduler, and
+        # graftguard all share ONE job log in a chaos sweep. The
+        # per-kind readers (collect --sweep / --serve, the CI
+        # assertions) must each get exactly their own stream back,
+        # whole and ordered per writer, from the interleaved file.
+        import threading
+
+        path = str(tmp_path / "events.jsonl")
+        n_records = 40
+
+        def writer(kind):
+            for i in range(n_records):
+                events.log_job_event(
+                    kind, {"event": "e", "i": i}, path=path)
+
+        kinds = ("graftsweep", "reqtrace", "graftguard")
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in kinds]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        assert len(events.read_job_events(path)) == \
+            n_records * len(kinds)
+        for kind in kinds:
+            got = events.read_job_events(path, kind=kind)
+            assert [r["kind"] for r in got] == [kind] * n_records
+            # O_APPEND keeps each writer's own records in emit order.
+            assert [r["payload"]["i"] for r in got] == \
+                list(range(n_records))
+
     def test_corrupt_lines_skipped_with_one_warning(self, tmp_path,
                                                     caplog):
         # A writer that crashed mid-append leaves a torn line; readers

@@ -112,6 +112,38 @@ class TestBackoff:
             raw = min(30.0, 2.0 ** attempt)
             assert 0.5 * raw <= delay < raw
 
+    def test_attempt_zero_is_the_jittered_base(self):
+        rng = random.Random(1)
+        for _ in range(16):
+            delay = resilience.backoff_delay(0, base=2.0, cap=30.0,
+                                             rng=rng)
+            assert 1.0 <= delay < 2.0
+
+    def test_base_beyond_cap_clamps_immediately(self):
+        rng = random.Random(2)
+        delay = resilience.backoff_delay(0, base=100.0, cap=30.0,
+                                         rng=rng)
+        assert 15.0 <= delay < 30.0
+
+    def test_huge_attempt_saturates_instead_of_overflowing(self):
+        # 2.0**attempt overflows a float past attempt 1023; a retry
+        # loop gone wild must still get the cap, not an OverflowError.
+        rng = random.Random(3)
+        for attempt in (64, 1024, 10**6):
+            delay = resilience.backoff_delay(attempt, base=1.0,
+                                             cap=30.0, rng=rng)
+            assert 15.0 <= delay < 30.0
+
+    def test_same_seed_same_schedule(self):
+        delays_a = [resilience.backoff_delay(k, rng=random.Random(9))
+                    for k in range(4)]
+        delays_b = [resilience.backoff_delay(k, rng=random.Random(9))
+                    for k in range(4)]
+        assert delays_a == delays_b
+        assert delays_a != [resilience.backoff_delay(k,
+                                                     rng=random.Random(10))
+                            for k in range(4)]
+
 
 class TestCheckpointIntegrity:
     def _state(self):

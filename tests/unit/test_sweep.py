@@ -1,0 +1,624 @@
+"""graftsweep: oracles, ASHA promotion math, supervised trials.
+
+What's pinned here is the ISSUE 15 acceptance contract: trials are
+graftguard-supervised (a preempted trial RESUMES, bit-identical, and
+its fault census lands on the right trial row), same-signature trials
+share one warm Trainer (trial N>1 reports zero new traces/compiles),
+ASHA promotes/prunes by the online top-1/eta rule, and the JSONL event
+stream reconciles into `cloud_tpu.sweep_report.v1` with zero orphan
+trials. The full 12-trial chaos scenario runs in the sweep-chaos-smoke
+CI job; these tests pin the same invariants at unit scale.
+"""
+
+import json
+import math
+
+import numpy as np
+import optax
+import pytest
+
+from cloud_tpu.analysis import chaos
+from cloud_tpu.models import MLP
+from cloud_tpu.monitoring import collect
+from cloud_tpu.parallel import runtime
+from cloud_tpu.training import Trainer, resilience
+from cloud_tpu.tuner import (ASHA, GridOracle, HyperParameters,
+                             Objective, RandomOracle, Sweep)
+from cloud_tpu.tuner.sweep import SweepTrialStatus
+from cloud_tpu.utils import events as events_lib
+
+
+@pytest.fixture(autouse=True)
+def _sweep_isolation(monkeypatch):
+    """No chaos plan, guard counters, runtime state, or knob env leaks
+    between tests; backoff is zeroed so retries are instant."""
+    for key in ("CLOUD_TPU_CHAOS", "CLOUD_TPU_RETRIES",
+                "CLOUD_TPU_RESUME_DIR", "CLOUD_TPU_EVENT_LOG",
+                "CLOUD_TPU_WATCH"):
+        monkeypatch.delenv(key, raising=False)
+    monkeypatch.setenv("CLOUD_TPU_RETRY_BACKOFF", "0")
+    runtime.reset()
+    chaos.uninstall()
+    resilience.reset_guard_stats()
+    yield
+    chaos.uninstall()
+    resilience.reset_guard_stats()
+    runtime.reset()
+
+
+def _space():
+    hp = HyperParameters()
+    hp.Float("learning_rate", 1e-3, 1e-1, sampling="log")
+    return hp
+
+
+def _toy_data(n=32, d=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.integers(0, classes, size=n).astype(np.int32)
+    return x, y
+
+
+def _build(hp):
+    opt = optax.inject_hyperparams(optax.sgd)(
+        learning_rate=hp.get("learning_rate"))
+    return Trainer(MLP(hidden=8, num_classes=4), optimizer=opt, seed=3)
+
+
+# --------------------------------------------------------------------------
+# Oracles
+# --------------------------------------------------------------------------
+
+
+class TestRandomOracle:
+    def test_proposal_is_pure_function_of_seed_and_index(self):
+        a = RandomOracle(_space(), max_trials=8, seed=5)
+        b = RandomOracle(_space(), max_trials=8, seed=5)
+        for k in range(8):
+            assert a.propose(k).values == b.propose(k).values
+        # Re-asking the same index replays the same assignment — the
+        # bit-identity control leans on this.
+        assert a.propose(3).values == a.propose(3).values
+
+    def test_indices_draw_distinct_assignments(self):
+        oracle = RandomOracle(_space(), max_trials=8, seed=5)
+        values = {oracle.propose(k).values["learning_rate"]
+                  for k in range(8)}
+        assert len(values) == 8
+
+    def test_exhaustion_returns_none(self):
+        oracle = RandomOracle(_space(), max_trials=3)
+        assert oracle.propose(2) is not None
+        assert oracle.propose(3) is None
+
+    def test_rejects_empty_space_and_zero_budget(self):
+        with pytest.raises(ValueError, match="empty"):
+            RandomOracle(HyperParameters(), max_trials=3)
+        with pytest.raises(ValueError, match="max_trials"):
+            RandomOracle(_space(), max_trials=0)
+
+
+class TestGridOracle:
+    def test_full_product_last_axis_fastest(self):
+        hp = HyperParameters()
+        hp.Choice("units", [16, 32, 64])
+        hp.Boolean("bias")
+        oracle = GridOracle(hp)
+        assert oracle.max_trials == 6
+        seen = []
+        for k in range(6):
+            got = oracle.propose(k)
+            seen.append((got.values["units"], got.values["bias"]))
+        # Mixed-radix decode: the LAST registered axis cycles fastest.
+        assert seen == [(16, False), (16, True), (32, False),
+                        (32, True), (64, False), (64, True)]
+        assert oracle.propose(6) is None
+
+    def test_fixed_and_stepped_axes(self):
+        hp = HyperParameters()
+        hp.Fixed("depth", 2)
+        hp.Int("width", 8, 16, step=4)
+        hp.Float("dropout", 0.0, 0.2, step=0.1)
+        oracle = GridOracle(hp)
+        assert oracle.max_trials == 1 * 3 * 3
+        got = oracle.propose(0)
+        assert got.values == {"depth": 2, "width": 8, "dropout": 0.0}
+        widths = {oracle.propose(k).values["width"] for k in range(9)}
+        assert widths == {8, 12, 16}
+
+    def test_unstepped_float_has_no_finite_grid(self):
+        hp = HyperParameters()
+        hp.Float("learning_rate", 1e-3, 1e-1)
+        with pytest.raises(ValueError, match="step"):
+            GridOracle(hp)
+
+    def test_unstepped_int_enumerates_the_range(self):
+        hp = HyperParameters()
+        hp.Int("layers", 1, 4)
+        oracle = GridOracle(hp)
+        assert oracle.max_trials == 4
+        assert [oracle.propose(k).values["layers"]
+                for k in range(4)] == [1, 2, 3, 4]
+
+
+# --------------------------------------------------------------------------
+# ASHA
+# --------------------------------------------------------------------------
+
+
+class TestASHA:
+    def test_budget_ladder(self):
+        obj = Objective("loss", "min")
+        assert ASHA(obj, min_budget=1, eta=3).budgets == [1, 3, 9]
+        assert ASHA(obj, 1, 3, 27).budgets == [1, 3, 9, 27]
+        # A non-power max caps the top rung instead of overshooting.
+        assert ASHA(obj, 2, 3, 10).budgets == [2, 6, 10]
+        assert ASHA(obj, 1, 2, 2).budgets == [1, 2]
+        single = ASHA(obj, 4, 3, 4)
+        assert single.budgets == [4] and single.top_rung == 0
+
+    def test_rejects_bad_knobs(self):
+        obj = Objective("loss", "min")
+        with pytest.raises(ValueError, match="eta"):
+            ASHA(obj, eta=1)
+        with pytest.raises(ValueError, match="min_budget"):
+            ASHA(obj, min_budget=0)
+        with pytest.raises(ValueError, match="max_budget"):
+            ASHA(obj, min_budget=4, max_budget=2)
+
+    def test_online_promotion_rule(self):
+        sched = ASHA(Objective("loss", "min"), 1, 3, 9)
+        for i, score in enumerate([3.0, 1.0, 2.0]):
+            assert sched.next_promotion() is None
+            sched.report("t{}".format(i), 0, score)
+        # 3 reports at eta=3: quota 1 -> the best minimizer promotes.
+        assert sched.next_promotion() == ("t1", 1)
+        sched.promote("t1", 1)
+        # The quota is consumed until the rung holds 2*eta reports.
+        assert sched.next_promotion() is None
+        for i, score in enumerate([4.0, 0.5, 6.0]):
+            sched.report("u{}".format(i), 0, score)
+        # 6 reports: quota 2; the best UNPROMOTED of the top-2 wins.
+        assert sched.next_promotion() == ("u1", 1)
+
+    def test_direction_max_promotes_the_largest(self):
+        sched = ASHA(Objective("accuracy", "max"), 1, 2, 4)
+        sched.report("a", 0, 0.1)
+        sched.report("b", 0, 0.9)
+        assert sched.next_promotion() == ("b", 1)
+
+    def test_higher_rungs_promote_first(self):
+        # Near-finished trials finish before fresh rung-0 starts.
+        sched = ASHA(Objective("loss", "min"), 1, 2, 4)
+        for i in range(4):
+            sched.report("t{}".format(i), 0, float(i))
+        sched.promote("t0", 1)
+        sched.report("t0", 1, 0.0)
+        sched.promote("t1", 1)
+        sched.report("t1", 1, 1.0)
+        promo = sched.next_promotion()
+        assert promo == ("t0", 2)
+
+    def test_rereport_overwrites(self):
+        sched = ASHA(Objective("loss", "min"), 1, 3, 9)
+        sched.report("t0", 0, 5.0)
+        sched.report("t0", 0, 1.0)
+        assert sched.results[0]["t0"] == 1.0
+
+    def test_paused_and_cutoff(self):
+        sched = ASHA(Objective("loss", "min"), 1, 3, 9)
+        assert sched.cutoff(0) is None  # < eta reports: no bar yet
+        for i, score in enumerate([3.0, 1.0, 2.0]):
+            sched.report("t{}".format(i), 0, score)
+        assert sched.cutoff(0) == 1.0
+        sched.promote("t1", 1)
+        sched.report("t1", 1, 0.9)
+        # t0/t2 sit unpromoted at rung 0; t1 unpromoted at rung 1
+        # (below the top rung) — all three are prune candidates.
+        assert sched.paused() == [("t0", 0, 3.0), ("t1", 1, 0.9),
+                                  ("t2", 0, 2.0)]
+
+
+# --------------------------------------------------------------------------
+# guard_scope
+# --------------------------------------------------------------------------
+
+
+class TestGuardScope:
+    def test_deltas_are_isolated_from_prior_counters(self):
+        resilience._stats["faults"] += 5
+        resilience._stats["retries"] += 4
+        resilience._stats["last_fault"] = "preemption"
+        with resilience.guard_scope() as guard:
+            resilience._stats["faults"] += 2
+            resilience._stats["last_fault"] = "nan_loss"
+        stats = guard.stats()
+        assert stats["faults"] == 2
+        assert stats["retries"] == 0
+        assert stats["last_fault"] == "nan_loss"
+
+    def test_last_fields_none_when_scope_saw_nothing(self):
+        # A stale last_fault / resume latency from an EARLIER trial
+        # must not be attributed to a clean scope.
+        resilience._stats["faults"] += 1
+        resilience._stats["last_fault"] = "preemption"
+        resilience._stats["resumes"] += 1
+        resilience._stats["last_resume_latency_seconds"] = 1.5
+        with resilience.guard_scope() as guard:
+            pass
+        stats = guard.stats()
+        assert stats["faults"] == 0
+        assert stats["last_fault"] is None
+        assert stats["last_resume_latency_seconds"] is None
+
+    def test_resume_fields_survive_when_scope_resumed(self):
+        with resilience.guard_scope() as guard:
+            resilience._stats["resumes"] += 1
+            resilience._stats["last_resume_latency_seconds"] = 0.25
+            resilience._stats["last_resume_new_compiles"] = 0
+        stats = guard.stats()
+        assert stats["resumes"] == 1
+        assert stats["last_resume_latency_seconds"] == 0.25
+        assert stats["last_resume_new_compiles"] == 0
+
+    def test_mid_scope_read_is_live(self):
+        with resilience.guard_scope() as guard:
+            assert guard.stats()["faults"] == 0
+            resilience._stats["faults"] += 1
+            assert guard.stats()["faults"] == 1
+
+    def test_read_before_entry_raises(self):
+        guard = resilience.guard_scope()
+        with pytest.raises(RuntimeError, match="before entry"):
+            guard.stats()
+
+
+# --------------------------------------------------------------------------
+# Cumulative chaos step mode
+# --------------------------------------------------------------------------
+
+
+class TestChaosCumulativeStepMode:
+    def test_mode_validation(self):
+        plan = chaos.ChaosPlan.parse("preempt@5")
+        assert plan.step_mode == "global"
+        plan.set_step_mode("cumulative")
+        assert plan.step_mode == "cumulative"
+        with pytest.raises(ValueError, match="step_mode"):
+            plan.set_step_mode("per-trial")
+
+    def test_global_mode_honors_caller_step(self):
+        plan = chaos.ChaosPlan.parse("preempt@5")
+        plan.pre_dispatch(step=0, n_steps=3)   # [0, 3): not due
+        with pytest.raises(resilience.Preemption):
+            plan.pre_dispatch(step=5, n_steps=1)
+
+    def test_cumulative_mode_ignores_caller_step(self):
+        # Trial-local counters restart at 0 every trial; the plan's own
+        # dispatch index makes preempt@5 fire at the SWEEP's 5th-ish
+        # dispatch window no matter what step the caller reports.
+        plan = chaos.ChaosPlan.parse("preempt@5")
+        plan.set_step_mode("cumulative")
+        plan.pre_dispatch(step=999, n_steps=3)   # windows [0, 3)
+        plan.pre_dispatch(step=0, n_steps=2)     # windows [3, 5)
+        with pytest.raises(resilience.Preemption):
+            plan.pre_dispatch(step=999, n_steps=2)  # windows [5, 7)
+        assert plan.remaining() == []
+
+    def test_aborted_dispatch_still_claims_its_window(self):
+        # The injection aborts the dispatch, but the window advances
+        # anyway — a resume replaying the same dispatch sees a FRESH
+        # window, so the schedule is deterministic across re-entries.
+        plan = chaos.ChaosPlan.parse("preempt@1")
+        plan.set_step_mode("cumulative")
+        with pytest.raises(resilience.Preemption):
+            plan.pre_dispatch(step=0, n_steps=4)
+        assert plan._dispatched == 4
+        plan.pre_dispatch(step=0, n_steps=4)  # replay: nothing re-fires
+        assert plan._dispatched == 8
+        assert plan.remaining() == []
+
+
+# --------------------------------------------------------------------------
+# The engine
+# --------------------------------------------------------------------------
+
+
+class TestSweepEngine:
+    def test_random_search_shares_one_warm_trainer(self, tmp_path,
+                                                    monkeypatch):
+        log = str(tmp_path / "events.jsonl")
+        monkeypatch.setenv("CLOUD_TPU_EVENT_LOG", log)
+        x, y = _toy_data()
+        hp = _space()
+        sweep = Sweep(_build, hp, Objective("loss", "min"),
+                      directory=str(tmp_path / "sweep"),
+                      max_trials=3, epochs=1, seed=10,
+                      shape_keys=(), name="unit")
+        result = sweep.run(x, y, batch_size=16)
+
+        assert result["format"] == "cloud_tpu.sweep_result.v1"
+        assert result["statuses"] == {"COMPLETED": 3}
+        assert not result["census"]["lost_trials"]
+        # Signature sharing: one cold build, every later trial reuses
+        # the warm Trainer with ZERO new traces or compiles.
+        assert result["compile"]["cold_trials"] == 1
+        assert result["compile"]["warm_trials"] == 2
+        assert result["compile"]["warm_new_compiles"] == 0
+        assert result["compile"]["warm_new_traces"] == 0
+        # Distinct learning rates must yield distinct scores — pins
+        # that _apply_hp really lands on the reused opt_state.
+        scores = [t["score"] for t in result["trials"]]
+        assert len(set(scores)) == 3
+        assert result["best"]["score"] == min(scores)
+
+        records = events_lib.read_job_events(log, kind="graftsweep")
+        kinds = {}
+        for r in records:
+            e = r["payload"]["event"]
+            kinds[e] = kinds.get(e, 0) + 1
+        assert kinds["sweep_start"] == 1
+        assert kinds["trial_start"] == 3
+        assert kinds["rung_report"] == 3
+        assert kinds["complete"] == 3
+        assert kinds["sweep_complete"] == 1
+
+    def test_default_signature_treats_every_param_as_shape(self):
+        hp = _space()
+        hp.Fixed("depth", 2)
+        sweep = Sweep(_build, hp, Objective("loss", "min"),
+                      directory="unused", max_trials=2)
+        a = hp.random_sample(0)
+        b = hp.random_sample(1)
+        # Default (shape_keys=None): non-Fixed values key the
+        # signature, so different proposals never share a Trainer...
+        assert sweep.signature(a) != sweep.signature(b)
+        shared = Sweep(_build, hp, Objective("loss", "min"),
+                       directory="unused", max_trials=2,
+                       shape_keys=())
+        # ...while an explicit empty tuple declares them runtime-only.
+        assert shared.signature(a) == shared.signature(b)
+
+    def test_inert_hyperparameter_warns_once(self, tmp_path, caplog):
+        import logging
+
+        hp = _space()
+        hp.Boolean("use_magic")  # wired to nothing in _build
+
+        x, y = _toy_data()
+        sweep = Sweep(_build, hp, Objective("loss", "min"),
+                      directory=str(tmp_path / "sweep"),
+                      max_trials=3, seed=4, shape_keys=(),
+                      name="inert")
+        with caplog.at_level(logging.WARNING, logger="cloud_tpu"):
+            sweep.run(x, y, batch_size=16)
+        warned = [r for r in caplog.records
+                  if "use_magic" in r.getMessage()]
+        assert len(warned) == 1  # once per name, not per warm trial
+
+    def test_missing_objective_fails_the_trial_terminally(self,
+                                                          tmp_path):
+        x, y = _toy_data()
+        sweep = Sweep(_build, _space(), Objective("no_such_metric"),
+                      directory=str(tmp_path / "sweep"), max_trials=1)
+        result = sweep.run(x, y, batch_size=16)
+        assert result["statuses"] == {"FAILED": 1}
+        assert not result["census"]["lost_trials"]
+        (trial,) = result["trials"]
+        assert "no_such_metric" in trial["error"]
+        assert result["best"] is None
+
+
+class TestSweepChaosRecovery:
+    def test_preempted_trial_resumes_bit_identical(self, tmp_path):
+        # 4 trials, ASHA(1, 2, 4), batch 16 over 32 rows = 2 dispatch
+        # windows/epoch. The segment order is score-independent: 5
+        # rung-0/1 single-epoch segments cover windows 0-11, then the
+        # final rung-2 promotion (epochs 2->4) covers 12-15. preempt@12
+        # lands in that segment's FIRST epoch, so the resumed final
+        # epoch is clean and the score must not move a bit.
+        chaos.install("preempt@12")
+        x, y = _toy_data()
+        hp = _space()
+        obj = Objective("loss", "min")
+        sweep = Sweep(_build, hp, obj,
+                      directory=str(tmp_path / "sweep"),
+                      oracle=RandomOracle(hp, 4, seed=7),
+                      scheduler=ASHA(obj, 1, 2, 4),
+                      shape_keys=(), seed=20, name="chaos-unit")
+        result = sweep.run(x, y, batch_size=16)
+
+        assert not chaos.active_plan().remaining()
+        assert result["statuses"] == {"COMPLETED": 1, "PRUNED": 3}
+        assert not result["census"]["lost_trials"]
+        assert result["census"]["faults"] == 1
+        assert result["census"]["resumes"] == 1
+        assert result["census"]["by_kind"] == {"preemption": 1}
+        assert result["compile"]["warm_new_compiles"] == 0
+        (faulted,) = [t for t in result["trials"] if t["faults"]]
+        assert faulted["status"] == "COMPLETED"
+        assert faulted["fault_kinds"] == ["preemption"]
+
+        # Control: replay the faulted trial's exact rung schedule from
+        # its recorded (hp, seed), no chaos.
+        chaos.install(None)
+        resilience.reset_guard_stats()
+        ctrl_hp = hp.copy()
+        ctrl_hp.values.update(faulted["hp"])
+        ctrl = _build(ctrl_hp)
+        ctrl.seed = faulted["seed"]
+        budgets, prev, history = [1, 2, 4], 0, {}
+        for rung in [r["rung"] for r in faulted["rungs"]]:
+            resilience.resilient_fit(
+                ctrl, directory=str(tmp_path / "ctrl"), x=x, y=y,
+                batch_size=16, epochs=budgets[rung],
+                initial_epoch=prev, history=history, verbose=False,
+                warm_start=True)
+            prev = budgets[rung]
+        assert float(history["loss"][-1]) == faulted["score"]
+
+    def test_nan_rolls_back_and_the_trial_still_completes(self,
+                                                          tmp_path):
+        chaos.install("nan@2")
+        x, y = _toy_data()
+        sweep = Sweep(_build, _space(), Objective("loss", "min"),
+                      directory=str(tmp_path / "sweep"),
+                      max_trials=2, epochs=2, seed=8,
+                      shape_keys=(), name="nan-unit")
+        result = sweep.run(x, y, batch_size=16)
+        assert result["statuses"] == {"COMPLETED": 2}
+        assert result["census"]["by_kind"] == {"nan_loss": 1}
+        assert result["census"]["rollbacks"] == 1
+        (faulted,) = [t for t in result["trials"] if t["faults"]]
+        assert faulted["trial"] == "t0000"  # window 2 = t0's epoch 1
+        assert math.isfinite(faulted["score"])
+
+
+# --------------------------------------------------------------------------
+# collect --sweep report
+# --------------------------------------------------------------------------
+
+
+def _emit(path, payload):
+    events_lib.log_job_event("graftsweep", payload, path=path)
+
+
+def _seed_log(path, with_orphan=False):
+    _emit(path, {"event": "sweep_start", "sweep": "s",
+                 "oracle": "random", "scheduler": "asha",
+                 "objective": {"name": "loss", "direction": "min"},
+                 "max_trials": 2, "budgets": [1, 3],
+                 "directory": "/tmp/s"})
+    for trial, score, cold in (("t0000", 1.0, True),
+                               ("t0001", 2.0, False)):
+        _emit(path, {"event": "trial_start", "sweep": "s",
+                     "trial": trial, "rung": 0, "budget_epochs": 1})
+        _emit(path, {"event": "rung_report", "sweep": "s",
+                     "trial": trial, "rung": 0, "epoch": 0,
+                     "score": score})
+    _emit(path, {"event": "promote", "sweep": "s", "trial": "t0000",
+                 "rung": 1, "budget_epochs": 3, "score": 1.0})
+    _emit(path, {"event": "fault", "sweep": "s", "trial": "t0000",
+                 "rung": 1, "faults": 1, "retries": 1, "rollbacks": 0,
+                 "last_fault": "preemption"})
+    _emit(path, {"event": "resume", "sweep": "s", "trial": "t0000",
+                 "rung": 1, "resumes": 1,
+                 "resume_latency_seconds": 0.5, "new_traces": 0,
+                 "new_compiles": 0})
+    _emit(path, {"event": "complete", "sweep": "s", "trial": "t0000",
+                 "status": "COMPLETED", "score": 0.9,
+                 "hp": {"learning_rate": 0.01}, "seed": 20,
+                 "cold": True, "faults": 1, "retries": 1,
+                 "rollbacks": 0, "resumes": 1,
+                 "fault_kinds": ["preemption"], "new_traces": 2,
+                 "new_compiles": 1, "compile_seconds": 1.25,
+                 "rungs": [{"rung": 0}, {"rung": 1}]})
+    _emit(path, {"event": "prune", "sweep": "s", "trial": "t0001",
+                 "rung": 0, "score": 2.0, "cutoff": 1.0})
+    _emit(path, {"event": "complete", "sweep": "s", "trial": "t0001",
+                 "status": "PRUNED", "score": 2.0, "cold": False,
+                 "faults": 0, "retries": 0, "rollbacks": 0,
+                 "resumes": 0, "fault_kinds": [], "new_traces": 0,
+                 "new_compiles": 0, "compile_seconds": 0.0})
+    if with_orphan:
+        _emit(path, {"event": "trial_start", "sweep": "s",
+                     "trial": "t0002", "rung": 0, "budget_epochs": 1})
+    _emit(path, {"event": "sweep_complete", "sweep": "s", "trials": 2,
+                 "wall_s": 10.0, "train_s": 8.5})
+
+
+class TestSweepReport:
+    def _report(self, path):
+        by_process, corrupt = collect.load_process_records([path])
+        assert not corrupt
+        return collect.sweep_report(collect.sweep_events(by_process))
+
+    def test_schema_and_reconciliation(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        _seed_log(path)
+        report = self._report(path)
+        assert report["format"] == "cloud_tpu.sweep_report.v1"
+        (sw,) = report["sweeps"]
+        assert sw["sweep"] == "s"
+        assert sw["oracle"] == "random"
+        assert sw["budgets"] == [1, 3]
+        assert sw["complete"] is True
+        assert sw["orphans"] == []
+        assert sw["statuses"] == {"COMPLETED": 1, "PRUNED": 1}
+        assert sw["best"]["trial"] == "t0000"
+        assert sw["best"]["score"] == 0.9
+        assert sw["census"] == {"faults": 1, "retries": 1,
+                                "rollbacks": 0, "resumes": 1,
+                                "by_kind": {"preemption": 1}}
+        assert sw["compile"]["cold_trials"] == 1
+        assert sw["compile"]["warm_trials"] == 1
+        assert sw["compile"]["warm_new_compiles"] == 0
+        assert sw["wall"] == {"sweep_s": 10.0, "train_s": 8.5,
+                              "overhead_s": 1.5}
+        # Reconciliation: per-trial rows carry the lifecycle counts
+        # observed in the raw stream, so report and log can't drift.
+        rows = {t["trial"]: t for t in sw["trials"]}
+        assert rows["t0000"]["events"] == {"rung_report": 1,
+                                           "promote": 1, "fault": 1,
+                                           "resume": 1}
+        assert rows["t0001"]["events"] == {"rung_report": 1,
+                                           "prune": 1}
+
+    def test_orphan_detection(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        _seed_log(path, with_orphan=True)
+        report = self._report(path)
+        (sw,) = report["sweeps"]
+        assert sw["orphans"] == ["t0002"]
+        assert sw["statuses"]["ORPHANED"] == 1
+        # An orphan never competes for best.
+        assert sw["best"]["trial"] == "t0000"
+
+    def test_direction_max_flips_best(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        _emit(path, {"event": "sweep_start", "sweep": "m",
+                     "objective": {"name": "accuracy",
+                                   "direction": "max"}})
+        for trial, score in (("t0000", 0.4), ("t0001", 0.8)):
+            _emit(path, {"event": "trial_start", "sweep": "m",
+                         "trial": trial})
+            _emit(path, {"event": "complete", "sweep": "m",
+                         "trial": trial, "status": "COMPLETED",
+                         "score": score, "cold": trial == "t0000"})
+        report = self._report(path)
+        (sw,) = report["sweeps"]
+        assert sw["best"]["trial"] == "t0001"
+
+    def test_collect_pass_writes_the_report_file(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        _seed_log(path)
+        out = str(tmp_path / "fleet")
+        report = collect.collect([path], out, sweep=True)
+        assert report["sweep"] == {
+            "sweeps": 1, "trials": 2, "orphans": 0, "faults": 1,
+            "best": [{"trial": "t0000", "score": 0.9,
+                      "hp": {"learning_rate": 0.01}, "seed": 20,
+                      "rungs": [{"rung": 0}, {"rung": 1}]}]}
+        with open(report["outputs"]["sweep_report"]) as f:
+            on_disk = json.load(f)
+        assert on_disk["format"] == "cloud_tpu.sweep_report.v1"
+
+    def test_kind_filter_ignores_foreign_streams(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        events_lib.log_job_event("graftguard", {"event": "fault"},
+                                 path=path)
+        _seed_log(path)
+        events_lib.log_job_event("reqtrace", {"event": "submitted"},
+                                 path=path)
+        report = self._report(path)
+        (sw,) = report["sweeps"]
+        assert len(sw["trials"]) == 2
+        assert sw["census"]["faults"] == 1  # graftguard row not counted
+
+
+def test_sweep_names_resolve_from_the_package_root():
+    import cloud_tpu
+
+    assert cloud_tpu.Sweep is Sweep
+    assert cloud_tpu.ASHA is ASHA
+    assert cloud_tpu.RandomOracle is RandomOracle
