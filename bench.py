@@ -955,6 +955,12 @@ def _serve_worker():
             "paged_attention"]["flops"],
         "paged_attention_bytes_per_tick": kernel_costs[
             "paged_attention"]["bytes_moved"],
+        # Chunked-prefill A/B fields (ISSUE 16): chunk size 0 = off;
+        # the dispatch count and decode-gap tail make a chunked record
+        # self-describing next to an unchunked one.
+        "prefill_chunk": stats["prefill_chunk_size"],
+        "prefill_chunks_dispatched": stats["prefill_chunks_dispatched"],
+        "decode_gap_p99_s": _pct(stats["decode_gap"], "p99"),
         # graftshare census: hit/miss TTFT split + cache effectiveness.
         # Hit percentiles are None at prefix_share=0 (empty histogram).
         "prefix_share": prefix_share,
@@ -1095,6 +1101,9 @@ def _serve_load_worker():
         "prefix_hit_rate": round(stats["prefix_hit_rate"], 4),
         "queue_wait_p95_s": _pct(stats["queue_wait"], "p95"),
         "reserve_wait_p95_s": _pct(stats["reserve_wait"], "p95"),
+        "prefill_chunk": stats["prefill_chunk_size"],
+        "prefill_chunks_dispatched": stats["prefill_chunks_dispatched"],
+        "decode_gap_p99_s": _pct(stats["decode_gap"], "p99"),
         "ticks": stats["ticks"],
         "new_traces_post_warmup": after["n_traces"] - warm["n_traces"],
         "new_compiles_post_warmup": (after["n_compiles"]

@@ -373,33 +373,55 @@ _GATE_ACTIVATIONS = {
 }
 
 
+class _DenseKernel(nn.Module):
+    """Bare kernel-param holder: creates `<name>/kernel` exactly where
+    `nn.Dense(use_bias=False)` would — same path, shape, param dtype,
+    and initializer, so the param tree, checkpoints, AND path-derived
+    init rng are unchanged when a fused op consumes the weight
+    directly instead of calling the Dense module."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features):
+        return self.param("kernel",
+                          nn.linear.default_kernel_init,
+                          (in_features, self.features), jnp.float32)
+
+
 class SwiGLU(nn.Module):
     """Gated MLP: down(act(gate(x)) * up(x)), all bias-free.
 
     activation selects the gate nonlinearity: "silu" (the SwiGLU
     proper, Llama/Mistral/Qwen) or "gelu_tanh"/"gelu" (GeGLU, the
-    Gemma family).
+    Gemma family). The tail runs through `ops.fused_swiglu` — a
+    single-VMEM-pass Pallas kernel on TPU, the bitwise lax reference
+    elsewhere (`impl` follows the block's `attention_impl`,
+    `CLOUD_TPU_FUSED_MLP` overriding) — with the gate/up/down kernel
+    params exactly where the three `nn.Dense` modules kept them.
     """
 
     d_ff: int
     compute_dtype: jnp.dtype = jnp.bfloat16
     activation: str = "silu"
+    impl: str = "auto"
 
     @nn.compact
     def __call__(self, x):
-        try:
-            act = _GATE_ACTIVATIONS[self.activation]
-        except KeyError:
+        if self.activation not in _GATE_ACTIVATIONS:
             raise ValueError(
                 "Unknown mlp activation {!r}; expected one of {}."
                 .format(self.activation, sorted(_GATE_ACTIVATIONS)))
-        gate = nn.Dense(self.d_ff, use_bias=False,
-                        dtype=self.compute_dtype, name="gate")(x)
-        up = nn.Dense(self.d_ff, use_bias=False,
-                      dtype=self.compute_dtype, name="up")(x)
-        return nn.Dense(x.shape[-1], use_bias=False,
-                        dtype=self.compute_dtype,
-                        name="down")(act(gate) * up)
+        from cloud_tpu.ops import fused_swiglu
+        features = x.shape[-1]
+        w_gate = _DenseKernel(self.d_ff, name="gate")(features)
+        w_up = _DenseKernel(self.d_ff, name="up")(features)
+        w_down = _DenseKernel(features, name="down")(self.d_ff)
+        impl = "reference" if self.impl == "reference" else "auto"
+        return fused_swiglu(x, w_gate, w_up, w_down,
+                            activation=self.activation,
+                            compute_dtype=self.compute_dtype,
+                            impl=impl)
 
 
 class FusedRMSNorm(nn.Module):
@@ -511,7 +533,8 @@ class LlamaBlock(nn.Module):
                      reduce_fn=lambda a, b: a + b, init_fn=lambda: 0.0)
         else:
             y = SwiGLU(self.d_ff, self.compute_dtype,
-                       activation=self.mlp_activation, name="mlp")(y)
+                       activation=self.mlp_activation,
+                       impl=self.attention_impl, name="mlp")(y)
         if self.post_norms:
             y = norm("norm_mlp_post")(y)
         if self.dropout_rate:

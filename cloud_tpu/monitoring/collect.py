@@ -473,6 +473,18 @@ def _summarize_request(events):
     if requeues:
         summary["requeues"] = requeues
     summary["chaos"] = bool(faults or requeues)
+    # Chunked prefill: per-chunk dispatch events tile INSIDE the
+    # (pages_reserved, prefill] phase — they are sub-phase detail, not
+    # lifecycle boundaries, so the boundary tiling (and its telescoping
+    # residual check) is untouched by their presence.
+    chunk_events = [e for e in events if e["event"] == "prefill_chunk"]
+    if chunk_events:
+        summary["prefill_chunks"] = max(
+            int(e.get("n", 0)) for e in chunk_events)
+        summary["prefill_chunk_dispatches"] = len(chunk_events)
+        summary["prefill_chunk_tokens"] = sum(
+            int(e.get("tokens", 0)) for e in chunk_events)
+    summary["chunked"] = bool(chunk_events)
     present = [(name, first[name]["_monotonic"])
                for name in _BOUNDARIES if name in first]
     phases = {}
@@ -597,6 +609,43 @@ def serve_report(lifecycles, globals_=(), slo_ttft=None, slo_tpot=None):
                                   if e["event"] == "prefix_evict"),
         "per_request": requests,
     }
+    # Chunked-prefill census: who prefilled in chunks, how many, and
+    # the prefill-phase cost per class — the A/B surface for the
+    # interleave (chunked prefills SHOULD cost more wall time
+    # end-to-end; the win shows up in decode_by_prompt_len below).
+    chunked_rows = [r for r in completed if r.get("chunked")]
+    unchunked_rows = [r for r in completed if not r.get("chunked")]
+    report["prefill_chunks"] = {
+        "chunked_requests": len(chunked_rows),
+        "unchunked_requests": len(unchunked_rows),
+        "chunk_dispatches": sum(
+            r.get("prefill_chunk_dispatches", 0) for r in rows),
+        "chunks_per_request": _pcts(
+            [r.get("prefill_chunks") for r in chunked_rows]),
+        "prefill_dur": {
+            "chunked": _pcts(
+                [r.get("prefill_dur_s") for r in chunked_rows]),
+            "unchunked": _pcts(
+                [r.get("prefill_dur_s") for r in unchunked_rows]),
+        },
+    }
+    # Decode p99 vs prompt length: per-request TPOT percentiles in
+    # pow2 prompt buckets. Without chunking, SHORT-prompt requests
+    # resident while a long prompt prefills eat the stall — their
+    # bucket's p99 blows up; with chunking every bucket stays near the
+    # tick time. This section is where that shows.
+    by_prompt = {}
+    for row in completed:
+        plen = row.get("prompt_len")
+        if not plen or row.get("tpot_s") is None:
+            continue
+        bucket = 1
+        while bucket < plen:
+            bucket *= 2
+        by_prompt.setdefault(bucket, []).append(row["tpot_s"])
+    report["decode_by_prompt_len"] = {
+        str(bucket): _pcts(vals)
+        for bucket, vals in sorted(by_prompt.items())}
     # graftstorm: fault/requeue/shed census + goodput-under-chaos. A
     # chaos row saw >= 1 slot_fault or requeue; its goodput shows the
     # recovery-path tax relative to untouched (clean) requests.
@@ -854,6 +903,7 @@ def collect(inputs, out_dir, serve=False, slo_ttft=None, slo_tpot=None,
         report["serve"] = {
             "requests": sreport["requests"],
             "goodput": sreport["goodput"],
+            "prefill_chunks": sreport["prefill_chunks"],
         }
 
     if sweep:
@@ -934,6 +984,12 @@ def main(argv=None):
               "orphaned, goodput {}".format(
                   reqs["submitted"], reqs["completed"], reqs["failed"],
                   reqs["orphaned"], serve["goodput"]["overall"]))
+        chunks = serve.get("prefill_chunks") or {}
+        if chunks.get("chunk_dispatches"):
+            print("serve: chunked prefill on {} request(s) "
+                  "({} chunk dispatch(es))".format(
+                      chunks["chunked_requests"],
+                      chunks["chunk_dispatches"]))
     sweep = report.get("sweep")
     if sweep is not None:
         best = [b for b in sweep["best"] if b]

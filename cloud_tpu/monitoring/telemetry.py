@@ -118,6 +118,17 @@ SERVE_PREDICTED_TTFT = "cloud_tpu_serve_predicted_ttft"
 #: needs a live prefill estimate even when telemetry export is off.
 SERVE_PREFILL_HISTOGRAM = "cloud_tpu_serve_prefill_seconds"
 
+#: Chunked prefill (ROADMAP item 4 tail). Per-CHUNK prefill latency
+#: replaces the whole-prefill p50 in the admission model when chunking
+#: is on; the decode-gap histogram is the tick-to-tick commit interval
+#: active slots actually experience (the p99 the interleave protects —
+#: tick COMPUTE time alone cannot see a stalled tick loop). The pages
+#: gauge counts pages reserved for prefills still in flight.
+SERVE_PREFILL_CHUNK_HISTOGRAM = "cloud_tpu_serve_prefill_chunk_seconds"
+SERVE_PREFILL_CHUNKS_TOTAL = "cloud_tpu_serve_prefill_chunks_total"
+SERVE_DECODE_GAP_HISTOGRAM = "cloud_tpu_serve_decode_gap_seconds"
+SERVE_PAGES_PREFILLING = "cloud_tpu_serve_pages_prefilling"
+
 #: graftsweep (tuner/sweep.py) names. Counters accrue across every
 #: sweep a process runs; the gauges hold the LATEST sweep's values.
 #: `_warm_trials_total` counts reused-Trainer trials that finished

@@ -5,6 +5,8 @@ from cloud_tpu.ops.attention import flash_attention
 from cloud_tpu.ops.attention import mha_reference
 from cloud_tpu.ops.fused_ce import lm_head_loss
 from cloud_tpu.ops.fused_ce import lm_head_loss_reference
+from cloud_tpu.ops.fused_mlp import fused_swiglu
+from cloud_tpu.ops.fused_mlp import swiglu_reference
 from cloud_tpu.ops.fused_norm import fused_rmsnorm
 from cloud_tpu.ops.fused_norm import rmsnorm_residual_reference
 from cloud_tpu.ops.paged_attention import paged_attention
@@ -14,6 +16,7 @@ from cloud_tpu.ops.paged_attention import paged_decode_attention
 
 __all__ = ["attention", "flash_attention", "mha_reference",
            "lm_head_loss", "lm_head_loss_reference",
+           "fused_swiglu", "swiglu_reference",
            "fused_rmsnorm", "rmsnorm_residual_reference",
            "paged_attention", "paged_attention_cost",
            "paged_attention_reference", "paged_decode_attention"]

@@ -1,0 +1,315 @@
+"""Fused SwiGLU MLP tail as a Pallas TPU kernel.
+
+The gated MLP `down(act(gate(x)) * up(x))` is the last unfused hot op
+in the Llama block: written as three `nn.Dense` calls it materializes
+the two `[rows, d_ff]` projections and the gated product in HBM between
+matmuls. This kernel streams a row block through VMEM once — both input
+projections, the gate nonlinearity, the elementwise product, and the
+down projection happen per block with the three weight matrices held
+resident — so the `[rows, d_ff]` intermediates never touch HBM.
+
+Numerics mirror the flax module exactly: inputs and kernels are cast to
+the compute dtype (flax `promote_dtype` with `dtype=compute_dtype`),
+each projection is a plain `lax.dot_general` with default precision,
+and the activation runs on the projected compute-dtype values — so
+swapping the unfused SwiGLU for this op is bitwise in f32 and
+tolerance-level in bf16 (same rounding points, blocked rows don't
+change a row's reduction).
+
+Backward is `jax.custom_vjp` with the standard gated-MLP gradient in
+f32 from the saved (x, weights): dh = dy@Wd^T, du = dh*act(g),
+da = dh*u, dg via the activation's own vjp, dx = dg@Wg^T + du@Wu^T,
+and the three kernel grads from the corresponding outer products. The
+backward runs as plain lax — decode never differentiates, and the
+single-pass claim is for the forward serving/training hot path.
+
+On non-TPU backends a forced kernel runs in Pallas interpret mode, so
+parity tests exercise the same code path CPU-side.
+"""
+
+import functools
+import os
+import types
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK_ROWS = 128
+
+# Mirrors llama._GATE_ACTIVATIONS (ops must not import models); flax
+# nn.silu/nn.gelu ARE jax.nn.silu/jax.nn.gelu, so the reference stays
+# math-for-math the module. Immutable: traced functions bake the
+# lookup in at trace time, so the table must never change underneath
+# a warm executable.
+_ACTIVATIONS = types.MappingProxyType({
+    "silu": jax.nn.silu,
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+})
+
+
+class _MLPConfig(NamedTuple):
+    activation: str
+    block_rows: int
+    out_dtype: str   # dtype name (hashable for the custom_vjp config)
+    interpret: bool
+
+
+def _contract(x, w):
+    """The exact `nn.Dense(use_bias=False)` contraction: last axis of x
+    against axis 0 of w, default precision."""
+    return jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())))
+
+
+def swiglu_reference(x, w_gate, w_up, w_down, activation="silu",
+                     compute_dtype=None):
+    """Pure-lax gated MLP: down(act(gate(x)) * up(x)).
+
+    Math-for-math the flax SwiGLU module (three bias-free `nn.Dense`
+    with `dtype=compute_dtype`): everything is cast to `compute_dtype`
+    up front (flax `promote_dtype` semantics; the promoted type of
+    x/w_gate when None), then three default-precision dot_generals with
+    the activation on the projected values.
+    """
+    try:
+        act = _ACTIVATIONS[activation]
+    except KeyError:
+        raise ValueError(
+            "Unknown mlp activation {!r}; expected one of {}.".format(
+                activation, sorted(_ACTIVATIONS)))
+    if compute_dtype is None:
+        compute_dtype = jnp.promote_types(x.dtype, w_gate.dtype)
+    x = x.astype(compute_dtype)
+    g = _contract(x, w_gate.astype(compute_dtype))
+    u = _contract(x, w_up.astype(compute_dtype))
+    return _contract(act(g) * u, w_down.astype(compute_dtype))
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(x_ref, wg_ref, wu_ref, wd_ref, o_ref, *, config):
+    """One row block: both projections, the gated product, and the down
+    projection — one VMEM pass, weights resident across the grid."""
+    act = _ACTIVATIONS[config.activation]
+    x = x_ref[...]
+    g = jnp.dot(x, wg_ref[...])
+    u = jnp.dot(x, wu_ref[...])
+    o_ref[...] = jnp.dot(act(g) * u, wd_ref[...]).astype(o_ref.dtype)
+
+
+def _swiglu_forward(config, x, w_gate, w_up, w_down):
+    """x: [rows, D] (row-padded, compute dtype); weights compute dtype
+    -> [rows, D_out] out_dtype."""
+    rows, features = x.shape
+    d_ff = w_gate.shape[1]
+    d_out = w_down.shape[1]
+    block = config.block_rows
+    grid = (rows // block,)
+    kernel = functools.partial(_fwd_kernel, config=config)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block, features), lambda i: (i, 0)),
+            pl.BlockSpec((features, d_ff), lambda i: (0, 0)),
+            pl.BlockSpec((features, d_ff), lambda i: (0, 0)),
+            pl.BlockSpec((d_ff, d_out), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, d_out), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, d_out),
+                                       jnp.dtype(config.out_dtype)),
+        interpret=config.interpret,
+    )(x, w_gate, w_up, w_down)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_swiglu(config, x, w_gate, w_up, w_down):
+    return _swiglu_forward(config, x, w_gate, w_up, w_down)
+
+
+def _fused_swiglu_fwd(config, x, w_gate, w_up, w_down):
+    out = _swiglu_forward(config, x, w_gate, w_up, w_down)
+    return out, (x, w_gate, w_up, w_down)
+
+
+def _fused_swiglu_bwd(config, residuals, dy):
+    x, w_gate, w_up, w_down = residuals
+    act = _ACTIVATIONS[config.activation]
+    xf = x.astype(jnp.float32)
+    wgf = w_gate.astype(jnp.float32)
+    wuf = w_up.astype(jnp.float32)
+    wdf = w_down.astype(jnp.float32)
+    g = xf @ wgf
+    u = xf @ wuf
+    a, act_vjp = jax.vjp(act, g)
+    dyf = dy.astype(jnp.float32)
+    dh = dyf @ wdf.T
+    dwd = (a * u).T @ dyf
+    du = dh * a
+    da = dh * u
+    dg = act_vjp(da)[0]
+    dx = dg @ wgf.T + du @ wuf.T
+    dwg = xf.T @ dg
+    dwu = xf.T @ du
+    return (dx.astype(x.dtype), dwg.astype(w_gate.dtype),
+            dwu.astype(w_up.dtype), dwd.astype(w_down.dtype))
+
+
+_fused_swiglu.defvjp(_fused_swiglu_fwd, _fused_swiglu_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def fused_swiglu(x, w_gate, w_up, w_down, activation="silu",
+                 compute_dtype=None, impl="auto",
+                 interpret: Optional[bool] = None, block_rows=None):
+    """Dispatching fused SwiGLU tail: down(act(gate(x)) * up(x)).
+
+    x: [..., D]; w_gate/w_up: [D, F]; w_down: [F, D_out] (the bare
+    `kernel` params of the three bias-free Dense projections, any
+    param dtype — cast to `compute_dtype` here, flax-style).
+
+    impl: "fused" forces the Pallas kernel, "reference" the lax path;
+    "auto" picks the kernel on TPU, the reference elsewhere. The
+    `CLOUD_TPU_FUSED_MLP` env var ("1"/"0") is the deployment A/B
+    override and beats `impl`; a forced kernel runs in interpret mode
+    off-TPU. Differentiable w.r.t. x and all three weights either way.
+    """
+    features = x.shape[-1]
+    if w_gate.ndim != 2 or w_gate.shape[0] != features:
+        raise ValueError(
+            "w_gate must be [features={}, d_ff]; got {}.".format(
+                features, w_gate.shape))
+    if w_up.shape != w_gate.shape:
+        raise ValueError(
+            "w_up must match w_gate's shape {}; got {}.".format(
+                w_gate.shape, w_up.shape))
+    if w_down.ndim != 2 or w_down.shape[0] != w_gate.shape[1]:
+        raise ValueError(
+            "w_down must be [d_ff={}, d_out]; got {}.".format(
+                w_gate.shape[1], w_down.shape))
+    env = os.environ.get("CLOUD_TPU_FUSED_MLP", "").strip()
+    if env == "1":
+        use_kernel = True
+    elif env == "0":
+        use_kernel = False
+    elif impl == "fused":
+        use_kernel = True
+    elif impl == "reference":
+        use_kernel = False
+    else:
+        use_kernel = jax.default_backend() == "tpu"
+    if not use_kernel:
+        return swiglu_reference(x, w_gate, w_up, w_down,
+                                activation=activation,
+                                compute_dtype=compute_dtype)
+
+    if activation not in _ACTIVATIONS:
+        raise ValueError(
+            "Unknown mlp activation {!r}; expected one of {}.".format(
+                activation, sorted(_ACTIVATIONS)))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_rows is None:
+        block_rows = int(os.environ.get("CLOUD_TPU_FUSED_MLP_BLOCK",
+                                        _BLOCK_ROWS))
+    if compute_dtype is None:
+        compute_dtype = jnp.promote_types(x.dtype, w_gate.dtype)
+    lead = x.shape[:-1]
+    rows = 1
+    for dim in lead:
+        rows *= dim
+    block_rows = min(block_rows, max(rows, 1))
+    rows_pad = -(-rows // block_rows) * block_rows
+    config = _MLPConfig(activation=activation,
+                        block_rows=int(block_rows),
+                        out_dtype=jnp.dtype(compute_dtype).name,
+                        interpret=bool(interpret))
+    folded = x.astype(compute_dtype).reshape(rows, features)
+    if rows_pad != rows:
+        # Zero rows project to zero, gate to act(0)*0 = 0 — sliced
+        # away below; pad/slice autodiff owns the edges.
+        folded = jnp.pad(folded, ((0, rows_pad - rows), (0, 0)))
+    out = _fused_swiglu(config, folded,
+                        w_gate.astype(compute_dtype),
+                        w_up.astype(compute_dtype),
+                        w_down.astype(compute_dtype))
+    return out[:rows].reshape(lead + (w_down.shape[1],))
+
+
+def fused_mlp_cost(shape, d_ff, dtype=jnp.bfloat16):
+    """Per-call flops / bytes-moved row for the telemetry gauges, via
+    the jit cost-analysis hook on the lax reference (PR 6 idiom);
+    bytes_moved is the fused single-pass traffic (x in, y out, three
+    weights — the [rows, d_ff] intermediates stay in VMEM). Returns
+    {"flops", "bytes_moved"}; never raises."""
+    rows = 1
+    for dim in shape[:-1]:
+        rows *= dim
+    features = shape[-1]
+    flops = 6.0 * rows * features * d_ff  # three matmuls
+    try:
+        args = [jax.ShapeDtypeStruct(tuple(shape), dtype),
+                jax.ShapeDtypeStruct((features, d_ff), jnp.float32),
+                jax.ShapeDtypeStruct((features, d_ff), jnp.float32),
+                jax.ShapeDtypeStruct((d_ff, features), jnp.float32)]
+        analysis = jax.jit(swiglu_reference).lower(
+            *args).cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        flops = float(analysis.get("flops", flops) or flops)
+    except Exception:
+        pass
+    itemsize = jnp.dtype(dtype).itemsize
+    bytes_moved = float(2 * rows * features * itemsize
+                        + 3 * features * d_ff * 4)
+    return {"flops": flops, "bytes_moved": bytes_moved}
+
+
+def record_cost_row(shape, d_ff, dtype=jnp.bfloat16, iters=10):
+    """Times the jitted fused tail at `shape` and feeds the telemetry
+    kernel-cost row (`cloud_tpu_kernel_fused_mlp_pct_peak` /
+    `_bytes_moved`) — the bench/CI hook that turns the cost analysis
+    into a tracked pct-of-peak metric. No-op (returns None) when
+    telemetry is off; returns the per-call seconds otherwise."""
+    import sys
+    import time
+
+    telemetry = sys.modules.get("cloud_tpu.monitoring.telemetry")
+    if telemetry is None:
+        return None
+    tele = telemetry.get()
+    if tele is None or not tele.active:
+        return None
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    features = shape[-1]
+    x = jnp.asarray(rng.randn(*shape), dtype)
+    w_gate = jnp.asarray(rng.randn(features, d_ff) * 0.02, jnp.float32)
+    w_up = jnp.asarray(rng.randn(features, d_ff) * 0.02, jnp.float32)
+    w_down = jnp.asarray(rng.randn(d_ff, features) * 0.02, jnp.float32)
+
+    @jax.jit
+    def run(x, w_gate, w_up, w_down):
+        return fused_swiglu(x, w_gate, w_up, w_down)
+
+    jax.block_until_ready(run(x, w_gate, w_up, w_down))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run(x, w_gate, w_up, w_down)
+    jax.block_until_ready(out)
+    elapsed = (time.perf_counter() - t0) / max(iters, 1)
+    cost = fused_mlp_cost(shape, d_ff, dtype)
+    tele.record_kernel_cost("fused_mlp", cost["flops"],
+                            cost["bytes_moved"], elapsed)
+    return elapsed

@@ -168,9 +168,12 @@ def _serve_prefill_fns(decoder, temperature, top_k, top_p):
 
 
 @functools.lru_cache(maxsize=64)
-def _draft_prefill_fn(decoder):
-    """Jitted draft-model prefill: cache only, nothing sampled (the
-    draft never emits tokens directly — it proposes inside the tick)."""
+def _cache_prefill_fn(decoder):
+    """Jitted cache-only prefill: run a window, keep the cache, sample
+    nothing. Two callers share it (per decoder, per window shape):
+    draft-model prefills (the draft never emits tokens directly — it
+    proposes inside the tick) and the INTERMEDIATE chunks of a chunked
+    prefill, which only advance the cache — the tail chunk samples."""
 
     @functools.partial(runtime.instrumented_jit, donate_argnums=1)
     def prefill(params, cache, tokens, mask):
@@ -180,6 +183,188 @@ def _draft_prefill_fn(decoder):
 
     from cloud_tpu.models.decoding import best_effort_donation
     return best_effort_donation(prefill)
+
+
+def chunk_plan(n_suffix, chunk_size, max_seq_len):
+    """Chunk layout for an `n_suffix`-token prefill at fixed chunk
+    width `chunk_size`: `(n_full, tail, tail_bucket)` — `n_full` full
+    chunks of `chunk_size` real tokens, then one tail chunk of `tail`
+    in [1, chunk_size] real tokens run at the pow2 `tail_bucket` width
+    (the SAME executable family as a whole prefill of a short suffix,
+    so single-chunk prefills degenerate to exactly today's path). With
+    `chunk_size` a power of two the written extent
+    `n_full * chunk_size + tail_bucket` never exceeds
+    `bucket_length(n_suffix)`, so the whole-prefill in-cache check
+    also bounds the chunked writes."""
+    from cloud_tpu.models.decoding import bucket_length
+    n_full = (n_suffix - 1) // chunk_size
+    tail = n_suffix - n_full * chunk_size
+    return n_full, tail, bucket_length(tail, max_seq_len)
+
+
+class ChunkedPrefill:
+    """An in-flight chunked prefill: one request's suffix split into
+    fixed-width windows that the scheduler interleaves with decode
+    ticks (`step()` runs ONE chunk; the final chunk returns the
+    `PrefillResult` a whole prefill would have).
+
+    Bit-identity: the dense decode attention always computes over the
+    full [1, L] cache with per-position validity masks, and positions
+    come from the running real-token count — so a window written in
+    chunks holds bitwise the values the whole window writes, and the
+    tail chunk's last-real-position logits (where the first token is
+    sampled) are bitwise the whole prefill's. The rng schedule is
+    untouched: only the tail chunk draws, with the same split the
+    whole prefill uses.
+
+    Construction is host-side only (the chunk PLAN); the first
+    `step()` acquires the dense cache(s) and runs the optional prefix
+    gather. Every device dispatch therefore happens on the stepping
+    thread — the scheduler steps chunks on the tick thread, whose
+    ticks donate the pool cache the gather reads."""
+
+    def __init__(self, engine, prompt, max_new_tokens, rng, sampling,
+                 chunk_size, prefix_len=0, gather_vec=None,
+                 key_override=None):
+        from cloud_tpu.models.decoding import bucket_length
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        prompt_len = int(prompt.shape[0])
+        prefix_len = int(prefix_len)
+        if not 0 <= prefix_len < prompt_len:
+            raise ValueError(
+                "prefix_len must be in [0, prompt_len); got {} for a "
+                "{}-token prompt.".format(prefix_len, prompt_len))
+        n_suffix = prompt_len - prefix_len
+        if prefix_len + bucket_length(
+                n_suffix, engine.max_seq_len) > engine.max_seq_len:
+            raise ValueError(
+                "prefix ({}) + suffix bucket exceeds max_seq_len {}; "
+                "the scheduler trims the match to keep the padded "
+                "suffix in-cache.".format(prefix_len,
+                                          engine.max_seq_len))
+        self.engine = engine
+        self.chunk_size = int(chunk_size)
+        self.prompt_len = prompt_len
+        self.prefix_len = prefix_len
+        self.max_new_tokens = int(max_new_tokens)
+        self._suffix = prompt[prefix_len:]
+        self._sampling = dict(sampling)
+        self._gather_vec = gather_vec
+        n_full, tail, tail_bucket = chunk_plan(
+            n_suffix, self.chunk_size, engine.max_seq_len)
+        self.n_chunks = n_full + 1
+        self.chunks_done = 0
+        self._tail = tail
+        self._tail_bucket = tail_bucket
+        if key_override is None:
+            self._key, self._prefill_rng = jax.random.split(rng)
+            self._override_rest = None
+        else:
+            self._prefill_rng = jnp.asarray(key_override[0], jnp.uint32)
+            self._key = None
+            self._override_rest = key_override[1]
+        self._cache = None
+        self._dcache = None
+        self._closed = False
+
+    def chunk_tokens(self, i):
+        """Real tokens chunk `i` carries (chunk_size, or the tail)."""
+        return self.chunk_size if i < self.n_chunks - 1 else self._tail
+
+    def _acquire(self):
+        from cloud_tpu.models.decoding import acquire_cache
+        engine = self.engine
+        cache = _plain(acquire_cache(engine._dense, 1))
+        gvec = None
+        if self.prefix_len:
+            gvec = jnp.asarray(self._gather_vec, jnp.int32)
+            cache = engine._gather(cache, engine.cache, gvec,
+                                   np.int32(self.prefix_len))
+        self._cache = cache
+        if engine.spec_on:
+            dcache = _plain(acquire_cache(engine._dense_draft, 1))
+            if self.prefix_len:
+                dcache = engine._gather(dcache, engine.draft_cache,
+                                        gvec, np.int32(self.prefix_len))
+            self._dcache = dcache
+
+    def step(self):
+        """Runs the next chunk. Intermediate chunks return None (cache
+        advanced, nothing sampled); the final chunk samples the first
+        token and returns the `PrefillResult` — blocking until the
+        token is on host, the TTFT point, exactly like `prefill()`."""
+        if self._closed:
+            raise RuntimeError(
+                "ChunkedPrefill already consumed or abandoned.")
+        engine = self.engine
+        t0_ns = time.monotonic_ns()
+        if self._cache is None:
+            self._acquire()
+        i = self.chunks_done
+        C = self.chunk_size
+        if i < self.n_chunks - 1:
+            tokens = jnp.asarray(self._suffix[None, i * C:(i + 1) * C])
+            mask = jnp.ones((1, C), bool)
+            self._cache = _cache_prefill_fn(engine._dense)(
+                engine._params, self._cache, tokens, mask)
+            if engine.spec_on:
+                self._dcache = _cache_prefill_fn(engine._dense_draft)(
+                    engine._draft_params, self._dcache, tokens, mask)
+            self.chunks_done = i + 1
+            spans.complete("serve_prefill_chunk", t0_ns,
+                           time.monotonic_ns() - t0_ns)
+            return None
+        tail, bucket = self._tail, self._tail_bucket
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :tail] = self._suffix[i * C:]
+        mask = np.zeros((1, bucket), bool)
+        mask[0, :tail] = True
+        fn = _serve_prefill_fns(
+            engine._dense, float(self._sampling["temperature"]),
+            self._sampling["top_k"], self._sampling["top_p"])
+        pcache, first = fn(engine._params, self._cache,
+                           jnp.asarray(tokens), self._prefill_rng,
+                           jnp.asarray(mask), np.int32(tail - 1))
+        self._cache = None
+        dpcache = None
+        if engine.spec_on:
+            dpcache = _cache_prefill_fn(engine._dense_draft)(
+                engine._draft_params, self._dcache,
+                jnp.asarray(tokens), jnp.asarray(mask))
+            self._dcache = None
+        n_steps = self.max_new_tokens
+        step_keys = np.zeros((engine.max_new_cap - 1, 2), np.uint32)
+        if self._override_rest is not None:
+            rest = np.asarray(self._override_rest,
+                              np.uint32).reshape(-1, 2)
+            if n_steps > 1:
+                step_keys[:n_steps - 1] = rest[:n_steps - 1]
+        elif n_steps > 1:
+            step_keys[:n_steps - 1] = np.asarray(
+                jax.random.split(self._key, n_steps - 1))
+        first_host = int(runtime.device_fetch(first)[0])
+        spans.complete("serve_prefill_chunk", t0_ns,
+                       time.monotonic_ns() - t0_ns)
+        self.chunks_done = i + 1
+        self._closed = True
+        return PrefillResult(first_token=first_host, pcache=pcache,
+                             dpcache=dpcache, step_keys=step_keys,
+                             bucket=bucket, n_steps=n_steps,
+                             prompt_len=self.prompt_len)
+
+    def abandon(self):
+        """Parks any held dense cache(s) back in the reuse pool (the
+        drain/fail path; a consumed prefill's caches belong to its
+        PrefillResult and go back via `release_prefill`)."""
+        from cloud_tpu.models.decoding import release_cache
+        self._closed = True
+        if self._cache is not None:
+            release_cache(self.engine._dense, 1, self._cache)
+            self._cache = None
+        if self._dcache is not None:
+            release_cache(self.engine._dense_draft, 1, self._dcache)
+            self._dcache = None
 
 
 class DecodeEngine:
@@ -372,7 +557,7 @@ class DecodeEngine:
             if prefix_len:
                 dcache = self._gather(dcache, self.draft_cache, gvec,
                                       np.int32(prefix_len))
-            dpcache = _draft_prefill_fn(self._dense_draft)(
+            dpcache = _cache_prefill_fn(self._dense_draft)(
                 self._draft_params, dcache, jnp.asarray(tokens),
                 jnp.asarray(mask))
         step_keys = np.zeros((self.max_new_cap - 1, 2), np.uint32)
@@ -393,6 +578,36 @@ class DecodeEngine:
                              dpcache=dpcache, step_keys=step_keys,
                              bucket=bucket, n_steps=int(max_new_tokens),
                              prompt_len=prompt_len)
+
+    def prefill_chunks(self, prompt, max_new_tokens, rng, sampling,
+                       chunk_size, prefix_len=0, gather_vec=None,
+                       key_override=None):
+        """Chunked-prefill continuation for one request: the suffix
+        runs as `chunk_plan()` windows — fixed `chunk_size` chunks
+        through the cache-only executable, then a pow2-bucketed tail
+        through the SAME sampling executable a whole prefill of that
+        suffix would use. `prefix_len`/`gather_vec` seed the first
+        chunk's start offset (prefix-cache hit) and `key_override`
+        re-bases a requeued continuation, both exactly as `prefill()`.
+        Returns a `ChunkedPrefill`; no device work happens until its
+        first `step()` (which must run on the tick thread when
+        `prefix_len > 0` — the gather reads the tick-donated pool
+        cache)."""
+        chunk_size = int(chunk_size)
+        if chunk_size < 1 or chunk_size & (chunk_size - 1):
+            raise ValueError(
+                "chunk_size must be a power of two >= 1 (the pow2 "
+                "bound keeps chunked writes inside the whole-prefill "
+                "bucket); got {}.".format(chunk_size))
+        if chunk_size > self.max_seq_len:
+            raise ValueError(
+                "chunk_size ({}) exceeds max_seq_len ({}).".format(
+                    chunk_size, self.max_seq_len))
+        return ChunkedPrefill(self, prompt, max_new_tokens, rng,
+                              sampling, chunk_size,
+                              prefix_len=prefix_len,
+                              gather_vec=gather_vec,
+                              key_override=key_override)
 
     def release_prefill(self, result):
         """Parks a consumed (or abandoned) prefill's dense cache(s)
@@ -811,4 +1026,5 @@ class DecodeEngine:
         return new_cache, new_dcache, out_ctl
 
 
-__all__ = ["DecodeEngine", "PrefillResult", "RetraceError"]
+__all__ = ["ChunkedPrefill", "DecodeEngine", "PrefillResult",
+           "RetraceError", "chunk_plan"]

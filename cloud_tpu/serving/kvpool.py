@@ -60,6 +60,7 @@ class PagePool:
         self._refs = {}  # page id -> holder count, allocated pages only
         self._cow_copies = 0
         self._reserve_waiters = 0
+        self._prefilling = 0
         self._closed = False
 
     @property
@@ -189,6 +190,26 @@ class PagePool:
         with self._cond:
             self._cow_copies += int(n)
 
+    def note_prefill_hold(self, n):
+        """Marks `n` already-reserved pages as held by an in-flight
+        (chunked) prefill — occupancy accounting only, no allocation.
+        A multi-chunk prefill holds its pages for several ticks before
+        its slot insert, so `pages_prefilling` splits `pages_held`
+        into decoding vs still-prefilling for the SERVE_* gauges."""
+        with self._cond:
+            self._prefilling += int(n)
+
+    def note_prefill_release(self, n):
+        """Drops `n` pages from the prefill-hold count (the prefill
+        inserted, failed, or was drained — the pages themselves move
+        or free separately)."""
+        with self._cond:
+            self._prefilling -= int(n)
+            if self._prefilling < 0:
+                raise ValueError(
+                    "prefill-hold underflow: released more prefilling "
+                    "pages than held.")
+
     def pool_stats(self):
         """Point-in-time accounting: free/held/shared page counts, CoW
         copies since construction, and a holder-count histogram
@@ -203,6 +224,7 @@ class PagePool:
                 "pages_held": len(self._refs),
                 "pages_shared": sum(1 for r in self._refs.values()
                                     if r >= 2),
+                "pages_prefilling": self._prefilling,
                 "cow_copies": self._cow_copies,
                 "reserve_waiters": self._reserve_waiters,
                 "refcount_hist": hist,

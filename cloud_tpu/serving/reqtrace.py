@@ -7,6 +7,17 @@ lifecycle as typed events::
     submitted -> queued -> radix_probe -> pages_reserved -> prefill
               -> slot_insert -> tick_commit* -> complete | fail | shed
 
+With chunked prefill enabled (``CLOUD_TPU_SERVE_PREFILL_CHUNK``), the
+prefill phase is tiled by per-chunk events emitted at each dispatch::
+
+    pages_reserved -> prefill_chunk{i, n, tokens, dur_s}*
+                   -> prefill{..., chunks}
+
+``prefill_chunk`` events are sub-phase detail INSIDE the
+(pages_reserved, prefill] span, not lifecycle boundaries — phase sums
+still telescope to the submitted -> complete wall time with or without
+them, and ``collect --serve`` audits exactly that.
+
 graftstorm (serving chaos) adds mid-lifecycle fault events: a chaos
 injection that hits an in-flight request emits ``slot_fault`` (with the
 taxonomy ``kind`` and the victim slot) followed by ``requeue`` (with

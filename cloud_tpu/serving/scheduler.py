@@ -68,6 +68,22 @@ candidate's TTFT from the live queue-wait/prefill histograms plus pool
 occupancy, and sheds (typed `ServeShed`) or defers
 (`CLOUD_TPU_SERVE_SHED=defer`) work it cannot serve within SLO instead
 of plain-FCFS admitting it.
+
+Chunked prefill (ROADMAP item 4 tail): with `prefill_chunk=` (or
+`CLOUD_TPU_SERVE_PREFILL_CHUNK`) set to a pow2 chunk width, prefills
+run as `engine.ChunkedPrefill` continuations interleaved with the
+decode tick — at most ONE chunk dispatched per tick-loop iteration, so
+a 4k-token arrival costs every resident slot one chunk of extra
+tick-to-tick latency instead of the whole prefill. All three prefill
+classes chunk (miss, prefix hit via the gather offset, requeue via
+key_override), outputs stay bit-identical (the tail chunk runs the
+SAME sampling executable a whole prefill of that suffix would), chaos
+`prefill_fail` lands on chunk boundaries with completed chunks
+retained, and the admission model swaps the whole-prefill p50 for a
+per-chunk histogram. The decode-gap histogram (commit-to-commit
+interval over active slots) is the p99 this interleave protects —
+tick COMPUTE time alone cannot see a tick loop stalled behind a
+monolithic prefill.
 """
 
 import collections
@@ -211,6 +227,59 @@ class _RequeueItem:
         self.rid = rid
 
 
+class _ChunkItem:
+    """An in-flight chunked prefill on the tick thread's interleave
+    queue: the `engine.ChunkedPrefill` continuation plus everything
+    needed to insert (or complete) it when the tail chunk lands.
+    `kind` selects the insert variant — "miss" (admission-thread
+    reservation, registers in the trie), "hit" (shared + fresh pages,
+    CoW partial page, registers), "requeue" (key-override
+    continuation: original TTFT carried, no register)."""
+    __slots__ = ("kind", "request", "chunked", "pages", "shared",
+                 "fresh", "partial_page", "partial_len", "prefix_len",
+                 "result_prefix_len", "future", "t_submit", "ttft_s",
+                 "rid", "result", "t_prefill0", "counts_pending",
+                 "hold_released")
+
+    def __init__(self, kind, request, chunked, future, t_submit,
+                 rid=None, pages=(), shared=(), fresh=(),
+                 partial_page=None, partial_len=0, prefix_len=0,
+                 result_prefix_len=0, ttft_s=0.0):
+        self.kind = kind
+        self.request = request
+        self.chunked = chunked
+        self.pages = list(pages)
+        self.shared = list(shared)
+        self.fresh = list(fresh)
+        self.partial_page = partial_page
+        self.partial_len = partial_len
+        self.prefix_len = prefix_len
+        self.result_prefix_len = result_prefix_len
+        self.future = future
+        self.t_submit = t_submit
+        self.ttft_s = ttft_s
+        self.rid = rid
+        self.result = None       # PrefillResult once the tail chunk ran
+        self.t_prefill0 = None   # first chunk dispatch (prefill span)
+        self.counts_pending = (kind != "requeue"
+                               and request.max_new_tokens > 1)
+        self.hold_released = False
+
+    def pages_held(self):
+        """Pages the eventual _Slot owns (the CoW partial page is
+        freed at insert, never carried into the slot)."""
+        if self.kind == "hit":
+            return self.shared + self.fresh
+        return list(self.pages)
+
+    def all_pages(self):
+        """Every page to free if the item dies before insert."""
+        held = self.pages_held()
+        if self.kind == "hit" and self.partial_len:
+            held = held + [self.partial_page]
+        return held
+
+
 def _registry():
     """graftscope registry when telemetry is enabled, else None — the
     decode hooks' zero-cost-when-off discipline."""
@@ -233,7 +302,7 @@ class Scheduler:
                  admission_window=8, strict_no_retrace=False,
                  prefix_cache=True, prefix_cache_pages=None,
                  draft_model=None, draft_params=None, spec_k=0,
-                 slo_ttft=None, shed_policy=None):
+                 slo_ttft=None, shed_policy=None, prefill_chunk=None):
         if num_pages is None:
             # Default: every slot can hold a full-length sequence, plus
             # scratch — paging then bounds fragmentation, not memory.
@@ -314,6 +383,42 @@ class Scheduler:
         self._prefill_fail_armed = 0
         # Squeezed page holds: (pages, release_tick, release_deadline).
         self._squeezed = []
+        # -- chunked prefill: budgeted tick interleave ----------------
+        if prefill_chunk is None:
+            env = os.environ.get("CLOUD_TPU_SERVE_PREFILL_CHUNK",
+                                 "").strip().lower()
+            prefill_chunk = 0 if env in _OFF_VALUES else int(env)
+        prefill_chunk = int(prefill_chunk)
+        if prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0 (0 = off); "
+                             "got {}.".format(prefill_chunk))
+        if prefill_chunk:
+            if prefill_chunk & (prefill_chunk - 1):
+                raise ValueError(
+                    "prefill_chunk must be a power of two (the tail "
+                    "bucket family only telescopes then); got "
+                    "{}.".format(prefill_chunk))
+            if prefill_chunk > model.max_seq_len:
+                raise ValueError(
+                    "prefill_chunk ({}) exceeds max_seq_len "
+                    "({}).".format(prefill_chunk, model.max_seq_len))
+        self._prefill_chunk = prefill_chunk or None
+        # In-flight ChunkedPrefill continuations, oldest first. Guarded
+        # by _ready_lock: the admission thread appends, the tick thread
+        # pops/re-queues — at most ONE chunk dispatched per tick.
+        self._chunks = collections.deque()
+        # How many _pending_inserts are chunk items only THIS loop can
+        # advance — excluded from the skip-yield, else the tick loop
+        # would sleep waiting on work it alone performs.
+        self._chunk_accounted = 0
+        self._chunks_dispatched = 0
+        self._t_last_commit = None
+        # Per-chunk dispatch latency (feeds the chunked admission
+        # model) and commit-to-commit decode gap (the p99 the
+        # interleave protects; tick COMPUTE time cannot see a loop
+        # stalled behind a monolithic prefill).
+        self._prefill_chunk_hist = Histogram("prefill_chunk")
+        self._decode_gap_hist = Histogram("decode_gap")
 
     # -- lifecycle ----------------------------------------------------
 
@@ -560,8 +665,21 @@ class Scheduler:
         current regime instead of a configured constant."""
         now = time.monotonic() if now is None else now
         accrued = max(now - t_submit, 0.0)
-        prefill_p50 = self._prefill_hist.percentile(50)
-        predicted = accrued + (position + 1) * prefill_p50
+        if self._prefill_chunk is not None:
+            # Chunk granularity: the candidate costs n_chunks chunk
+            # dispatches, interleaved one per tick, and each request
+            # admitted ahead of it serializes at least one chunk before
+            # the candidate's first. A whole-prefill p50 would be
+            # bimodal junk here — short and 4k prompts now differ only
+            # in chunk COUNT, not per-dispatch latency.
+            chunk_p50 = self._prefill_chunk_hist.percentile(50)
+            tick_p50 = self._token_hist.percentile(50)
+            n = self._n_chunks(len(request.prompt))
+            predicted = (accrued + position * chunk_p50 + n * chunk_p50
+                         + max(n - 1, 0) * tick_p50)
+        else:
+            prefill_p50 = self._prefill_hist.percentile(50)
+            predicted = accrued + (position + 1) * prefill_p50
         if request.max_new_tokens > 1:
             need = self.pool.pages_needed(len(request.prompt),
                                           request.max_new_tokens,
@@ -631,6 +749,10 @@ class Scheduler:
                 self._ready.append(_HitTicket(request, future, t_submit,
                                               rid=rid))
             self._wake.set()
+            return
+        if self._prefill_chunk is not None:
+            self._admit_miss_chunked(request, future, t_submit, rid,
+                                     sampling)
             return
         while True:
             # Re-entered on a transient PrefillFailed: the reservation
@@ -711,6 +833,232 @@ class Scheduler:
             total = self._hits + self._misses
             reg.gauge(telemetry.SERVE_PREFIX_HIT_RATE).set(
                 self._hits / total if total else 0.0)
+
+    # -- chunked prefill: tick-interleaved continuations --------------
+
+    def _n_chunks(self, n_suffix):
+        """Chunk count for an `n_suffix`-token prefill at the
+        configured chunk size (1 when chunking is off)."""
+        if self._prefill_chunk is None or n_suffix <= 0:
+            return 1
+        return (n_suffix - 1) // self._prefill_chunk + 1
+
+    def _admit_miss_chunked(self, request, future, t_submit, rid,
+                            sampling):
+        """Miss admission with chunking on: reserve pages here (same
+        blocking backpressure as the whole-prefill path), then hand the
+        request to the tick thread as a ChunkedPrefill continuation —
+        the admission thread never touches the device, so a long
+        prompt cannot monopolize the chip between ticks. Chaos
+        `prefill_fail` moves to chunk dispatch."""
+        pages = []
+        if request.max_new_tokens > 1:
+            need = self.pool.pages_needed(len(request.prompt),
+                                          request.max_new_tokens,
+                                          slack=self._spec_slack())
+            pages = None
+            t_reserve0 = time.monotonic()
+            while not self._stop.is_set():
+                pages = self._reserve_with_pressure(need, timeout=0.2)
+                if pages is not None:
+                    break
+            if pages is None:  # shutdown while blocked on the pool
+                self._pending_inserts -= 1
+                error = RuntimeError("scheduler closed")
+                self._trace_fail(rid, error)
+                future.set_exception(error)
+                return
+            wait = time.monotonic() - t_reserve0
+            self._observe_reserve_wait(wait)
+            self._trace_emit(rid, "pages_reserved", pages=len(pages),
+                             wait_s=wait)
+        chunked = self.engine.prefill_chunks(
+            np.asarray(request.prompt, np.int32),
+            request.max_new_tokens, jax.random.PRNGKey(request.rng_seed),
+            sampling, self._prefill_chunk)
+        self._enqueue_chunk_item(_ChunkItem(
+            "miss", request, chunked, future, t_submit, rid=rid,
+            pages=pages))
+
+    def _enqueue_chunk_item(self, item):
+        self.pool.note_prefill_hold(len(item.all_pages()))
+        with self._ready_lock:
+            if item.counts_pending:
+                self._chunk_accounted += 1
+            self._chunks.append(item)
+        self._wake.set()
+
+    def _release_chunk_hold(self, item):
+        if not item.hold_released:
+            item.hold_released = True
+            self.pool.note_prefill_release(len(item.all_pages()))
+
+    def _fail_chunk_item(self, item, error):
+        """Drains one chunk item on failure/shutdown: caches park,
+        pages free (exactly once), the future fails, and the pending-
+        insert accounting unwinds."""
+        try:
+            item.chunked.abandon()
+        except Exception:  # noqa: BLE001 — drain is best-effort
+            pass
+        if item.result is not None:
+            try:
+                self.engine.release_prefill(item.result)
+            except Exception:  # noqa: BLE001
+                pass
+            item.result = None
+        self._release_chunk_hold(item)
+        pages = item.all_pages()
+        if pages:
+            self.pool.free(pages)
+        with self._ready_lock:
+            if item.counts_pending:
+                self._chunk_accounted -= 1
+        if item.counts_pending:
+            self._pending_inserts -= 1
+        if not item.future.done():
+            self._trace_fail(item.rid, error)
+            item.future.set_exception(error)
+
+    def _step_chunks(self):
+        """Budgeted interleave: dispatch at most ONE prefill chunk per
+        tick-loop iteration, oldest continuation first. Chaos
+        `prefill_fail` is consumed at the chunk boundary — the faulted
+        dispatch counts a fault + requeue but the continuation keeps
+        its already-computed chunks (retained progress; the retry costs
+        one tick, not a re-prefill). The tail chunk records TTFT and
+        moves the item to the ready deque for slot insertion (or
+        completes outright when max_new == 1). Returns True when a
+        chunk was dispatched so the idle branch can drain continuations
+        back-to-back instead of sleeping."""
+        with self._ready_lock:
+            if not self._chunks:
+                return False
+            item = self._chunks.popleft()
+        if self._stop.is_set():
+            self._fail_chunk_item(
+                item, self._failure or RuntimeError("scheduler closed"))
+            return False
+        with self._chaos_lock:
+            armed = self._prefill_fail_armed > 0
+            if armed:
+                self._prefill_fail_armed -= 1
+        if armed:
+            self._note_fault(
+                PrefillFailed("graftchaos: injected prefill_fail"),
+                rid=item.rid, slot=None)
+            self._note_requeue(item.rid, tokens_done=0)
+            with self._ready_lock:
+                self._chunks.appendleft(item)
+            return True
+        if item.t_prefill0 is None:
+            item.t_prefill0 = time.monotonic()
+        i = item.chunked.chunks_done
+        t0 = time.monotonic()
+        try:
+            result = item.chunked.step()
+        except BaseException as exc:  # noqa: BLE001
+            self._fail_chunk_item(item, exc)
+            raise
+        dur = time.monotonic() - t0
+        self._chunks_dispatched += 1
+        self._observe_prefill_chunk(dur)
+        self._trace_emit(item.rid, "prefill_chunk", i=int(i),
+                         n=int(item.chunked.n_chunks),
+                         tokens=int(item.chunked.chunk_tokens(i)),
+                         dur_s=dur)
+        if result is None:
+            with self._ready_lock:
+                self._chunks.appendleft(item)
+            return True
+        item.result = result
+        now = time.monotonic()
+        if item.kind != "requeue":
+            item.ttft_s = now - item.t_submit
+            self._record_ttft(item.ttft_s, hit=item.kind == "hit")
+        self._observe_prefill(now - item.t_prefill0)
+        self._trace_emit(item.rid, "prefill", bucket=int(result.bucket),
+                         prefix_len=int(item.prefix_len),
+                         dur_s=now - item.t_prefill0,
+                         chunks=int(item.chunked.n_chunks))
+        if item.kind == "hit":
+            self._prefix_tokens_served += item.prefix_len
+        if item.request.max_new_tokens == 1:
+            # Completes at prefill: no slot, no pages, no tick.
+            self.engine.release_prefill(result)
+            item.result = None
+            self._release_chunk_hold(item)
+            self._complete(item.request, item.future, item.t_submit,
+                           item.ttft_s, [result.first_token],
+                           prefix_len=item.result_prefix_len,
+                           rid=item.rid)
+            return True
+        with self._ready_lock:
+            self._ready.append(item)
+        return True
+
+    def _insert_chunk_item(self, item):
+        """Slot insertion for a completed chunked prefill (the tail
+        chunk already ran): the kind-specific page-vector split and
+        bookkeeping of the three unchunked insert paths, unified."""
+        if self._stop.is_set():
+            self._fail_chunk_item(
+                item, self._failure or RuntimeError("scheduler closed"))
+            return
+        held = item.pages_held()
+        slot = self._free_slots.pop()
+        state = _Slot(item.request, held, item.future, item.t_submit,
+                      item.ttft_s, prefix_len=item.prefix_len,
+                      rid=item.rid)
+        state.result_prefix_len = item.result_prefix_len
+        state.emitted.append(item.result.first_token)
+        state.step_keys = item.result.step_keys
+        self._slots[slot] = state
+        page_vec = self.pool.page_vec(held)
+        if item.kind == "hit":
+            # Shared pages are immutable: route their scatter entries
+            # to scratch, reconstruct divergence into fresh pages.
+            scatter_vec = self.pool.page_vec(
+                [0] * len(item.shared) + list(item.fresh))
+        else:
+            scatter_vec = page_vec
+        self.engine.insert(slot, item.result, page_vec, scatter_vec,
+                           self._sampling(item.request))
+        item.result = None
+        self._trace_emit(item.rid, "slot_insert", slot=slot)
+        if item.kind == "hit" and item.partial_len:
+            # The divergent page was reconstructed into a fresh page by
+            # the insert scatter — device-side copy-on-write done.
+            self.pool.note_cow()
+            self.pool.free([item.partial_page])
+        self._release_chunk_hold(item)
+        if item.kind != "requeue":
+            self._register(item.request, held)
+        if item.counts_pending:
+            self._pending_inserts -= 1
+            with self._ready_lock:
+                self._chunk_accounted -= 1
+        self._observe_gauges()
+
+    def _observe_prefill_chunk(self, dur):
+        self._prefill_chunk_hist.observe(dur)
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.histogram(
+                telemetry.SERVE_PREFILL_CHUNK_HISTOGRAM).observe(dur)
+            reg.counter(telemetry.SERVE_PREFILL_CHUNKS_TOTAL).inc()
+
+    def _observe_decode_gap(self, gap, n_active):
+        if n_active <= 0:
+            return
+        self._decode_gap_hist.observe(gap, count=n_active)
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.histogram(
+                telemetry.SERVE_DECODE_GAP_HISTOGRAM).observe(
+                    gap, count=n_active)
 
     # -- graftstorm: chaos + slot fault recovery ----------------------
 
@@ -889,19 +1237,29 @@ class Scheduler:
                     watch.heartbeat()
                     watch.check()
                 self._chaos_pre_tick()
+                stepped = self._step_chunks()
                 self._insert_ready()
                 if not any(s is not None for s in self._slots):
+                    self._t_last_commit = None
+                    if stepped:
+                        # A continuation advanced and nothing decodes:
+                        # drain chunks back-to-back, no idle sleep.
+                        continue
                     if not self._wake.wait(timeout=0.05):
                         continue
                     self._wake.clear()
                     continue
-                if (self._free_slots and self._pending_inserts > 0
+                if (self._free_slots
+                        and self._pending_inserts > self._chunk_accounted
                         and skips < 40):
-                    # Admissions are in flight and slots are open:
-                    # yield briefly so the insert lands before the
-                    # next tick. The skip cap bounds the stall when an
-                    # admission is itself blocked on pages only ticks
-                    # can free.
+                    # Admissions are in flight on OTHER threads and
+                    # slots are open: yield briefly so the insert lands
+                    # before the next tick. The skip cap bounds the
+                    # stall when an admission is itself blocked on
+                    # pages only ticks can free. In-flight chunked
+                    # prefills are excluded — only this loop advances
+                    # them, so waiting on them would stall every
+                    # resident slot for nothing.
                     skips += 1
                     self._wake.wait(timeout=0.005)
                     self._wake.clear()
@@ -910,12 +1268,18 @@ class Scheduler:
                 t0 = time.monotonic()
                 out = self.engine.tick()
                 fetched = runtime.device_fetch(out)
-                elapsed = time.monotonic() - t0
+                t_commit = time.monotonic()
+                elapsed = t_commit - t0
                 # monotonic() and monotonic_ns() share an epoch, so the
                 # span timestamps line up with the tracer's records.
                 spans.complete("serve_tick", int(t0 * 1e9),
                                int(elapsed * 1e9))
                 self._ticks += 1
+                if self._t_last_commit is not None:
+                    self._observe_decode_gap(
+                        t_commit - self._t_last_commit,
+                        sum(s is not None for s in self._slots))
+                self._t_last_commit = t_commit
                 self._distribute(fetched, elapsed)
                 if self.strict_no_retrace:
                     self.engine.check_no_retrace()
@@ -944,6 +1308,9 @@ class Scheduler:
                 if isinstance(item, _RequeueItem):
                     if not self._insert_requeue(item):
                         blocked.append(item)
+                    continue
+                if isinstance(item, _ChunkItem):
+                    self._insert_chunk_item(item)
                     continue
                 self._insert_miss_item(item)
         finally:
@@ -983,6 +1350,29 @@ class Scheduler:
                 item.future.set_exception(error)
             return True
         key_override = (item.key, item.rest)
+        if self._prefill_chunk is not None:
+            pages = []
+            if request.max_new_tokens > 1:
+                need = self.pool.pages_needed(len(request.prompt),
+                                              request.max_new_tokens,
+                                              slack=self._spec_slack())
+                pages = self._reserve_with_pressure(need, timeout=0.01)
+                if pages is None:
+                    return False
+                self._trace_emit(item.rid, "pages_reserved",
+                                 pages=len(pages), wait_s=0.0)
+            chunked = self.engine.prefill_chunks(
+                np.asarray(request.prompt, np.int32),
+                request.max_new_tokens,
+                jax.random.PRNGKey(request.rng_seed),
+                self._sampling(request), self._prefill_chunk,
+                key_override=key_override)
+            self._enqueue_chunk_item(_ChunkItem(
+                "requeue", request, chunked, item.future,
+                item.t_submit, rid=item.rid, pages=pages,
+                result_prefix_len=item.result_prefix_len,
+                ttft_s=item.ttft_s))
+            return True
         if request.max_new_tokens == 1:
             # Single remaining token: completes at prefill, no slot.
             try:
@@ -1099,6 +1489,23 @@ class Scheduler:
         self._observe_reserve_wait(wait)
         self._trace_emit(ticket.rid, "pages_reserved",
                          pages=len(fresh), wait_s=wait)
+        if self._prefill_chunk is not None:
+            # The gather runs lazily at the first chunk step (tick
+            # thread — safe); the held refs keep the prefix pages'
+            # content live until then.
+            chunked = self.engine.prefill_chunks(
+                np.asarray(prompt, np.int32), request.max_new_tokens,
+                jax.random.PRNGKey(request.rng_seed),
+                self._sampling(request), self._prefill_chunk,
+                prefix_len=prefix_len,
+                gather_vec=self.pool.page_vec(held))
+            self._enqueue_chunk_item(_ChunkItem(
+                "hit", request, chunked, ticket.future,
+                ticket.t_submit, rid=ticket.rid, shared=shared,
+                fresh=fresh, partial_page=partial_page,
+                partial_len=partial_len, prefix_len=prefix_len,
+                result_prefix_len=prefix_len))
+            return True
         t_prefill0 = time.monotonic()
         try:
             result = self._engine_prefill(
@@ -1157,6 +1564,16 @@ class Scheduler:
         self._observe_reserve_wait(wait)
         self._trace_emit(ticket.rid, "pages_reserved",
                          pages=len(pages), wait_s=wait)
+        if self._prefill_chunk is not None:
+            chunked = self.engine.prefill_chunks(
+                np.asarray(request.prompt, np.int32),
+                request.max_new_tokens,
+                jax.random.PRNGKey(request.rng_seed),
+                self._sampling(request), self._prefill_chunk)
+            self._enqueue_chunk_item(_ChunkItem(
+                "miss", request, chunked, ticket.future,
+                ticket.t_submit, rid=ticket.rid, pages=pages))
+            return True
         t_prefill0 = time.monotonic()
         try:
             result = self._engine_prefill(
@@ -1364,6 +1781,8 @@ class Scheduler:
         reg.gauge(telemetry.SERVE_COW_COPIES).set(pstats["cow_copies"])
         reg.gauge(telemetry.SERVE_RESERVE_WAITERS).set(
             pstats["reserve_waiters"])
+        reg.gauge(telemetry.SERVE_PAGES_PREFILLING).set(
+            pstats["pages_prefilling"])
         if self.trie is not None:
             tstats = self.trie.stats()
             reg.gauge(telemetry.SERVE_PREFIX_PAGES_HELD).set(
@@ -1372,15 +1791,24 @@ class Scheduler:
                 tstats["evictions"])
 
     def _fail_pending(self, error):
-        self._pending_inserts = 0
         with self._ready_lock:
             ready, self._ready = list(self._ready), collections.deque()
+            chunks, self._chunks = (list(self._chunks),
+                                    collections.deque())
         for item in ready:
+            if isinstance(item, _ChunkItem):
+                chunks.append(item)
+                continue
             if isinstance(item, _ReadyItem) and item.pages:
                 self.pool.free(item.pages)
             if not item.future.done():
                 self._trace_fail(item.rid, error)
                 item.future.set_exception(error)
+        for item in chunks:
+            self._fail_chunk_item(item, error)
+        self._pending_inserts = 0
+        with self._ready_lock:
+            self._chunk_accounted = 0
         for slot, state in enumerate(self._slots):
             if state is not None:
                 if state.pages:
@@ -1408,7 +1836,7 @@ class Scheduler:
         busy = (any(s is not None for s in self._slots)
                 or self._pending_inserts > 0 or self._admit_q.qsize())
         with self._ready_lock:
-            busy = busy or bool(self._ready)
+            busy = busy or bool(self._ready) or bool(self._chunks)
         if busy:
             raise RuntimeError(
                 "assert_drained called with requests in flight.")
@@ -1470,6 +1898,20 @@ class Scheduler:
         # validates; bucket_length() still maps the capped length to
         # the intended width.
         cap = self.engine.max_seq_len - max_new - self._spec_slack()
+        chunk_lengths = []
+        if self._prefill_chunk is not None:
+            # Drive the chunk + tail-bucket surface: length C + t has
+            # exactly one full chunk and a t-token tail, so the set
+            # {C + t : t pow2 <= C} compiles the fixed-chunk executable
+            # and EVERY tail bucket per sampling config. Steady state
+            # then stays at zero new traces regardless of prompt
+            # length — any n decomposes into full chunks + one of
+            # these tails.
+            t = 1
+            while t <= self._prefill_chunk:
+                if self._prefill_chunk + t <= cap:
+                    chunk_lengths.append(self._prefill_chunk + t)
+                t *= 2
         for _ in range(2):
             futures = []
             for bucket in sorted(widths):
@@ -1484,6 +1926,13 @@ class Scheduler:
                         futures.append(self.submit(ServeRequest(
                             prompt=[first] + [1] * (length - 1),
                             max_new_tokens=max_new, **cfg)))
+            for length in chunk_lengths:
+                for cfg in configs:
+                    first = 2 + combo % max(vocab - 2, 1)
+                    combo += 1
+                    futures.append(self.submit(ServeRequest(
+                        prompt=[first] + [1] * (length - 1),
+                        max_new_tokens=max_new, **cfg)))
             for future in futures:
                 future.result(timeout=600)
         if self.trie is not None:
@@ -1502,6 +1951,10 @@ class Scheduler:
         self._queue_wait_hist = Histogram("queue_wait")
         self._reserve_wait_hist = Histogram("reserve_wait")
         self._prefill_hist = Histogram("prefill")
+        self._prefill_chunk_hist = Histogram("prefill_chunk")
+        self._decode_gap_hist = Histogram("decode_gap")
+        self._chunks_dispatched = 0
+        self._t_last_commit = None
         self._completed = 0
         self._tokens_out = 0
         self._ticks = 0
@@ -1554,6 +2007,10 @@ class Scheduler:
             "queue_wait": self._queue_wait_hist.snapshot(),
             "reserve_wait": self._reserve_wait_hist.snapshot(),
             "prefill": self._prefill_hist.snapshot(),
+            "prefill_chunk": self._prefill_chunk_hist.snapshot(),
+            "decode_gap": self._decode_gap_hist.snapshot(),
+            "prefill_chunks_dispatched": self._chunks_dispatched,
+            "prefill_chunk_size": self._prefill_chunk or 0,
             "queue_depth": self._admit_q.qsize(),
             "faults": dict(self._fault_counts),
             "requeues": self._requeues,

@@ -1,0 +1,387 @@
+"""Chunked prefill: interleaved continuations vs the whole prefill.
+
+Fast tier pins the chunk PLAN itself: `chunk_plan()` splits an
+n-suffix prefill into full fixed-width chunks plus a pow2-bucketed
+tail whose written extent never exceeds the whole prefill's bucket
+(so the whole-prefill in-cache check also bounds chunked writes),
+degenerates to a single tail chunk for short suffixes, and the
+`prefill_chunks()` / `prefill_chunk=` knobs reject non-pow2 or
+oversized widths.
+
+Slow tier pins the contract that makes interleaving safe to turn on:
+a prefill run as chunks produces the SAME first token, rng schedule,
+and decode stream a whole prefill produces — bit-identical to solo
+`generate()` under greedy, nucleus, shared-prefix, and speculative
+decode — while chaos `prefill_fail` consumed at a chunk boundary
+requeues the continuation WITH its already-computed chunks (the
+dispatch census shows no re-prefill), and the warmed chunk surface
+serves mixed chunked traffic with zero new traces.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from cloud_tpu.serving.engine import chunk_plan
+
+CTX = 32  # the test model's max_seq_len
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import TransformerLM
+    return TransformerLM(vocab_size=64, num_layers=2, num_heads=2,
+                         d_model=32, d_ff=64, max_seq_len=CTX,
+                         compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    import jax
+    import jax.numpy as jnp
+    return model.init(jax.random.PRNGKey(1),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+
+
+def _oracle(model, params, req):
+    """Solo generate() — the scheduler's bit-identical reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import generate
+    toks = generate(model, params,
+                    jnp.asarray(req.prompt, jnp.int32)[None],
+                    req.max_new_tokens,
+                    rng=jax.random.PRNGKey(req.rng_seed),
+                    temperature=req.temperature, top_k=req.top_k,
+                    top_p=req.top_p, eos_token=req.eos_token)
+    return np.asarray(toks)[0]
+
+
+def _drained(sched):
+    time.sleep(0.3)
+    sched.assert_drained(clear_prefix=True)
+    assert sched.pool.leak_report() == {}
+
+
+# -- the chunk plan (fast) --------------------------------------------
+
+
+class TestChunkPlan:
+
+    @pytest.mark.parametrize("n_suffix,chunk,want", [
+        (12, 4, (2, 4, 4)),    # exact multiple: last full width is the tail
+        (13, 4, (3, 1, 1)),    # 1-token tail runs at bucket 1
+        (10, 4, (2, 2, 2)),    # tail pads to its own pow2 bucket
+        (4, 4, (0, 4, 4)),     # suffix == chunk: single tail chunk
+        (1, 4, (0, 1, 1)),     # degenerate 1-token prefill
+        (32, 16, (1, 16, 16)),
+        (17, 16, (1, 1, 1)),
+    ])
+    def test_layouts(self, n_suffix, chunk, want):
+        assert chunk_plan(n_suffix, chunk, CTX) == want
+
+    def test_written_extent_bounded_by_whole_bucket(self):
+        """For every (suffix, chunk) the chunked writes stay inside the
+        whole prefill's bucket — the invariant that lets the scheduler
+        reuse the unchunked in-cache admission check unchanged."""
+        from cloud_tpu.models.decoding import bucket_length
+        for chunk in (1, 2, 4, 8, 16):
+            for n in range(1, CTX + 1):
+                n_full, tail, tail_bucket = chunk_plan(n, chunk, CTX)
+                assert n_full * chunk + tail == n
+                assert 1 <= tail <= chunk
+                assert tail_bucket >= tail
+                assert tail_bucket & (tail_bucket - 1) == 0
+                assert (n_full * chunk + tail_bucket
+                        <= bucket_length(n, CTX))
+
+    def test_single_chunk_degenerates_to_whole_bucket(self):
+        """suffix <= chunk: one tail chunk at the SAME bucket a whole
+        prefill of that suffix uses — the executable families match,
+        so short prompts never compile a chunk-only variant."""
+        from cloud_tpu.models.decoding import bucket_length
+        for n in range(1, 17):
+            n_full, tail, tail_bucket = chunk_plan(n, 16, CTX)
+            assert n_full == 0 and tail == n
+            assert tail_bucket == bucket_length(n, CTX)
+
+
+class TestChunkKnobValidation:
+
+    def test_engine_rejects_bad_chunk_sizes(self, model, params):
+        import jax
+
+        from cloud_tpu.serving.engine import DecodeEngine
+        engine = DecodeEngine(model, params, slots=1, page_size=16,
+                              num_pages=3)
+        sampling = dict(temperature=0.0, top_k=None, top_p=None,
+                        eos_token=None)
+        prompt = np.asarray([1, 2, 3, 4, 5], np.int32)
+        rng = jax.random.PRNGKey(0)
+        with pytest.raises(ValueError, match="power of two"):
+            engine.prefill_chunks(prompt, 4, rng, sampling, 3)
+        with pytest.raises(ValueError, match="power of two"):
+            engine.prefill_chunks(prompt, 4, rng, sampling, 0)
+        with pytest.raises(ValueError, match="exceeds max_seq_len"):
+            engine.prefill_chunks(prompt, 4, rng, sampling, 2 * CTX)
+        with pytest.raises(ValueError, match="prefix_len must be in"):
+            engine.prefill_chunks(prompt, 4, rng, sampling, 4,
+                                  prefix_len=len(prompt))
+        # The plan is host-side only: a valid call compiles nothing
+        # and an un-stepped continuation abandons clean.
+        chunked = engine.prefill_chunks(prompt, 4, rng, sampling, 4)
+        assert chunked.n_chunks == 2
+        chunked.abandon()
+        with pytest.raises(RuntimeError, match="already consumed"):
+            chunked.step()
+
+    def test_scheduler_rejects_bad_chunk_sizes(self, model, params):
+        from cloud_tpu.serving import Scheduler
+        for bad in (-1, 3, 2 * CTX):
+            with pytest.raises(ValueError):
+                Scheduler(model, params, slots=1, prefill_chunk=bad)
+
+    def test_env_knob_and_explicit_off(self, model, params,
+                                       monkeypatch):
+        from cloud_tpu.serving import Scheduler
+        monkeypatch.setenv("CLOUD_TPU_SERVE_PREFILL_CHUNK", "8")
+        with Scheduler(model, params, slots=1) as sched:
+            assert sched.stats()["prefill_chunk_size"] == 8
+        # Explicit 0 beats the env: the unchunked A/B control leg.
+        with Scheduler(model, params, slots=1,
+                       prefill_chunk=0) as sched:
+            assert sched.stats()["prefill_chunk_size"] == 0
+
+
+# -- engine-level bit-identity (slow: compiles prefill variants) ------
+
+
+@pytest.mark.slow
+class TestEngineChunkedPrefill:
+
+    @pytest.fixture(scope="class")
+    def engine(self, model, params):
+        from cloud_tpu.serving.engine import DecodeEngine
+        return DecodeEngine(model, params, slots=2, page_size=16,
+                            num_pages=5)
+
+    def _run_chunked(self, chunked):
+        outs = [chunked.step() for _ in range(chunked.n_chunks)]
+        assert all(r is None for r in outs[:-1])
+        assert outs[-1] is not None
+        return outs[-1]
+
+    @pytest.mark.parametrize("sampling", [
+        dict(temperature=0.0, top_k=None, top_p=None, eos_token=None),
+        dict(temperature=0.9, top_k=None, top_p=0.9, eos_token=None),
+    ])
+    def test_first_token_and_schedule_match_whole_prefill(
+            self, engine, params, sampling):
+        """The tail chunk samples the same first token from the same
+        prefill key, and arms the same step-key schedule, as the whole
+        prefill — the rng schedule never moves."""
+        import jax
+        prompt = np.asarray(
+            np.random.default_rng(8).integers(1, 64, (13,)), np.int32)
+        whole = engine.prefill(prompt, 5, jax.random.PRNGKey(9),
+                               sampling)
+        chunked = engine.prefill_chunks(prompt, 5,
+                                        jax.random.PRNGKey(9),
+                                        sampling, 4)
+        assert chunked.n_chunks == 4  # 3 full chunks + 1-token tail
+        res = self._run_chunked(chunked)
+        assert res.first_token == whole.first_token
+        np.testing.assert_array_equal(res.step_keys, whole.step_keys)
+        assert res.prompt_len == whole.prompt_len == 13
+        assert res.n_steps == whole.n_steps == 5
+        # The tail runs at ITS bucket, not the whole suffix's.
+        assert res.bucket == 1 and whole.bucket == 16
+        engine.release_prefill(whole)
+        engine.release_prefill(res)
+
+    def test_key_override_rebased_identically(self, engine, params):
+        """A requeued continuation (key_override) chunks with the same
+        override key + retained schedule a whole re-prefill uses."""
+        import jax
+        prompt = np.asarray([5, 4, 3, 2, 1, 9, 8, 7, 6], np.int32)
+        sampling = dict(temperature=1.0, top_k=None, top_p=None,
+                        eos_token=None)
+        override = (np.asarray([123, 456], np.uint32),
+                    np.arange(12, dtype=np.uint32).reshape(6, 2))
+        whole = engine.prefill(prompt, 4, jax.random.PRNGKey(0),
+                               sampling, key_override=override)
+        chunked = engine.prefill_chunks(prompt, 4,
+                                        jax.random.PRNGKey(1),
+                                        sampling, 4,
+                                        key_override=override)
+        res = self._run_chunked(chunked)
+        assert res.first_token == whole.first_token
+        np.testing.assert_array_equal(res.step_keys, whole.step_keys)
+        engine.release_prefill(whole)
+        engine.release_prefill(res)
+
+
+# -- scheduler-level end-to-end (slow) --------------------------------
+
+
+@pytest.mark.slow
+class TestChunkedSchedulerBitIdentity:
+
+    def test_mixed_sampling_long_prompts(self, model, params):
+        """Chunked serving under every sampling mode and multi-chunk
+        prompt lengths is bit-identical to solo generate(), and the
+        dispatch census is exactly sum(ceil(suffix / chunk))."""
+        from cloud_tpu.serving import Scheduler, ServeRequest
+        rng = np.random.default_rng(7)
+        configs = [
+            dict(temperature=0.0),
+            dict(temperature=1.0),
+            dict(temperature=0.7, top_k=8),
+            dict(temperature=0.9, top_p=0.9),
+            dict(temperature=0.8, top_k=12, top_p=0.95),
+            dict(temperature=0.0),
+        ]
+        requests = []
+        for i, cfg in enumerate(configs):
+            plen = int(rng.integers(9, 26))
+            requests.append(ServeRequest(
+                prompt=rng.integers(1, 64,
+                                    (plen,)).astype(np.int32).tolist(),
+                max_new_tokens=int(rng.integers(2, 7)),
+                rng_seed=700 + i, **cfg))
+        with Scheduler(model, params, slots=2, prefix_cache=False,
+                       prefill_chunk=4) as sched:
+            futures = [sched.submit(r, timeout=30) for r in requests]
+            results = [f.result(timeout=300) for f in futures]
+            stats = sched.stats()
+            _drained(sched)
+        for req, res in zip(requests, results):
+            np.testing.assert_array_equal(res.tokens,
+                                          _oracle(model, params, req))
+        expected = sum((len(r.prompt) - 1) // 4 + 1 for r in requests)
+        assert stats["prefill_chunks_dispatched"] == expected
+        assert stats["prefill_chunk_size"] == 4
+
+    def test_prefix_hit_chunked(self, model, params):
+        """A prefix-cache HIT's suffix runs as chunks on the tick
+        thread (gather on the first chunk) and still matches solo
+        generate() — under nucleus sampling, so a moved draw would
+        show."""
+        from cloud_tpu.serving import Scheduler, ServeRequest
+        rng = np.random.default_rng(4)
+        shared = rng.integers(1, 64, (16,)).astype(np.int32).tolist()
+        opener = ServeRequest(prompt=shared + [7], max_new_tokens=3,
+                              temperature=0.0, rng_seed=41)
+        rider = ServeRequest(
+            prompt=shared + rng.integers(
+                1, 64, (10,)).astype(np.int32).tolist(),
+            max_new_tokens=4, temperature=0.9, top_p=0.9, rng_seed=42)
+        with Scheduler(model, params, slots=2, prefix_cache=True,
+                       prefill_chunk=4) as sched:
+            r1 = sched.submit(opener, timeout=30).result(timeout=300)
+            r2 = sched.submit(rider, timeout=30).result(timeout=300)
+            stats = sched.stats()
+            _drained(sched)
+        np.testing.assert_array_equal(r1.tokens,
+                                      _oracle(model, params, opener))
+        np.testing.assert_array_equal(r2.tokens,
+                                      _oracle(model, params, rider))
+        assert stats["prefix_hits"] == 1
+        # opener: ceil(17/4) = 5 chunks; rider's 10-token SUFFIX: 3.
+        assert stats["prefill_chunks_dispatched"] == 8
+
+    def test_chunk_boundary_fault_requeues_with_retained_chunks(
+            self, model, params):
+        """`prefill_fail` consumed at a chunk boundary requeues the
+        continuation but keeps its computed chunks: the retry costs one
+        tick, the dispatch census shows no re-prefill, and the output
+        is still bit-identical."""
+        from cloud_tpu.serving import Scheduler, ServeRequest
+        first = ServeRequest(prompt=[2, 4, 6], max_new_tokens=4,
+                             temperature=0.0, rng_seed=31)
+        second = ServeRequest(
+            prompt=[6, 4, 2, 1, 3, 5, 7, 9, 11, 13, 15, 17],
+            max_new_tokens=6, temperature=0.7, top_k=8, rng_seed=32)
+        with Scheduler(model, params, slots=2, prefix_cache=False,
+                       prefill_chunk=4) as sched:
+            r1 = sched.submit(first, timeout=30).result(timeout=300)
+            # Arm the failure directly (what `prefill_fail@tick` does
+            # from the tick thread) so it deterministically hits
+            # `second`'s first chunk dispatch.
+            sched._prefill_fail_armed = 1
+            r2 = sched.submit(second, timeout=30).result(timeout=300)
+            stats = sched.stats()
+            _drained(sched)
+        np.testing.assert_array_equal(r1.tokens,
+                                      _oracle(model, params, first))
+        np.testing.assert_array_equal(r2.tokens,
+                                      _oracle(model, params, second))
+        assert stats["faults"] == {"prefill_fail": 1}
+        assert stats["requeues"] == 1
+        # first: 1 chunk; second: 3 chunks, dispatched ONCE each — the
+        # faulted boundary re-enters the queue without re-running.
+        assert stats["prefill_chunks_dispatched"] == 4
+
+    def test_mid_speculation_chunked(self, model, params):
+        """Chunked prefill composes with speculative decode: the
+        draft cache advances chunk-for-chunk with the target's, so
+        acceptance (here: the ceiling, by construction) and the token
+        stream match the target-only oracle."""
+        import jax.numpy as jnp
+
+        from cloud_tpu.models import TransformerLM
+        from cloud_tpu.serving import Scheduler, ServeRequest
+        from cloud_tpu.serving.smoke import split_draft
+        draft_model = TransformerLM(vocab_size=64, num_layers=1,
+                                    num_heads=2, d_model=32, d_ff=64,
+                                    max_seq_len=CTX,
+                                    compute_dtype=jnp.float32)
+        target, draft = split_draft(params, draft_layers=1)
+        req = ServeRequest(prompt=[8, 6, 4, 2, 1, 3, 5, 7, 9, 11],
+                           max_new_tokens=8, temperature=0.0,
+                           rng_seed=51)
+        with Scheduler(model, target, slots=2, prefix_cache=False,
+                       draft_model=draft_model, draft_params=draft,
+                       spec_k=2, prefill_chunk=4) as sched:
+            res = sched.submit(req, timeout=30).result(timeout=300)
+            stats = sched.stats()
+            _drained(sched)
+        np.testing.assert_array_equal(res.tokens,
+                                      _oracle(model, target, req))
+        assert stats["prefill_chunks_dispatched"] == 3
+
+    def test_zero_retrace_after_warmup(self, model, params):
+        """The warmed chunk surface (fixed-width chunk + every pow2
+        tail bucket) serves mixed chunked lengths with ZERO new traces
+        or compiles — the production no-retrace gate, enforced twice:
+        strict_no_retrace raises on any retrace, and the compile
+        counters must not move."""
+        from cloud_tpu.models.decoding import bucket_length
+        from cloud_tpu.parallel import runtime
+        from cloud_tpu.serving import Scheduler, ServeRequest
+        rng = np.random.default_rng(9)
+        requests = [ServeRequest(
+            prompt=rng.integers(1, 64, (plen,)).astype(np.int32)
+            .tolist(),
+            max_new_tokens=4, temperature=0.0, rng_seed=900 + plen)
+            for plen in (5, 9, 14, 21)]
+        buckets = sorted({bucket_length(len(r.prompt), CTX)
+                          for r in requests})
+        with Scheduler(model, params, slots=2, prefix_cache=False,
+                       strict_no_retrace=True,
+                       prefill_chunk=4) as sched:
+            sched.warmup(buckets, max_new=4)
+            warm = runtime.compile_stats()
+            results = [f.result(timeout=300) for f in
+                       [sched.submit(r, timeout=30) for r in requests]]
+            after = runtime.compile_stats()
+            _drained(sched)
+        assert after["n_traces"] == warm["n_traces"]
+        assert after["n_compiles"] == warm["n_compiles"]
+        for req, res in zip(requests, results):
+            np.testing.assert_array_equal(res.tokens,
+                                          _oracle(model, params, req))
