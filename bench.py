@@ -248,6 +248,15 @@ def _metric_name():
             name += "_pk_on"
         elif forced == "0":
             name += "_pk_off"
+        # graftpack contrast series: int8 KV pages (and/or the host
+        # page tier) change what a token costs, so their records get
+        # their own cache slot — suffixed, never pin-eligible, same as
+        # the kernel A/B above.
+        if os.environ.get("BENCH_SERVE_KV_DTYPE",
+                          "").strip().lower() == "int8":
+            name += "_kvq"
+        if os.environ.get("BENCH_SERVE_HOST_TIER", "0") == "1":
+            name += "_host"
         return name
     # Architecture/feeding variants are suffixed so recorded numbers
     # (including failed runs) stay apples-to-apples per series.
@@ -523,6 +532,12 @@ def _requested_config():
             # A/B pair of serve records is self-describing.
             "paged_kernel": {"1": "on", "0": "off"}.get(
                 os.environ.get("CLOUD_TPU_PAGED_KERNEL", ""), "auto"),
+            # graftpack knobs: KV page dtype ("" = compute dtype) and
+            # the host page tier. Each flips the record onto its own
+            # suffixed series (_kvq / _host).
+            "kv_dtype": os.environ.get("BENCH_SERVE_KV_DTYPE",
+                                       "").strip().lower(),
+            "host_tier": _env_int("BENCH_SERVE_HOST_TIER", 0),
         }
     cfg = {
         "batch": BATCH,
@@ -889,6 +904,9 @@ def _serve_worker():
     slots = _env_int("BENCH_SERVE_SLOTS", 8)
     waves = _env_int("BENCH_SERVE_WAVES", 0) or None
     prefix_share = _env_float("BENCH_SERVE_PREFIX_SHARE", 0.0)
+    kv_dtype = os.environ.get("BENCH_SERVE_KV_DTYPE",
+                              "").strip().lower()
+    host_tier = os.environ.get("BENCH_SERVE_HOST_TIER", "0") == "1"
     model = build_model()
     requests = build_requests(slots, waves, prefix_share=prefix_share)
     params = model.init(jax.random.PRNGKey(1),
@@ -903,7 +921,9 @@ def _serve_worker():
     scheduler = Scheduler(model, params, slots=slots, page_size=16,
                           num_pages=(slots + 4) * pages_per_slot + 1,
                           admission_window=len(requests),
-                          strict_no_retrace=True).start()
+                          strict_no_retrace=True,
+                          kv_dtype=kv_dtype,
+                          host_tier=host_tier).start()
     try:
         buckets = sorted({scheduler._bucket(r) for r in requests})
         scheduler.warmup(buckets,
@@ -976,6 +996,16 @@ def _serve_worker():
         "ttft_miss_p99_s": _pct(stats["ttft_miss"], "p99"),
         "cow_copies": stats["pool"]["cow_copies"],
         "ticks": stats["ticks"],
+        # graftpack KV-hierarchy census: page dtype + per-page cost,
+        # resident-session capacity at the pool's byte budget, and the
+        # demote/promote traffic when the host tier is on.
+        "kv_dtype": stats["kv"]["page_dtype"] or "fp",
+        "kv_page_bytes": stats["kv"]["page_bytes"],
+        "kv_capacity_sessions": stats["kv"]["capacity_sessions"],
+        "host_tier_pages": stats["kv"]["host_tier_pages"],
+        "page_demotes": stats["kv"]["page_demotes"],
+        "page_promotes": stats["kv"]["page_promotes"],
+        "digest_failures": stats["kv"]["digest_failures"],
         # The zero-retrace contract as numbers (also enforced live by
         # strict_no_retrace — a violation kills the run, not the lint).
         "new_traces_post_warmup": after["n_traces"] - warm["n_traces"],

@@ -37,6 +37,10 @@ class CausalSelfAttention(nn.Module):
     # per-slot write pointers (continuous batching; serving/kvpool.py).
     page_size: int = 0
     num_pages: int = 0
+    # "" = pages in compute_dtype; "int8" = quantized pages with
+    # per-page per-head f32 scales (key_scales/value_scales cache
+    # variables), dequantized inside ops.paged_attention's block loads.
+    page_dtype: str = ""
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -161,18 +165,35 @@ class CausalSelfAttention(nn.Module):
         if self.num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "scratch page).")
+        if self.page_dtype not in ("", "int8"):
+            raise ValueError(
+                "page_dtype must be '' or 'int8'; got {!r}.".format(
+                    self.page_dtype))
+        quantized = self.page_dtype == "int8"
         pages_per_slot = self.cache_len // self.page_size
+        page_store = jnp.int8 if quantized else self.compute_dtype
         key_pages = self.variable(
             "cache", "key_pages", jnp.zeros,
             (self.num_pages, self.page_size, heads, head_dim),
-            self.compute_dtype)
+            page_store)
         value_pages = self.variable(
             "cache", "value_pages", jnp.zeros,
             (self.num_pages, self.page_size, heads, head_dim),
-            self.compute_dtype)
+            page_store)
         page_table = self.variable(
             "cache", "page_table", jnp.zeros, (slots, pages_per_slot),
             jnp.int32)
+        if quantized:
+            # Per-page per-head symmetric scales; 0 = never-written
+            # page (dequantizes to exact zeros). They live in the same
+            # attention cache subtree as the pages, so the engine's
+            # _map_attention / paged_slot_rewind carry them for free.
+            key_scales = self.variable(
+                "cache", "key_scales", jnp.zeros,
+                (self.num_pages, heads), jnp.float32)
+            value_scales = self.variable(
+                "cache", "value_scales", jnp.zeros,
+                (self.num_pages, heads), jnp.float32)
 
         pos, allowed = paged_slot_update(self, mask, slots, seq,
                                          self.cache_len)
@@ -182,10 +203,28 @@ class CausalSelfAttention(nn.Module):
         phys = jnp.take_along_axis(page_table.value,
                                    pos // self.page_size, 1)
         off = pos % self.page_size
-        key_pages.value = key_pages.value.at[phys, off].set(
-            k.astype(self.compute_dtype))
-        value_pages.value = value_pages.value.at[phys, off].set(
-            v.astype(self.compute_dtype))
+        if quantized:
+            if mask is not None:
+                # Zero invalid tokens pre-quantize so pad garbage never
+                # inflates a real page's amax scale (their positions are
+                # masked from attention either way).
+                m = mask.reshape(slots, seq).astype(k.dtype)
+                k = k * m[:, :, None, None]
+                v = v * m[:, :, None, None]
+            key_pages.value, key_scales.value = _quantized_page_write(
+                key_pages.value, key_scales.value, k, phys, off)
+            value_pages.value, value_scales.value = (
+                _quantized_page_write(value_pages.value,
+                                      value_scales.value, v, phys,
+                                      off))
+            scales_kw = dict(key_scales=key_scales.value,
+                             value_scales=value_scales.value)
+        else:
+            key_pages.value = key_pages.value.at[phys, off].set(
+                k.astype(self.compute_dtype))
+            value_pages.value = value_pages.value.at[phys, off].set(
+                v.astype(self.compute_dtype))
+            scales_kw = {}
 
         # Impl selection (ops/paged_attention.py): "auto" runs the
         # Pallas paged kernel on TPU — the page table rides as a
@@ -201,7 +240,51 @@ class CausalSelfAttention(nn.Module):
         return paged_attention(
             q, key_pages.value, value_pages.value, page_table.value,
             allowed, sm_scale=1.0 / np.sqrt(head_dim),
-            impl=self.attention_impl)
+            impl=self.attention_impl, **scales_kw)
+
+
+def _quantized_page_write(pages, scales, x, phys, off):
+    """Write [slots, seq, H, D] decode K/V into int8 pages with
+    per-page per-head amax rescale.
+
+    pages: [N, P, H, D] int8; scales: [N, H] f32; phys/off: [slots,
+    seq] physical page / in-page offset per token. Returns the updated
+    (pages, scales).
+
+    Per position j (static python loop — seq is 1 for the plain tick,
+    spec_k + 1 for the verify window): the page's scale grows
+    monotonically to cover the new token's amax
+    (`new = max(old, amax / 127)`), the page's existing block is
+    rescaled by `old / new` and the token quantized at `new`. When the
+    scale doesn't grow the rescale factor is exactly 1.0 and
+    `round(x * 1.0) == x` for int8-range values in f32, so the rewrite
+    is an exact no-op — steady-state decode never degrades earlier
+    tokens. Duplicate physical targets across slots only happen at the
+    scratch page (inactive slots' zeroed table rows); its undefined
+    winner is never attended. Scales only *reset* at page-granular
+    rewrites (the engine insert scatter / host-tier promote), which
+    cover every recycled page before a decode write can touch it.
+    """
+    slots = x.shape[0]
+    seq = x.shape[1]
+    xf = x.astype(jnp.float32)
+    rows = jnp.arange(slots)
+    for j in range(seq):
+        p = phys[:, j]                       # [slots]
+        o = off[:, j]
+        xj = xf[:, j]                        # [slots, H, D]
+        amax = jnp.max(jnp.abs(xj), axis=-1)  # [slots, H]
+        old = scales[p]
+        new = jnp.maximum(old, amax / 127.0)
+        safe = jnp.where(new > 0, new, 1.0)
+        factor = (old / safe)[:, None, :, None]
+        block = jnp.clip(jnp.round(pages[p].astype(jnp.float32)
+                                   * factor), -127, 127)
+        qx = jnp.clip(jnp.round(xj / safe[:, :, None]), -127, 127)
+        block = block.at[rows, o].set(qx)
+        pages = pages.at[p].set(block.astype(jnp.int8))
+        scales = scales.at[p].set(new)
+    return pages, scales
 
 
 class TransformerBlock(nn.Module):
@@ -217,6 +300,7 @@ class TransformerBlock(nn.Module):
     norm_eps: float = 1e-6  # GPT-2 checkpoints use 1e-5
     page_size: int = 0  # paged-pool decode (serving); see attention
     num_pages: int = 0
+    page_dtype: str = ""
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic=True):
@@ -229,6 +313,7 @@ class TransformerBlock(nn.Module):
                                 causal=self.causal,
                                 page_size=self.page_size,
                                 num_pages=self.num_pages,
+                                page_dtype=self.page_dtype,
                                 name="attention")(y, mask)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
@@ -277,6 +362,7 @@ class TransformerLM(nn.Module):
     # page tables (requires decode=True; batch dim becomes slots).
     kv_page_size: int = 0
     kv_num_pages: int = 0
+    kv_page_dtype: str = ""  # "int8" = quantized pages (graftpack)
 
     @nn.compact
     def __call__(self, tokens, mask=None, deterministic=True):
@@ -314,6 +400,7 @@ class TransformerLM(nn.Module):
                                  norm_eps=self.norm_eps,
                                  page_size=self.kv_page_size,
                                  num_pages=self.kv_num_pages,
+                                 page_dtype=self.kv_page_dtype,
                                  name="block_%d" % i)(
                                      x, mask, deterministic)
         x = nn.LayerNorm(epsilon=self.norm_eps, dtype=self.compute_dtype,

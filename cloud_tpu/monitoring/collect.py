@@ -31,8 +31,11 @@ into:
     serve_report.json   per-request latency decomposition -> TTFT/TPOT
                         percentiles split by prefix-cache hit/miss and
                         prompt bucket, queue/reserve wait breakdown,
-                        slot-occupancy timeline, and goodput against
-                        `--slo-ttft` / `--slo-tpot`
+                        slot-occupancy timeline, goodput against
+                        `--slo-ttft` / `--slo-tpot`, and the graftpack
+                        kv_tier split: follow-up TTFT classed promoted
+                        (host pages copied back) vs device_hit vs
+                        re_prefill, plus pages demoted/promoted
     trace.json          grows a "graftserve requests" lane: one tid per
                         request, phases tiled submit->complete as "X"
                         events (the per-request waterfall, Perfetto-
@@ -485,6 +488,18 @@ def _summarize_request(events):
         summary["prefill_chunk_tokens"] = sum(
             int(e.get("tokens", 0)) for e in chunk_events)
     summary["chunked"] = bool(chunk_events)
+    # graftpack page-tier movement: a promote INSIDE admission marks
+    # the request's TTFT class (promoted vs device-cache-hit vs
+    # re-prefill); a demote at completion is census only.
+    promotes = [e for e in events if e["event"] == "page_promote"]
+    demotes = [e for e in events if e["event"] == "page_demote"]
+    summary["promoted"] = bool(promotes)
+    if promotes:
+        summary["promoted_pages"] = sum(
+            int(e.get("pages", 0)) for e in promotes)
+    if demotes:
+        summary["demoted_pages"] = sum(
+            int(e.get("pages", 0)) for e in demotes)
     present = [(name, first[name]["_monotonic"])
                for name in _BOUNDARIES if name in first]
     phases = {}
@@ -608,6 +623,31 @@ def serve_report(lifecycles, globals_=(), slo_ttft=None, slo_tpot=None):
         "prefix_evict_pages": sum(e.get("pages", 0) for e in globals_
                                   if e["event"] == "prefix_evict"),
         "per_request": requests,
+    }
+    # graftpack KV-tier split: completed requests classed by how their
+    # prefix was served — promoted (host tier copied pages back),
+    # device_hit (trie pages resident, no promote), re_prefill (no
+    # prefix at all). The promoted-vs-device_hit TTFT gap is the cost
+    # of the H2D copies; promoted-vs-re_prefill is the win.
+    promoted = [r for r in completed if r.get("promoted")]
+    device_hit = [r for r in completed
+                  if not r.get("promoted") and r.get("hit")]
+    re_prefill = [r for r in completed
+                  if not r.get("promoted") and r.get("hit") is False]
+    report["kv_tier"] = {
+        "promoted_requests": len(promoted),
+        "device_hit_requests": len(device_hit),
+        "re_prefill_requests": len(re_prefill),
+        "pages_promoted": sum(r.get("promoted_pages", 0)
+                              for r in rows),
+        "pages_demoted": sum(r.get("demoted_pages", 0) for r in rows),
+        "ttft": {
+            "promoted": _pcts([r.get("ttft_s") for r in promoted]),
+            "device_hit": _pcts([r.get("ttft_s")
+                                 for r in device_hit]),
+            "re_prefill": _pcts([r.get("ttft_s")
+                                 for r in re_prefill]),
+        },
     }
     # Chunked-prefill census: who prefilled in chunks, how many, and
     # the prefill-phase cost per class — the A/B surface for the

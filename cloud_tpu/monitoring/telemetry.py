@@ -129,6 +129,21 @@ SERVE_PREFILL_CHUNKS_TOTAL = "cloud_tpu_serve_prefill_chunks_total"
 SERVE_DECODE_GAP_HISTOGRAM = "cloud_tpu_serve_decode_gap_seconds"
 SERVE_PAGES_PREFILLING = "cloud_tpu_serve_pages_prefilling"
 
+#: graftpack (ROADMAP item 3) names: the KV memory hierarchy. The
+#: bytes gauge labels by tier via the `%s` suffix (hbm = pages the
+#: pool holds x page_hbm_bytes, host = pages the host tier holds at
+#: the same per-page cost); capacity-sessions is how many FULL-length
+#: sequences the pool can hold resident at once — the gauge the int8
+#: page mode exists to raise. Demote/promote counters accrue in PAGES
+#: moved; digest failures count promote-time tree_digest mismatches
+#: (typed HostTierCorrupt, entry dropped, request re-prefills).
+SERVE_KV_BYTES = "cloud_tpu_serve_kv_bytes_%s"
+SERVE_KV_CAPACITY_SESSIONS = "cloud_tpu_serve_kv_capacity_sessions"
+SERVE_HOST_TIER_PAGES = "cloud_tpu_serve_host_tier_pages"
+SERVE_PAGE_DEMOTES_TOTAL = "cloud_tpu_serve_page_demotes_total"
+SERVE_PAGE_PROMOTES_TOTAL = "cloud_tpu_serve_page_promotes_total"
+SERVE_DIGEST_FAILURES_TOTAL = "cloud_tpu_serve_digest_failures_total"
+
 #: graftsweep (tuner/sweep.py) names. Counters accrue across every
 #: sweep a process runs; the gauges hold the LATEST sweep's values.
 #: `_warm_trials_total` counts reused-Trainer trials that finished

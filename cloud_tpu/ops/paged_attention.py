@@ -45,6 +45,23 @@ tick) — which is what the `CLOUD_TPU_PAGED_KERNEL=1` smoke measures;
 the parity suite additionally forces `interpret=True` to pin the true
 interpreted kernel against both the walk and the reference.
 
+Quantized pages (graftpack): with `key_scales`/`value_scales` given
+(`[num_pages, heads]` f32, per-page per-head symmetric scales), the
+K/V pages are int8 and every impl dequantizes INSIDE its block load —
+the dequant contract, identical across kernel/walk/reference:
+
+    k_f32 = k_int8.astype(f32) * scale[page, head]
+
+and both the QK and PV dots run in f32 (int8 quantization already
+costs ~0.4% relative error, so bf16 intermediate rounding would
+dominate it). In the kernel the scale is ONE SMEM scalar per grid
+step — it rides scalar prefetch next to the page table, the dequant
+folds into the dots as a scalar multiply, and nothing dequantized is
+ever materialized in HBM. The walk and reference grow the same math,
+so the parity suite covers all three impls in int8 mode too. A zero
+scale means an all-zero (never-written) page and dequantizes to exact
+zeros.
+
 Forward only: decode never differentiates through the cache.
 """
 
@@ -69,35 +86,74 @@ class _PagedConfig(NamedTuple):
     seq_pad: int     # query rows after sublane padding
     page_size: int
     interpret: bool
+    quantized: bool = False
+
+
+def _check_scales(key_pages, key_scales, value_scales):
+    """Validates the int8-page calling convention: both scale arrays or
+    neither; int8 pages; [num_pages, heads] f32 scales."""
+    if (key_scales is None) != (value_scales is None):
+        raise ValueError(
+            "key_scales and value_scales must be given together.")
+    if key_scales is None:
+        return False
+    num_pages, _, heads, _ = key_pages.shape
+    if key_pages.dtype != jnp.int8:
+        raise ValueError(
+            "scales imply int8 pages; got page dtype {}.".format(
+                key_pages.dtype))
+    for name, s in (("key_scales", key_scales),
+                    ("value_scales", value_scales)):
+        if s.shape != (num_pages, heads):
+            raise ValueError(
+                "{} must be [num_pages, heads] = {}; got {}.".format(
+                    name, (num_pages, heads), s.shape))
+    return True
 
 
 def paged_attention_reference(q, key_pages, value_pages, page_table,
-                              allowed, sm_scale=None):
+                              allowed, sm_scale=None, key_scales=None,
+                              value_scales=None):
     """Gathered-lax paged decode attention (the correctness oracle).
 
     q: [slots, seq, H, D]; key_pages/value_pages: [N, P, H, D];
     page_table: [slots, pages_per_slot] int32; allowed:
     [slots, seq, cache_len] bool (True = attend) ->
-    [slots, seq, H, D] in the page dtype.
+    [slots, seq, H, D] in the page dtype (q's dtype for int8 pages).
 
     Logical per-slot [cache_len] views, one gather per call — bitwise
     the pre-kernel serving-tick math, kept verbatim so the kernel-off
-    engine stays bit-identical to solo `generate()` decodes.
+    engine stays bit-identical to solo `generate()` decodes. With
+    `key_scales`/`value_scales` the int8 pages are dequantized into
+    the gathered f32 view (the module-level dequant contract) and the
+    whole computation stays f32.
     """
     num_pages, page_size, heads, head_dim = key_pages.shape
     slots, pages_per_slot = page_table.shape
     cache_len = pages_per_slot * page_size
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
-    k_view = key_pages[page_table].reshape(slots, cache_len, heads,
-                                           head_dim)
-    v_view = value_pages[page_table].reshape(slots, cache_len, heads,
-                                             head_dim)
+    quantized = _check_scales(key_pages, key_scales, value_scales)
+    if quantized:
+        ks = key_scales[page_table][:, :, None, :, None]
+        vs = value_scales[page_table][:, :, None, :, None]
+        k_view = (key_pages[page_table].astype(jnp.float32) * ks
+                  ).reshape(slots, cache_len, heads, head_dim)
+        v_view = (value_pages[page_table].astype(jnp.float32) * vs
+                  ).reshape(slots, cache_len, heads, head_dim)
+    else:
+        k_view = key_pages[page_table].reshape(slots, cache_len, heads,
+                                               head_dim)
+        v_view = value_pages[page_table].reshape(slots, cache_len,
+                                                 heads, head_dim)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_view,
                         preferred_element_type=jnp.float32) * sm_scale
     logits = jnp.where(allowed[:, None], logits, _NEG_INF)
-    weights = jax.nn.softmax(logits, axis=-1).astype(value_pages.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v_view)
+    out_dtype = q.dtype if quantized else value_pages.dtype
+    weights = jax.nn.softmax(logits, axis=-1).astype(
+        jnp.float32 if quantized else value_pages.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights,
+                      v_view).astype(out_dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -148,61 +204,126 @@ def _paged_kernel(pt_ref, q_ref, k_ref, v_ref, a_ref, o_ref,
         o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
 
 
+def _paged_kernel_quant(pt_ref, ks_ref, vs_ref, q_ref, k_ref, v_ref,
+                        a_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                        config, num_pages):
+    """Int8-page variant: same online softmax, with each block's
+    per-page per-head f32 scale read as ONE SMEM scalar (it rides
+    scalar prefetch next to the page table) and folded into the dots —
+    `s = dot(q, k_i8) * (ks * sm_scale)` and `acc += dot(p, v_i8) * vs`
+    are exactly the pre-dot dequant contract because the scale is
+    constant over the block. Both dots run in f32 (module docstring)."""
+    b = pl.program_id(0)
+    ji = pl.program_id(1)
+    page = pt_ref[b // config.heads, ji]
+    ks = ks_ref[page, b % config.heads]
+    vs = vs_ref[page, b % config.heads]
+
+    @pl.when(ji == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)          # [seq_pad, D]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)  # [P, D] int8 -> f32
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * (ks * config.sm_scale)
+    mask = a_ref[0, :, 0, :] != 0
+
+    s = jnp.where(mask, s, _NEG_INF)
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_curr = jnp.max(s, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_curr)
+    alpha = jnp.exp(m_prev - m_next)
+    p = jnp.where(mask, jnp.exp(s - m_next), 0.0)
+    l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * vs
+    m_ref[...] = jnp.broadcast_to(m_next, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_next, l_ref.shape)
+
+    @pl.when(ji == num_pages - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
 def _paged_forward(config, q, key_pages, value_pages, page_table,
-                   allowed):
+                   allowed, key_scales=None, value_scales=None):
     """q: [S*H, seq_pad, D] (head-folded); allowed:
     [S, seq_pad, pages_per_slot, P] int32 -> out [S*H, seq_pad, D].
 
     The page table is the scalar-prefetch operand: index maps read
     `pt[b // H, j]` to address each program's physical K/V page, so the
-    pool is only ever touched at the pages a slot actually owns.
+    pool is only ever touched at the pages a slot actually owns. In
+    int8 mode the scale arrays join it in SMEM (num_scalar_prefetch=3)
+    and the kernel reads one scalar per grid step.
     """
     bh, seq_pad, head_dim = q.shape
     heads = config.heads
     page_size = config.page_size
     pages_per_slot = page_table.shape[1]
     grid = (bh, pages_per_slot)
-    kernel = functools.partial(_paged_kernel, config=config,
+    n_scalar = 3 if config.quantized else 1
+    kern = _paged_kernel_quant if config.quantized else _paged_kernel
+    kernel = functools.partial(kern, config=config,
                                num_pages=pages_per_slot)
+
+    def _drop(index_map):
+        # Index maps receive every scalar-prefetch operand; only the
+        # page table is ever indexed.
+        return lambda b, j, pt, *_: index_map(b, j, pt)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=n_scalar,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, seq_pad, head_dim),
-                         lambda b, j, pt: (b, 0, 0)),
+                         _drop(lambda b, j, pt: (b, 0, 0))),
             # K/V blocks are single physical pages, gathered by block
             # *indexing* through the prefetched table — never an HBM
             # materialization of the dense [S, cache_len, H, D] view.
             pl.BlockSpec((1, page_size, 1, head_dim),
-                         lambda b, j, pt: (pt[b // heads, j], 0,
-                                           b % heads, 0)),
+                         _drop(lambda b, j, pt: (pt[b // heads, j], 0,
+                                                 b % heads, 0))),
             pl.BlockSpec((1, page_size, 1, head_dim),
-                         lambda b, j, pt: (pt[b // heads, j], 0,
-                                           b % heads, 0)),
+                         _drop(lambda b, j, pt: (pt[b // heads, j], 0,
+                                                 b % heads, 0))),
             # The singleton page axis keeps the mask block's last dim
             # equal to the array dim (Mosaic's lane rule for P < 128).
             pl.BlockSpec((1, seq_pad, 1, page_size),
-                         lambda b, j, pt: (b // heads, 0, j, 0)),
+                         _drop(lambda b, j, pt: (b // heads, 0, j, 0))),
         ],
         out_specs=pl.BlockSpec((1, seq_pad, head_dim),
-                               lambda b, j, pt: (b, 0, 0)),
+                               _drop(lambda b, j, pt: (b, 0, 0))),
         scratch_shapes=[
             pltpu.VMEM((seq_pad, head_dim), jnp.float32),
             pltpu.VMEM((seq_pad, _LANES), jnp.float32),
             pltpu.VMEM((seq_pad, _LANES), jnp.float32),
         ],
     )
-    return pl.pallas_call(
+    out_dtype = q.dtype if config.quantized else value_pages.dtype
+    call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((bh, seq_pad, head_dim),
-                                       value_pages.dtype),
+                                       out_dtype),
         interpret=config.interpret,
-    )(page_table, q, key_pages, value_pages, allowed)
+    )
+    if config.quantized:
+        return call(page_table, key_scales, value_scales, q,
+                    key_pages, value_pages, allowed)
+    return call(page_table, q, key_pages, value_pages, allowed)
 
 
 def _paged_walk_lax(q, key_pages, value_pages, page_table, allowed,
-                    sm_scale):
+                    sm_scale, key_scales=None, value_scales=None):
     """The kernel's defining math as vectorized lax: walk the page
     blocks in grid order, gathering ONLY the slots' own pages (one
     [slots, P, H, D] take per logical page — never the dense
@@ -212,17 +333,25 @@ def _paged_walk_lax(q, key_pages, value_pages, page_table, allowed,
     Pallas interpret mode is ~100x too slow for a serving tick, so the
     `CLOUD_TPU_PAGED_KERNEL=1` smoke runs this form while the parity
     suite pins it against the true interpreted kernel
-    (`interpret=True`) and the gathered reference."""
+    (`interpret=True`) and the gathered reference. Int8 pages are
+    dequantized per page block in f32 (the module dequant contract)."""
     num_pages, page_size, heads, head_dim = key_pages.shape
     slots, seq, q_heads, _ = q.shape
     pages_per_slot = page_table.shape[1]
+    quantized = key_scales is not None
     am = allowed.reshape(slots, seq, pages_per_slot, page_size)
     m = jnp.full((slots, heads, seq, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((slots, heads, seq, 1), jnp.float32)
     acc = jnp.zeros((slots, heads, seq, head_dim), jnp.float32)
     for j in range(pages_per_slot):
-        k = key_pages[page_table[:, j]]      # [slots, P, H, D]
-        v = value_pages[page_table[:, j]]
+        pages = page_table[:, j]
+        k = key_pages[pages]                 # [slots, P, H, D]
+        v = value_pages[pages]
+        if quantized:
+            k = k.astype(jnp.float32) * key_scales[pages][:, None, :,
+                                                          None]
+            v = v.astype(jnp.float32) * value_scales[pages][:, None, :,
+                                                            None]
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                        preferred_element_type=jnp.float32) * sm_scale
         mask = am[:, :, j, :][:, None]       # [slots, 1, seq, P]
@@ -236,13 +365,15 @@ def _paged_walk_lax(q, key_pages, value_pages, page_table, allowed,
                                        p.astype(v.dtype), v)
         m = m_next
     safe_l = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / safe_l).astype(value_pages.dtype)
+    out_dtype = q.dtype if quantized else value_pages.dtype
+    out = (acc / safe_l).astype(out_dtype)
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
 def paged_decode_attention(q, key_pages, value_pages, page_table,
                            allowed, sm_scale=None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           key_scales=None, value_scales=None):
     """Pallas paged decode attention; layouts as the reference.
 
     Handles both the seq=1 plain tick and the seq=spec_k+1 speculative
@@ -250,7 +381,9 @@ def paged_decode_attention(q, key_pages, value_pages, page_table,
     all-masked and sliced away). Output matches
     `paged_attention_reference` to online-softmax accumulation order —
     tolerance-level, not bitwise; fully-masked rows (evicted slots,
-    padded queries) output exact zeros.
+    padded queries) output exact zeros. With scales given the pages
+    are int8 and the kernel dequantizes in its block loads (module
+    docstring).
 
     interpret: None (default) compiles the kernel on TPU and runs the
     lax page-walk form of the same math elsewhere; True forces Pallas
@@ -276,17 +409,21 @@ def paged_decode_attention(q, key_pages, value_pages, page_table,
             "{}.".format((slots, seq, cache_len), allowed.shape))
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
+    quantized = _check_scales(key_pages, key_scales, value_scales)
     if interpret is None:
         if jax.default_backend() != "tpu":
             return _paged_walk_lax(q, key_pages, value_pages,
                                    page_table, allowed,
-                                   float(sm_scale))
+                                   float(sm_scale),
+                                   key_scales=key_scales,
+                                   value_scales=value_scales)
         interpret = False
 
     seq_pad = -(-seq // _SUBLANES) * _SUBLANES
     config = _PagedConfig(sm_scale=float(sm_scale), heads=heads,
                           seq_pad=seq_pad, page_size=page_size,
-                          interpret=bool(interpret))
+                          interpret=bool(interpret),
+                          quantized=quantized)
 
     qf = jnp.transpose(q, (0, 2, 1, 3)).reshape(slots * heads, seq,
                                                 head_dim)
@@ -298,7 +435,9 @@ def paged_decode_attention(q, key_pages, value_pages, page_table,
     amask = amask.reshape(slots, seq_pad, pages_per_slot, page_size)
 
     out = _paged_forward(config, qf, key_pages, value_pages,
-                         page_table.astype(jnp.int32), amask)
+                         page_table.astype(jnp.int32), amask,
+                         key_scales=key_scales,
+                         value_scales=value_scales)
     out = out[:, :seq].reshape(slots, heads, seq, head_dim)
     return jnp.transpose(out, (0, 2, 1, 3))
 
@@ -310,7 +449,8 @@ def paged_decode_attention(q, key_pages, value_pages, page_table,
 
 def paged_attention(q, key_pages, value_pages, page_table, allowed,
                     sm_scale=None, impl="auto",
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    key_scales=None, value_scales=None):
     """Dispatching paged decode attention: Pallas kernel or gathered lax.
 
     impl: "paged" forces the kernel, "reference" forces the gathered
@@ -320,6 +460,8 @@ def paged_attention(q, key_pages, value_pages, page_table, allowed,
     deployment/A-B override and beats `impl`: "1" forces the kernel
     (interpret mode off-TPU, so CPU CI drives the kernel code path),
     "0" forces the reference, unset/empty defers to `impl`.
+    key_scales/value_scales select int8-page mode on whichever impl is
+    picked (the dequant contract in the module docstring).
     """
     env = os.environ.get("CLOUD_TPU_PAGED_KERNEL", "").strip()
     if env == "1":
@@ -336,14 +478,19 @@ def paged_attention(q, key_pages, value_pages, page_table, allowed,
         return paged_decode_attention(q, key_pages, value_pages,
                                       page_table, allowed,
                                       sm_scale=sm_scale,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      key_scales=key_scales,
+                                      value_scales=value_scales)
     return paged_attention_reference(q, key_pages, value_pages,
                                      page_table, allowed,
-                                     sm_scale=sm_scale)
+                                     sm_scale=sm_scale,
+                                     key_scales=key_scales,
+                                     value_scales=value_scales)
 
 
 def paged_attention_cost(slots, seq, heads, head_dim, page_size,
-                         pages_per_slot, dtype=jnp.bfloat16):
+                         pages_per_slot, dtype=jnp.bfloat16,
+                         kv_dtype=None):
     """Per-call flops / bytes-moved row for the telemetry gauges.
 
     flops come from the jit cost-analysis hook (the PR 6 idiom —
@@ -351,12 +498,17 @@ def paged_attention_cost(slots, seq, heads, head_dim, page_size,
     the gathered reference at these shapes; bytes_moved is the kernel's
     HBM traffic (q + out + the slot's own K/V pages + table + mask),
     i.e. what the fused path touches — NOT the dense gather the
-    reference materializes. Returns {"flops", "bytes_moved"}; never
-    raises (falls back to the analytic flop count).
+    reference materializes. kv_dtype (default: `dtype`) sizes the K/V
+    page traffic separately so int8 pages report their real, smaller
+    byte movement (plus the per-page f32 scale reads). Returns
+    {"flops", "bytes_moved"}; never raises (falls back to the analytic
+    flop count).
     """
     cache_len = page_size * pages_per_slot
     num_pages = slots * pages_per_slot + 1
     itemsize = jnp.dtype(dtype).itemsize
+    kv_itemsize = jnp.dtype(kv_dtype or dtype).itemsize
+    quantized = kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8
     # 2 matmuls (qk^T, pv), 2 flops per MAC.
     flops = 4.0 * slots * seq * cache_len * heads * head_dim
     try:
@@ -377,8 +529,11 @@ def paged_attention_cost(slots, seq, heads, head_dim, page_size,
     except Exception:
         pass
     bytes_moved = float(
-        2 * slots * cache_len * heads * head_dim * itemsize   # K/V pages
+        2 * slots * cache_len * heads * head_dim * kv_itemsize  # K/V
         + 2 * slots * seq * heads * head_dim * itemsize       # q + out
         + slots * pages_per_slot * 4                          # table
         + slots * seq * cache_len)                            # mask
+    if quantized:
+        # Per-page per-head f32 K and V scales ride scalar prefetch.
+        bytes_moved += float(2 * slots * pages_per_slot * heads * 4)
     return {"flops": flops, "bytes_moved": bytes_moved}

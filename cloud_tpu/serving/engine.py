@@ -379,7 +379,7 @@ class DecodeEngine:
 
     def __init__(self, model, params, slots, page_size, num_pages,
                  max_new_cap=None, draft_model=None, draft_params=None,
-                 spec_k=0):
+                 spec_k=0, page_dtype=""):
         from cloud_tpu.models.transformer import TransformerLM
 
         if not isinstance(model, TransformerLM):
@@ -390,6 +390,10 @@ class DecodeEngine:
             raise ValueError(
                 "max_seq_len ({}) must be a multiple of page_size "
                 "({}).".format(model.max_seq_len, page_size))
+        if page_dtype not in ("", "int8"):
+            raise ValueError(
+                "page_dtype must be '' or 'int8'; got {!r}.".format(
+                    page_dtype))
         self.model = model
         self.slots = int(slots)
         self.page_size = int(page_size)
@@ -402,13 +406,18 @@ class DecodeEngine:
         self._params = params
         self.spec_k = int(spec_k)
         self.spec_on = draft_model is not None and self.spec_k > 0
+        # "" = pages in compute_dtype; "int8" = graftpack quantized
+        # pages (per-page per-head f32 scale sidecars in the same
+        # cache subtrees — models/transformer.py).
+        self.page_dtype = str(page_dtype)
         # The SAME decode clone generate() derives, so the engine's
         # dense prefill caches come from the shared reuse pool solo
         # generate() calls in the process also draw from.
         self._dense = model.clone(decode=True, dropout_rate=0.0)
         self._paged = model.clone(decode=True, dropout_rate=0.0,
                                   kv_page_size=page_size,
-                                  kv_num_pages=num_pages)
+                                  kv_num_pages=num_pages,
+                                  kv_page_dtype=self.page_dtype)
 
         from cloud_tpu.models.decoding import (best_effort_donation,
                                                empty_cache)
@@ -437,7 +446,8 @@ class DecodeEngine:
             # trie) serves target and draft caches.
             self._paged_draft = draft_model.clone(
                 decode=True, dropout_rate=0.0, kv_page_size=page_size,
-                kv_num_pages=num_pages)
+                kv_num_pages=num_pages,
+                kv_page_dtype=self.page_dtype)
             self.draft_cache = _plain(
                 empty_cache(self._paged_draft, self.slots))
         else:
@@ -477,6 +487,11 @@ class DecodeEngine:
                 jit, donate_argnums=(0, 1))(self._evict_impl))
         self._gather = best_effort_donation(functools.partial(
             jit, donate_argnums=(0,))(self._gather_impl))
+        # Host-tier executables: snapshot READS the pool cache (no
+        # donation — the tick keeps it); promote replaces it.
+        self._snapshot = jit(self._snapshot_impl)
+        self._promote = best_effort_donation(functools.partial(
+            jit, donate_argnums=(0,))(self._promote_impl))
         self._warm_stats = None
         self._kernel_costs = None
 
@@ -720,7 +735,9 @@ class DecodeEngine:
             cost = ops.paged_attention_cost(
                 self.slots, seq, model.num_heads, head_dim,
                 self.page_size, self.pages_per_slot,
-                dtype=model.compute_dtype)
+                dtype=model.compute_dtype,
+                kv_dtype=(jnp.int8 if self.page_dtype == "int8"
+                          else None))
             layers = model.num_layers
             self._kernel_costs = {
                 "paged_attention": {
@@ -746,10 +763,20 @@ class DecodeEngine:
 
         def seed(att, datt):
             out = dict(datt)
-            k = att["key_pages"][page_vec].reshape(
-                1, L, *att["key_pages"].shape[2:])
-            v = att["value_pages"][page_vec].reshape(
-                1, L, *att["value_pages"].shape[2:])
+            k = att["key_pages"][page_vec]   # [ppn, P, H, D]
+            v = att["value_pages"][page_vec]
+            if "key_scales" in att:
+                # Int8 pool -> dense compute-dtype cache: dequantize
+                # with the per-page per-head scales (never-written
+                # pages carry scale 0 -> exact zeros).
+                ks = att["key_scales"][page_vec][:, None, :, None]
+                vs = att["value_scales"][page_vec][:, None, :, None]
+                k = (k.astype(jnp.float32) * ks).astype(
+                    datt["cached_key"].dtype)
+                v = (v.astype(jnp.float32) * vs).astype(
+                    datt["cached_value"].dtype)
+            k = k.reshape(1, L, *k.shape[2:])
+            v = v.reshape(1, L, *v.shape[2:])
             out["cached_key"] = jnp.where(
                 valid[None, :, None, None], k, jnp.zeros((), k.dtype))
             out["cached_value"] = jnp.where(
@@ -775,7 +802,15 @@ class DecodeEngine:
         fresh page, or scratch when shared content is already there);
         the page table gets page_vec. slot_steps comes from
         token_count (REAL tokens — cache_index includes the right-pad,
-        which must be overwritten by decode writes, not skipped)."""
+        which must be overwritten by decode writes, not skipped).
+
+        Int8 pools quantize here, per chunk per head: invalid (right-
+        pad) positions are zeroed BEFORE the amax so pad garbage never
+        inflates a page's scale, and each owned page's scale resets to
+        its chunk amax / 127 — which is what makes recycled pages'
+        stale scales unobservable (every owned page passes through
+        this scatter or the promote before a decode write can grow its
+        scale)."""
         ppn, page = self.pages_per_slot, self.page_size
 
         def scatter(att, patt):
@@ -784,6 +819,25 @@ class DecodeEngine:
                 ppn, page, *patt["cached_key"].shape[2:])
             chunks_v = patt["cached_value"][0].reshape(
                 ppn, page, *patt["cached_value"].shape[2:])
+            if "key_scales" in att:
+                vm = patt["slot_valid"][0].astype(jnp.float32).reshape(
+                    ppn, page)[:, :, None, None]
+
+                def quant(chunks):
+                    cf = chunks.astype(jnp.float32) * vm
+                    amax = jnp.max(jnp.abs(cf), axis=(1, 3))  # [ppn,H]
+                    scale = amax / 127.0
+                    safe = jnp.where(scale > 0, scale, 1.0)
+                    q = jnp.clip(jnp.round(cf / safe[:, None, :, None]),
+                                 -127, 127).astype(jnp.int8)
+                    return q, scale
+
+                chunks_k, scale_k = quant(chunks_k)
+                chunks_v, scale_v = quant(chunks_v)
+                out["key_scales"] = att["key_scales"].at[
+                    scatter_vec].set(scale_k)
+                out["value_scales"] = att["value_scales"].at[
+                    scatter_vec].set(scale_v)
             # Owned ids are unique and nonzero, so fresh chunks land
             # exactly; shared/overflow chunks collapse onto scratch,
             # whose content is never attended.
@@ -995,6 +1049,111 @@ class DecodeEngine:
             jnp.where(is_spec, n_acc, -1)[None, :],
         ], axis=0)  # [k+4, S]
         return cache, dcache, out_ctl, out
+
+    def _snapshot_impl(self, cache, page_vec):
+        """Per-attention-layer K/V page blocks (+ scale sidecars) for
+        `page_vec` ([pages_per_slot] int32, scratch-padded) — the
+        device half of a host-tier demote. Reads the pool cache, never
+        donates it; one fixed-shape executable for any page count."""
+        def snap(att):
+            entry = {"key_pages": att["key_pages"][page_vec],
+                     "value_pages": att["value_pages"][page_vec]}
+            if "key_scales" in att:
+                entry["key_scales"] = att["key_scales"][page_vec]
+                entry["value_scales"] = att["value_scales"][page_vec]
+            return entry
+
+        tree = _map_attention(cache, snap)
+        tree.pop("pos_count", None)
+        return tree
+
+    def _promote_impl(self, cache, host_tree, page_vec):
+        """Scatters a host-tier entry's page blocks back into the pool
+        at `page_vec` (full-width, scratch-padded past the promoted
+        extension — padded rows collapse onto scratch exactly like the
+        insert scatter's shared chunks)."""
+        def prom(att, h):
+            out = dict(att)
+            out["key_pages"] = att["key_pages"].at[page_vec].set(
+                h["key_pages"])
+            out["value_pages"] = att["value_pages"].at[page_vec].set(
+                h["value_pages"])
+            if "key_scales" in att:
+                out["key_scales"] = att["key_scales"].at[page_vec].set(
+                    h["key_scales"])
+                out["value_scales"] = att["value_scales"].at[
+                    page_vec].set(h["value_scales"])
+            return out
+
+        # The snapshot strips pos_count (it is slot state, not page
+        # content); put a placeholder back so the parallel walk indexes
+        # the same top-level keys the cache has.
+        host_tree = dict(host_tree)
+        host_tree.setdefault("pos_count", 0)
+        return _map_attention(cache, prom, host_tree)
+
+    # -- host page tier (tick thread) ---------------------------------
+
+    def snapshot_pages(self, page_ids):
+        """Host numpy snapshot of `page_ids`' pool content (the demote
+        D2H): a pytree mirroring the cache's attention subtrees, each
+        holding `[n, P, H, D]` K/V blocks (+ `[n, H]` scales in int8
+        mode) with n == len(page_ids), rows in logical page order.
+        Tick thread only — reads the tick-donated cache."""
+        n = len(page_ids)
+        vec = jnp.asarray(self.pool_page_vec(page_ids), jnp.int32)
+        tree = jax.device_get(self._snapshot(self.cache, vec))
+        return jax.tree_util.tree_map(lambda a: a[:n], tree)
+
+    def promote_pages(self, host_tree, page_ids, n_skip=0):
+        """Writes a host-tier snapshot back into the pool (the promote
+        H2D): logical page i of `host_tree` lands in physical page
+        `page_ids[i]`, except the first `n_skip` logical pages (already
+        resident via the prefix trie) and any `page_ids` entry of 0,
+        which collapse onto scratch. Tick thread only."""
+        vec = self.pool_page_vec(page_ids)
+        vec[:n_skip] = 0
+        n = len(page_ids)
+        ppn = self.pages_per_slot
+
+        def pad(a):
+            if a.shape[0] == ppn:
+                return a
+            widths = [(0, ppn - n)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(np.asarray(a), widths)
+
+        padded = jax.tree_util.tree_map(pad, host_tree)
+        self.cache = self._promote(self.cache, padded,
+                                   jnp.asarray(vec, jnp.int32))
+
+    def pool_page_vec(self, page_ids):
+        """Full-width scratch-padded page vector (kvpool.page_vec's
+        layout) — kept here so engine-level callers don't need the
+        pool object."""
+        vec = np.zeros((self.pages_per_slot,), np.int32)
+        vec[:len(page_ids)] = page_ids
+        return vec
+
+    def page_hbm_bytes(self):
+        """HBM bytes ONE physical page costs summed over every
+        attention layer (K + V blocks, plus the f32 scale sidecars in
+        int8 mode; the draft pool included when speculating — it keys
+        on the same page ids). Feeds PagePool.page_bytes for the
+        KV-hierarchy gauges."""
+        def per_model(m):
+            head_dim = m.d_model // m.num_heads
+            item = (1 if self.page_dtype == "int8"
+                    else jnp.dtype(m.compute_dtype).itemsize)
+            per_layer = 2 * self.page_size * m.num_heads * head_dim \
+                * item
+            if self.page_dtype == "int8":
+                per_layer += 2 * m.num_heads * 4
+            return per_layer * m.num_layers
+
+        total = per_model(self.model)
+        if self.spec_on:
+            total += per_model(self._paged_draft)
+        return int(total)
 
     def _clear_slots(self, cache, keep):
         def clear(att):

@@ -24,7 +24,8 @@ telemetry labels and reqtrace payloads.
 """
 
 __all__ = ["ServeFault", "SlotHang", "SlotEvicted", "PrefillFailed",
-           "PoolSqueezed", "ServeShed", "fault_kind"]
+           "PoolSqueezed", "ServeShed", "HostTierCorrupt",
+           "fault_kind"]
 
 
 class ServeFault(RuntimeError):
@@ -61,6 +62,15 @@ class PoolSqueezed(ServeFault):
     any slot drained to cover the squeeze requeues."""
 
     fault_kind = "pool_squeeze"
+
+
+class HostTierCorrupt(ServeFault):
+    """A host-tier page entry failed its tree_digest check at promote
+    time (graftpack): the entry is dropped and admission falls back to
+    re-prefilling the history — corrupt pages are never mapped into a
+    slot. Counted, never fatal to the request."""
+
+    fault_kind = "host_tier_corrupt"
 
 
 class ServeShed(ServeFault):

@@ -43,7 +43,8 @@ class PagePool:
     this object, only the page-id vectors it emits.
     """
 
-    def __init__(self, num_pages, page_size, pages_per_slot):
+    def __init__(self, num_pages, page_size, pages_per_slot,
+                 page_dtype="", page_bytes=0):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is the "
                              "scratch page); got {}.".format(num_pages))
@@ -53,6 +54,14 @@ class PagePool:
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.pages_per_slot = int(pages_per_slot)
+        # Byte accounting for the KV-hierarchy gauges: page_dtype is
+        # the storage dtype name ("" = the engine compute dtype,
+        # "int8" = graftpack quantized pages) and page_bytes the HBM
+        # bytes ONE physical page costs summed over every attention
+        # layer (K + V + scale sidecars). Zero when the engine doesn't
+        # wire it (pool used standalone in tests).
+        self.page_dtype = str(page_dtype)
+        self.page_bytes = int(page_bytes)
         self._cond = threading.Condition()
         # LIFO free list: recently-freed pages are re-handed first
         # (warm in whatever cache hierarchy the backend keeps).
@@ -228,6 +237,9 @@ class PagePool:
                 "cow_copies": self._cow_copies,
                 "reserve_waiters": self._reserve_waiters,
                 "refcount_hist": hist,
+                "page_dtype": self.page_dtype,
+                "kv_bytes_held": len(self._refs) * self.page_bytes,
+                "kv_bytes_total": self.capacity * self.page_bytes,
             }
 
     def leak_report(self):
@@ -252,4 +264,151 @@ class PagePool:
         return vec
 
 
-__all__ = ["PagePool"]
+class HostPageTier:
+    """Host-RAM second tier of the KV page hierarchy (graftpack).
+
+    Holds page-granular KV snapshots of completed conversation turns,
+    keyed by the token prefix they encode, so the NEXT turn's admission
+    can promote them back with a few H2D page copies instead of
+    re-prefilling the whole history. This turns the prefix cache into a
+    session store that survives pool pressure: trie eviction may drop
+    the device pages, the host copy persists.
+
+    An entry is `{key: token tuple (page-aligned prefix), pages: the
+    engine's host-side page pytree snapshot (numpy; per-layer K/V page
+    blocks + scale sidecars in int8 mode), n_pages, digest}`. The
+    digest is `checkpoint.tree_digest` over the snapshot at demote
+    time; promote recomputes it and a mismatch is a typed
+    `HostTierCorrupt` fault — the entry is dropped and admission falls
+    back to re-prefill, never serving corrupt pages.
+
+    Budgeted in PAGES with LRU eviction (a host tier exists to be much
+    larger than HBM, but smoke rigs still need determinism). All
+    host-side python, thread-safe; the device is only ever touched by
+    the engine's fixed-shape promote executable.
+    """
+
+    def __init__(self, max_pages, page_size):
+        if max_pages < 1:
+            raise ValueError("max_pages must be >= 1; got {}.".format(
+                max_pages))
+        self.max_pages = int(max_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        self._entries = {}   # key tuple -> entry dict
+        self._clock = 0
+        self.demotes = 0
+        self.promotes = 0
+        self.digest_failures = 0
+        self.evictions = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def held_pages(self):
+        with self._lock:
+            return sum(e["n_pages"] for e in self._entries.values())
+
+    def contains(self, tokens):
+        """True when an entry for exactly this page-aligned prefix
+        exists (cheap pre-snapshot dedup check)."""
+        with self._lock:
+            return tuple(tokens) in self._entries
+
+    def put(self, tokens, pages, n_pages, digest):
+        """Demotes a snapshot: `tokens` is the page-aligned token
+        prefix the pages encode (len == n_pages * page_size), `pages`
+        the host pytree, `digest` its tree_digest stamp. Evicts LRU
+        entries to stay under the page budget; an oversized snapshot
+        is refused (False) rather than thrashing the whole tier."""
+        key = tuple(int(t) for t in tokens)
+        if len(key) != n_pages * self.page_size:
+            raise ValueError(
+                "demote key must be page-aligned: {} tokens vs {} "
+                "pages of {}.".format(len(key), n_pages,
+                                      self.page_size))
+        if n_pages > self.max_pages:
+            return False
+        with self._lock:
+            held = sum(e["n_pages"] for e in self._entries.values())
+            if key in self._entries:
+                held -= self._entries[key]["n_pages"]
+            while held + n_pages > self.max_pages and self._entries:
+                lru = min(self._entries,
+                          key=lambda k: self._entries[k]["stamp"])
+                held -= self._entries[lru]["n_pages"]
+                del self._entries[lru]
+                self.evictions += 1
+            self._clock += 1
+            self._entries[key] = {"pages": pages, "n_pages": n_pages,
+                                  "digest": digest,
+                                  "stamp": self._clock}
+            self.demotes += 1
+            return True
+
+    def probe(self, tokens):
+        """Longest page-aligned prefix of `tokens` with a host entry,
+        in TOKENS (0 = none). Side-effect-free and cheap — one dict
+        probe per page boundary, longest first — so admission can rank
+        by it like the trie's probe."""
+        limit = (len(tokens) - 1) // self.page_size
+        key = tuple(int(t) for t in tokens[:limit * self.page_size])
+        with self._lock:
+            for n in range(limit, 0, -1):
+                if key[:n * self.page_size] in self._entries:
+                    return n * self.page_size
+        return 0
+
+    def get(self, tokens, n_pages):
+        """The entry for exactly `tokens[:n_pages * page_size]`, LRU-
+        refreshed, or None. Digest verification is the CALLER's step
+        (scheduler promote) so the failure is typed and counted there."""
+        key = tuple(int(t) for t in tokens[:n_pages * self.page_size])
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._clock += 1
+                entry["stamp"] = self._clock
+            return entry
+
+    def drop(self, tokens, n_pages):
+        """Removes one entry (digest mismatch / explicit invalidation)."""
+        key = tuple(int(t) for t in tokens[:n_pages * self.page_size])
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def note_promote(self):
+        with self._lock:
+            self.promotes += 1
+
+    def note_digest_failure(self):
+        with self._lock:
+            self.digest_failures += 1
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+    def reset_stats(self):
+        with self._lock:
+            self.demotes = 0
+            self.promotes = 0
+            self.digest_failures = 0
+            self.evictions = 0
+
+    def stats(self):
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "pages": sum(e["n_pages"]
+                             for e in self._entries.values()),
+                "max_pages": self.max_pages,
+                "demotes": self.demotes,
+                "promotes": self.promotes,
+                "digest_failures": self.digest_failures,
+                "evictions": self.evictions,
+            }
+
+
+__all__ = ["PagePool", "HostPageTier"]

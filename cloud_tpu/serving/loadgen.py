@@ -32,6 +32,7 @@ import dataclasses
 import json
 import os
 import queue
+import threading
 import time
 
 import numpy as np
@@ -248,6 +249,127 @@ def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
     }
 
 
+@dataclasses.dataclass
+class ConversationSpec:
+    """Multi-turn conversation traffic (graftpack's host-tier
+    workload): N concurrent sessions, each a closed loop of T turns —
+    turn t's prompt is the FULL history (turn t-1's prompt +
+    continuation) plus `user_tokens` fresh tokens, submitted after a
+    `think_time` gap. Between a turn's completion and the next turn's
+    arrival the session's KV pages are idle — exactly the window the
+    host tier demotes into, and the trie LRU evicts under pressure.
+    All randomness flows from `seed`."""
+    n_sessions: int = 4
+    n_turns: int = 3
+    user_tokens: int = 8
+    max_new_lo: int = 4
+    max_new_hi: int = 8             # inclusive
+    think_time: float = 0.05
+    seed: int = 0
+
+    def validate(self):
+        if self.n_sessions < 1 or self.n_turns < 1:
+            raise ValueError("n_sessions and n_turns must be >= 1.")
+        if self.user_tokens < 1:
+            raise ValueError("user_tokens must be >= 1.")
+        if self.think_time < 0:
+            raise ValueError("think_time must be >= 0.")
+
+
+def run_conversations(scheduler, spec, result_timeout=300.0):
+    """Drives `spec.n_sessions` concurrent multi-turn conversations.
+
+    Each session is closed-loop (a user cannot send turn t+1 before
+    reading turn t) but sessions overlap, so resident-page pressure and
+    trie eviction are real. A session ends early when the growing
+    history no longer fits max_seq_len. Returns the run report
+    (format cloud_tpu.loadgen_conv.v1): per-turn rows with
+    session/turn/prompt_len/ttft/prefix_len, plus TTFT percentiles
+    split first-turn vs follow-up — the follow-up split is the number
+    the host tier exists to keep near the cache-hit floor after
+    eviction."""
+    from cloud_tpu.serving.scheduler import ServeRequest
+
+    spec.validate()
+    max_seq_len = scheduler.engine.max_seq_len
+    vocab = scheduler.engine.model.vocab_size
+    hi = max(3, vocab)
+    rows_lock = threading.Lock()
+    rows = []
+
+    def session(idx):
+        rng = np.random.default_rng(spec.seed + 17 * idx)
+        history = []
+        for turn in range(spec.n_turns):
+            fresh = rng.integers(2, hi, (spec.user_tokens,)).tolist()
+            prompt = history + [int(t) for t in fresh]
+            max_new = int(rng.integers(spec.max_new_lo,
+                                       spec.max_new_hi + 1))
+            if len(prompt) + max_new > max_seq_len:
+                return  # history outgrew the context window
+            request = ServeRequest(prompt=prompt,
+                                   max_new_tokens=max_new,
+                                   temperature=0.0,
+                                   rng_seed=int(rng.integers(
+                                       0, 2**31 - 1)))
+            row = {"session": idx, "turn": turn,
+                   "prompt_len": len(prompt), "max_new": max_new}
+            try:
+                result = scheduler.submit(request, timeout=30).result(
+                    timeout=result_timeout)
+            except BaseException as exc:  # noqa: BLE001
+                row["status"] = ("shed" if fault_kind(exc) == "shed"
+                                 else "failed")
+                row["error"] = "{}: {}".format(type(exc).__name__,
+                                               str(exc)[:200])
+                with rows_lock:
+                    rows.append(row)
+                return
+            row.update(status="complete",
+                       ttft_s=round(result.ttft_s, 6),
+                       latency_s=round(result.latency_s, 6),
+                       prefix_len=int(result.prefix_len))
+            with rows_lock:
+                rows.append(row)
+            history = [int(t) for t in result.tokens]
+            if spec.think_time:
+                time.sleep(spec.think_time)
+
+    threads = [threading.Thread(target=session, args=(i,), daemon=True)
+               for i in range(spec.n_sessions)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=result_timeout)
+    wall = max(time.monotonic() - t0, 1e-9)
+    rows.sort(key=lambda r: (r["session"], r["turn"]))
+    done = [r for r in rows if r["status"] == "complete"]
+    first = [r["ttft_s"] for r in done if r["turn"] == 0]
+    later = [r["ttft_s"] for r in done if r["turn"] > 0]
+    return {
+        "format": "cloud_tpu.loadgen_conv.v1",
+        "spec": {
+            "n_sessions": spec.n_sessions,
+            "n_turns": spec.n_turns,
+            "user_tokens": spec.user_tokens,
+            "max_new": [spec.max_new_lo, spec.max_new_hi],
+            "think_time": spec.think_time,
+            "seed": spec.seed,
+        },
+        "offered": len(rows),
+        "completed": len(done),
+        "failed": sum(1 for r in rows if r["status"] == "failed"),
+        "shed": sum(1 for r in rows if r["status"] == "shed"),
+        "duration_s": wall,
+        "ttft_first_turn": _percentiles(first),
+        "ttft_follow_up": _percentiles(later),
+        "follow_up_prefix_tokens": _percentiles(
+            [float(r["prefix_len"]) for r in done if r["turn"] > 0]),
+        "per_request": rows,
+    }
+
+
 def _build_scheduler(args):
     import jax
     import jax.numpy as jnp
@@ -259,11 +381,14 @@ def _build_scheduler(args):
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     pages_per_slot = model.max_seq_len // args.page_size
+    num_pages = args.num_pages or (args.slots + 4) * pages_per_slot + 1
     return Scheduler(model, params, slots=args.slots,
                      page_size=args.page_size,
-                     num_pages=(args.slots + 4) * pages_per_slot + 1,
+                     num_pages=num_pages,
                      admission_window=args.slots,
-                     strict_no_retrace=False)
+                     strict_no_retrace=False,
+                     kv_dtype=args.kv_dtype,
+                     host_tier=args.host_tier)
 
 
 def main(argv=None):
@@ -284,10 +409,30 @@ def main(argv=None):
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--num-pages", type=int, default=0,
+                        help="KV pool pages (0 = slots+4 sequences); "
+                        "set small to force trie eviction between "
+                        "conversation turns")
     parser.add_argument("--layers", type=int, default=6,
                         help="model depth (2 keeps CI fast)")
+    parser.add_argument("--scenario", default="open",
+                        choices=("open", "conversation"),
+                        help="open-arrival singles, or multi-turn "
+                        "conversations (the host-tier workload)")
+    parser.add_argument("--conversations", type=int, default=4)
+    parser.add_argument("--turns", type=int, default=3)
+    parser.add_argument("--user-tokens", type=int, default=8)
+    parser.add_argument("--think-time", type=float, default=0.05)
+    parser.add_argument("--kv-dtype", default=None,
+                        help="KV page dtype: '' (compute dtype) or "
+                        "int8 (default: CLOUD_TPU_SERVE_KV_DTYPE)")
+    parser.add_argument("--host-tier", default=None, type=int,
+                        help="1 = demote finished turns to host RAM "
+                        "(default: CLOUD_TPU_SERVE_HOST_TIER)")
     parser.add_argument("--out-dir", default="loadgen-out")
     args = parser.parse_args(argv)
+    if args.host_tier is not None:
+        args.host_tier = bool(args.host_tier)
 
     os.makedirs(args.out_dir, exist_ok=True)
     from cloud_tpu.serving import reqtrace
@@ -298,6 +443,8 @@ def main(argv=None):
 
     scheduler = _build_scheduler(args)
     scheduler.start()
+    if args.scenario == "conversation":
+        return _main_conversation(args, scheduler)
     rates = args.rate or [8.0]
     specs = [LoadSpec(rate=rate, n_requests=args.requests,
                       process=args.process,
@@ -342,6 +489,56 @@ def main(argv=None):
             "reserve_wait": stats["reserve_wait"],
             "ttft": stats["ttft"],
             "prefix_hit_rate": stats["prefix_hit_rate"],
+        },
+    }
+    out_path = os.path.join(args.out_dir, "loadgen_report.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    print("[loadgen] wrote {}".format(out_path))
+    return 0
+
+
+def _main_conversation(args, scheduler):
+    """Conversation-scenario driver: warm every pow2 bucket (turn
+    prompts grow at runtime, so any width can appear), run the
+    sessions, report the first-turn vs follow-up TTFT split plus the
+    scheduler's demote/promote census."""
+    from cloud_tpu.serving import reqtrace
+    spec = ConversationSpec(
+        n_sessions=args.conversations, n_turns=args.turns,
+        user_tokens=args.user_tokens, think_time=args.think_time,
+        seed=args.seed)
+    try:
+        print("[loadgen] warmup (all pow2 buckets)")
+        scheduler.warmup([scheduler.engine.max_seq_len],
+                         sampling_configs=[(("temperature", 0.0),)])
+        print("[loadgen] conversations x{} turns x{}".format(
+            spec.n_sessions, spec.n_turns))
+        run = run_conversations(scheduler, spec)
+        stats = scheduler.stats()
+        # Leak detector: every session thread has joined, so after the
+        # tick thread quiesces the pool must hold nothing beyond the
+        # trie's own references — the CI offload job gates on this.
+        time.sleep(0.3)
+        scheduler.assert_drained(clear_prefix=True)
+        leaked = scheduler.pool.leak_report()
+    finally:
+        scheduler.close()
+        tracer = reqtrace.get()
+        if tracer is not None:
+            tracer.flush()
+    print("[loadgen]   completed {}/{}: ttft p50 first {} follow-up "
+          "{}".format(run["completed"], run["offered"],
+                      run["ttft_first_turn"]["p50"],
+                      run["ttft_follow_up"]["p50"]))
+    report = {
+        "format": "cloud_tpu.loadgen_sweep.v1",
+        "runs": [run],
+        "scheduler_stats": {
+            "ttft": stats["ttft"],
+            "prefix_hit_rate": stats["prefix_hit_rate"],
+            "kv": stats["kv"],
+            "leaked_pages": leaked,
         },
     }
     out_path = os.path.join(args.out_dir, "loadgen_report.json")

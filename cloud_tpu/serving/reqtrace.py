@@ -18,6 +18,18 @@ prefill phase is tiled by per-chunk events emitted at each dispatch::
 still telescope to the submitted -> complete wall time with or without
 them, and ``collect --serve`` audits exactly that.
 
+graftpack (the KV memory hierarchy) adds page-tier movement events:
+``page_demote{pages, tokens}`` fires at a request's completion when its
+written prefix pages snapshot to the host tier, and
+``page_promote{pages, prefix_len}`` fires INSIDE a later request's
+admission when host pages are copied back ahead of its suffix prefill —
+a promoted request's ``prefill`` event then carries the promoted
+``prefix_len``, which is how ``collect --serve`` splits follow-up-turn
+TTFT into promoted vs device-cache-hit vs re-prefill classes.
+``page_demote`` lands between the final tick and ``complete`` on the
+same rid; neither event is a lifecycle boundary, so phase sums
+telescope unchanged.
+
 graftstorm (serving chaos) adds mid-lifecycle fault events: a chaos
 injection that hits an in-flight request emits ``slot_fault`` (with the
 taxonomy ``kind`` and the victim slot) followed by ``requeue`` (with

@@ -102,10 +102,10 @@ from cloud_tpu.monitoring import spans
 from cloud_tpu.parallel import runtime
 from cloud_tpu.serving import reqtrace
 from cloud_tpu.serving.engine import DecodeEngine
-from cloud_tpu.serving.faults import (PoolSqueezed, PrefillFailed,
-                                      ServeShed, SlotEvicted, SlotHang,
-                                      fault_kind)
-from cloud_tpu.serving.kvpool import PagePool
+from cloud_tpu.serving.faults import (HostTierCorrupt, PoolSqueezed,
+                                      PrefillFailed, ServeShed,
+                                      SlotEvicted, SlotHang, fault_kind)
+from cloud_tpu.serving.kvpool import HostPageTier, PagePool
 from cloud_tpu.serving.prefixcache import PrefixCache
 
 #: pool_squeeze hold window: confiscated pages return after this many
@@ -302,18 +302,56 @@ class Scheduler:
                  admission_window=8, strict_no_retrace=False,
                  prefix_cache=True, prefix_cache_pages=None,
                  draft_model=None, draft_params=None, spec_k=0,
-                 slo_ttft=None, shed_policy=None, prefill_chunk=None):
+                 slo_ttft=None, shed_policy=None, prefill_chunk=None,
+                 kv_dtype=None, host_tier=None, host_tier_pages=None):
         if num_pages is None:
             # Default: every slot can hold a full-length sequence, plus
             # scratch — paging then bounds fragmentation, not memory.
             num_pages = slots * (model.max_seq_len // page_size) + 1
+        # -- graftpack: KV page dtype + host page tier ----------------
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("CLOUD_TPU_SERVE_KV_DTYPE",
+                                      "").strip().lower()
+        if kv_dtype in _OFF_VALUES:
+            kv_dtype = ""
+        if kv_dtype not in ("", "int8"):
+            raise ValueError(
+                "kv_dtype must be '' or 'int8'; got {!r}.".format(
+                    kv_dtype))
+        self.kv_dtype = kv_dtype
+        if host_tier is None:
+            env = os.environ.get("CLOUD_TPU_SERVE_HOST_TIER",
+                                 "").strip().lower()
+            host_tier = env not in _OFF_VALUES
+        if host_tier:
+            if draft_model is not None and spec_k > 0:
+                raise ValueError(
+                    "host_tier is incompatible with speculative decode "
+                    "(the verify window transiently writes past the "
+                    "committed history a demote key would stamp).")
+            if not prefix_cache:
+                raise ValueError(
+                    "host_tier requires prefix_cache=True (promote "
+                    "rides the hit-admission path and registers its "
+                    "pages in the trie).")
         self.engine = DecodeEngine(model, params, slots, page_size,
                                    num_pages, max_new_cap=max_new_cap,
                                    draft_model=draft_model,
                                    draft_params=draft_params,
-                                   spec_k=spec_k)
+                                   spec_k=spec_k, page_dtype=kv_dtype)
         self.pool = PagePool(num_pages, page_size,
-                             self.engine.pages_per_slot)
+                             self.engine.pages_per_slot,
+                             page_dtype=kv_dtype,
+                             page_bytes=self.engine.page_hbm_bytes())
+        self.host_tier = None
+        if host_tier:
+            if host_tier_pages is None:
+                env = os.environ.get("CLOUD_TPU_SERVE_HOST_TIER_PAGES",
+                                     "").strip()
+                # Default: 4x the device pool — a host tier exists to
+                # be much larger than HBM.
+                host_tier_pages = int(env) if env else 4 * num_pages
+            self.host_tier = HostPageTier(host_tier_pages, page_size)
         # prefix_cache_pages is the trie's HBM budget (None = half the
         # pool — see PrefixCache); prefix_cache=False disables sharing
         # entirely (every request cold-prefills, the A/B baseline).
@@ -558,7 +596,14 @@ class Scheduler:
     def _probe(self, request):
         if self.trie is None or request.max_new_tokens <= 1:
             return 0
-        return self.trie.probe([int(t) for t in request.prompt])
+        prompt = [int(t) for t in request.prompt]
+        matched = self.trie.probe(prompt)
+        if self.host_tier is not None:
+            # A host-only match must route through the hit path too:
+            # the promote executable touches the live cache, which only
+            # the tick thread may write.
+            matched = max(matched, self.host_tier.probe(prompt))
+        return matched
 
     @staticmethod
     def _sampling(request):
@@ -1470,6 +1515,9 @@ class Scheduler:
             else:
                 self.pool.free([shared.pop()])
             prefix_len = len(shared) * page + partial_len
+        shared, partial_page, partial_len, prefix_len = \
+            self._host_extend(ticket, prompt, prompt_len, shared,
+                              partial_page, partial_len, prefix_len)
         held = shared + ([partial_page] if partial_len else [])
         if prefix_len == 0:
             # Evicted (or trimmed away) between probe and match: it is
@@ -1618,6 +1666,114 @@ class Scheduler:
             return
         self.trie.register([int(t) for t in request.prompt], pages)
 
+    # -- graftpack: host page tier demote/promote ---------------------
+
+    def _host_extend(self, ticket, prompt, prompt_len, shared,
+                     partial_page, partial_len, prefix_len):
+        """Promote: extend the trie's device-resident prefix with
+        host-tier pages from a completed earlier turn. Finds the
+        longest host entry strictly past the trie match (page-aligned,
+        leaving >= 1 suffix token, and fitting the same
+        prefix+pow2(suffix) constraint the trim loop enforces),
+        verifies its tree_digest (mismatch -> typed HostTierCorrupt,
+        entry dropped, the trie prefix alone carries on — corrupt
+        pages are never mapped), reserves the extension pages
+        NON-BLOCKING (promotion is an optimization; a starved pool
+        falls back to re-prefilling the tail), and runs the engine's
+        fixed-shape promote scatter. The extension pages ride the hit
+        flow as extra `shared` pages: the insert scatter routes them
+        to scratch, `_register` indexes them, refcounts balance
+        exactly like trie-matched pages. Tick thread only."""
+        tier = self.host_tier
+        if tier is None:
+            return shared, partial_page, partial_len, prefix_len
+        from cloud_tpu.models.decoding import bucket_length
+        from cloud_tpu.training.checkpoint import tree_digest
+        page = self.pool.page_size
+        n_t = len(shared)
+        n_h = 0
+        for n in range((prompt_len - 1) // page, n_t, -1):
+            if (n * page + bucket_length(prompt_len - n * page,
+                                         self.engine.max_seq_len)
+                    > self.engine.max_seq_len):
+                continue
+            if tier.contains(prompt[:n * page]):
+                n_h = n
+                break
+        if n_h == 0:
+            return shared, partial_page, partial_len, prefix_len
+        entry = tier.get(prompt, n_h)
+        if entry is None:  # concurrently evicted between probe and get
+            return shared, partial_page, partial_len, prefix_len
+        if tree_digest(entry["pages"]) != entry["digest"]:
+            tier.note_digest_failure()
+            tier.drop(prompt, n_h)
+            self._note_fault(HostTierCorrupt(
+                "host-tier digest mismatch at {} pages; entry dropped, "
+                "falling back to re-prefill.".format(n_h)),
+                rid=ticket.rid, slot=None)
+            reg = _registry()
+            if reg is not None:
+                from cloud_tpu.monitoring import telemetry
+                reg.counter(telemetry.SERVE_DIGEST_FAILURES_TOTAL).inc()
+            return shared, partial_page, partial_len, prefix_len
+        # Plain non-blocking reserve — no trie eviction pressure; a
+        # promote must never evict device-resident prefixes to make
+        # room for itself.
+        ext = self.pool.reserve(n_h - n_t, timeout=0.01)
+        if ext is None:
+            return shared, partial_page, partial_len, prefix_len
+        if partial_len:
+            # The promoted prefix covers (and extends past) the
+            # divergent partial page — drop its ref, no CoW needed.
+            self.pool.free([partial_page])
+            partial_page, partial_len = None, 0
+        self.engine.promote_pages(entry["pages"], shared + ext,
+                                  n_skip=n_t)
+        tier.note_promote()
+        self._trace_emit(ticket.rid, "page_promote", pages=len(ext),
+                         prefix_len=n_h * page)
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.counter(telemetry.SERVE_PAGE_PROMOTES_TOTAL).inc(
+                len(ext))
+        return shared + ext, None, 0, n_h * page
+
+    def _maybe_demote(self, state):
+        """Demote: at turn completion, snapshot the slot's full
+        written pages to the host tier keyed by their token history,
+        so the NEXT conversation turn (prompt = this turn's prompt +
+        continuation) promotes them back instead of re-prefilling.
+        Tick thread, BEFORE the pages return to the pool — the
+        snapshot executable reads the live cache."""
+        tier = self.host_tier
+        request = state.request
+        if tier is None or request.max_new_tokens <= 1:
+            return
+        from cloud_tpu.training.checkpoint import tree_digest
+        emitted = [int(t)
+                   for t in state.emitted[:request.max_new_tokens]]
+        full = [int(t) for t in request.prompt] + emitted
+        # The final sampled token was never written to the cache.
+        written = len(full) - 1
+        n_full = written // self.pool.page_size
+        if n_full < 1 or n_full > len(state.pages):
+            return
+        key = full[:n_full * self.pool.page_size]
+        if tier.contains(key):
+            return
+        host_tree = self.engine.snapshot_pages(state.pages[:n_full])
+        if not tier.put(key, host_tree, n_full,
+                        tree_digest(host_tree)):
+            return  # oversized for the tier budget — refused, not LRUed
+        self._trace_emit(state.rid, "page_demote", pages=n_full,
+                         tokens=len(key))
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.counter(telemetry.SERVE_PAGE_DEMOTES_TOTAL).inc(n_full)
+
     def _distribute(self, fetched, elapsed):
         n_active = sum(s is not None for s in self._slots)
         if n_active:
@@ -1699,6 +1855,7 @@ class Scheduler:
         evict_mask[slot] = True
         self._slots[slot] = None
         self._free_slots.append(slot)
+        self._maybe_demote(state)
         self.pool.free(state.pages)
         self._complete(state.request, state.future, state.t_submit,
                        state.ttft_s, state.emitted,
@@ -1783,6 +1940,16 @@ class Scheduler:
             pstats["reserve_waiters"])
         reg.gauge(telemetry.SERVE_PAGES_PREFILLING).set(
             pstats["pages_prefilling"])
+        reg.gauge(telemetry.SERVE_KV_BYTES % "hbm").set(
+            pstats["kv_bytes_held"])
+        reg.gauge(telemetry.SERVE_KV_CAPACITY_SESSIONS).set(
+            self.pool.capacity // self.engine.pages_per_slot)
+        if self.host_tier is not None:
+            hstats = self.host_tier.stats()
+            reg.gauge(telemetry.SERVE_HOST_TIER_PAGES).set(
+                hstats["pages"])
+            reg.gauge(telemetry.SERVE_KV_BYTES % "host").set(
+                hstats["pages"] * self.pool.page_bytes)
         if self.trie is not None:
             tstats = self.trie.stats()
             reg.gauge(telemetry.SERVE_PREFIX_PAGES_HELD).set(
@@ -1937,6 +2104,10 @@ class Scheduler:
                 future.result(timeout=600)
         if self.trie is not None:
             self._warm_prefix_path(configs[0])
+            if self.host_tier is not None:
+                self._warm_host_tier(configs[0])
+                self.host_tier.clear()
+                self.host_tier.reset_stats()
             self.trie.clear()
             self.trie.reset_stats()
         self.engine.mark_warm()
@@ -1986,6 +2157,28 @@ class Scheduler:
             self.submit(ServeRequest(prompt=prompt, max_new_tokens=2,
                                      **cfg)).result(timeout=600)
 
+    def _warm_host_tier(self, cfg):
+        """graftpack pair: a turn that completes and demotes two full
+        pages (compiling the snapshot executable), then its next turn,
+        whose admission finds the host entry past the one-page trie
+        prefix and promotes (compiling the promote scatter and the
+        wider-prefix gather) — so steady-state offload traffic stays
+        at zero new traces. Both executables are fixed-shape, so one
+        compile each covers every page count."""
+        page = self.pool.page_size
+        vocab = self.engine.model.vocab_size
+        if (page < 2 or vocab < 5
+                or page + 2 > self.engine.max_new_cap
+                or 2 * page + 5 + self._spec_slack()
+                > self.engine.max_seq_len):
+            return
+        first = self.submit(ServeRequest(
+            prompt=[4] * page, max_new_tokens=page + 2,
+            **cfg)).result(timeout=600)
+        turn2 = [int(t) for t in first.tokens] + [2]
+        self.submit(ServeRequest(prompt=turn2, max_new_tokens=2,
+                                 **cfg)).result(timeout=600)
+
     def stats(self):
         """Host-side rollup for bench/smoke (works with telemetry
         off)."""
@@ -2028,6 +2221,23 @@ class Scheduler:
             "spec_accepted_tokens": self._accepted_draft_tokens,
             "spec_proposed_tokens": proposed,
         }
+        # graftpack KV hierarchy rollup: dtype-aware byte accounting
+        # plus the demote/promote census, mirrored from the host tier.
+        hstats = (self.host_tier.stats() if self.host_tier is not None
+                  else None)
+        out["kv"] = {
+            "page_dtype": self.kv_dtype,
+            "page_bytes": self.pool.page_bytes,
+            "capacity_sessions": (self.pool.capacity
+                                  // self.engine.pages_per_slot),
+            "host_tier_pages": hstats["pages"] if hstats else 0,
+            "page_demotes": hstats["demotes"] if hstats else 0,
+            "page_promotes": hstats["promotes"] if hstats else 0,
+            "digest_failures": (hstats["digest_failures"]
+                                if hstats else 0),
+        }
+        if hstats is not None:
+            out["host_tier"] = hstats
         if self.trie is not None:
             out["prefix_cache"] = self.trie.stats()
         return out

@@ -67,6 +67,37 @@ chunked (ISSUE 16) — a heavy-prompt mix (>= 25% long prompts near the
       surface), chunk dispatches observed on the ON leg only, and the
       drained-pool invariant (prefill holds release exactly once).
 
+kvq (ISSUE 17, graftpack) — the same greedy fleet served twice at an
+  EQUAL HBM byte budget, fp KV pages then int8 KV pages (per-page
+  per-head f32 scales):
+  12. CAPACITY — the int8 pool must admit >= MIN_KVQ_CAPACITY_RATIO
+      (2.0) times the fp pool's full-context sessions under the same
+      byte budget (the ~4x page-size shrink minus the scale sidecars),
+      with the pool's advertised page_bytes matching the analytic
+      per-layer formula on both legs.
+  13. PARITY — the int8 leg's greedy decodes are bit-identical to the
+      fp leg's AND to solo generate() (the dequant contract:
+      k = int8 * scale, both dots f32), zero post-warmup traces on
+      either leg, and leak-free drain. Capacity that costs correctness
+      is not capacity.
+
+offload (ISSUE 17, graftpack) — multi-turn conversations served three
+  ways: host tier ON under a page budget too small to keep device
+  prefixes resident (turn-2 admission PROMOTES demoted pages back),
+  an ample-budget device-cache-hit control, and the same small budget
+  with the host tier OFF (turn-2 re-prefills from scratch):
+  14. TTFT — turn-2 TTFT p50 with the host tier stays within
+      MAX_OFFLOAD_HIT_FACTOR (1.5x) of the device-hit control and
+      beats the re-prefill control by >= MIN_OFFLOAD_REPREFILL_RATIO
+      (3.0x): an H2D page copy costs more than a device hit but far
+      less than recomputing the prefix.
+  15. Every turn-2 admission on the offload leg promotes (the demote
+      -> evict -> promote cycle actually ran), turn outputs are
+      bit-identical across all three legs, zero post-warmup traces,
+      leak-free drain — and a corrupted host entry (stamped digest
+      mismatch) is refused as a typed `host_tier_corrupt` fault that
+      falls back to re-prefill with the result still exact.
+
 Relative gating (ISSUE 16): every performance gate above is an A/B
 ratio of two legs run back-to-back in the same process on the same
 rig, so load noise hits both legs alike. Even so, CI containers
@@ -95,19 +126,22 @@ MIN_CHUNK_GAP_RATIO = 3.0
 CHAOS_P99_FACTOR = 10.0
 CHAOS_PLAN = "prefill_fail@2,slot_hang@5,pool_squeeze@9:8,slot_hang@14"
 CHUNK_SIZE = 16
+MIN_KVQ_CAPACITY_RATIO = 2.0
+MAX_OFFLOAD_HIT_FACTOR = 1.5
+MIN_OFFLOAD_REPREFILL_RATIO = 3.0
 # Below this fraction of an advertised floor a missed ratio is a hard
 # failure (the A/B direction itself is in doubt); between the two it
 # only warns. Override: CLOUD_TPU_SMOKE_HARD_FRACTION.
 HARD_GATE_FRACTION = 0.6
 
 
-def build_model(max_seq_len=64, num_layers=6):
+def build_model(max_seq_len=64, num_layers=6, vocab_size=1024):
     """CPU-friendly but big enough that a decode tick is device-bound
     (the host round trip per tick must not dominate the comparison)."""
     import jax.numpy as jnp
 
     from cloud_tpu.models import TransformerLM
-    return TransformerLM(vocab_size=1024, num_layers=num_layers,
+    return TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
                          num_heads=6, d_model=384, d_ff=1536,
                          max_seq_len=max_seq_len,
                          compute_dtype=jnp.float32)
@@ -922,13 +956,371 @@ def run_chunked(args):
     return _check(failures, "chunked", warnings)
 
 
+def run_kvq(args):
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.serving import Scheduler, ServeRequest
+
+    # Small vocab on purpose: these weights are random-init, so logits
+    # are near-uniform and the top-2 argmax margin shrinks with vocab
+    # size (order-statistic spacing) — at 1024 the int8 rounding noise
+    # flips coin-toss argmaxes that no trained model exhibits. 128
+    # keeps the margins wide enough that the parity gate measures the
+    # dequant contract, not the untrained net's ties.
+    model = build_model(vocab_size=128)
+    page = 16
+    pages_per_slot = model.max_seq_len // page
+    rng = np.random.default_rng(9)
+    requests = [ServeRequest(
+        prompt=rng.integers(1, 128, (int(rng.integers(6, 17)),))
+        .astype(np.int32).tolist(),
+        max_new_tokens=int(rng.integers(8, 17)), temperature=0.0,
+        rng_seed=6000 + i) for i in range(12)]
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    print("[smoke:kvq] solo oracle ({} requests)".format(len(requests)))
+    oracle = solo_oracle(model, params, requests)
+
+    # Equal HBM byte budget, sized analytically (the engine's
+    # page_hbm_bytes contract, asserted against pool.page_bytes below):
+    # fp pages are 2 * page * H * D * itemsize per layer; int8 pages
+    # shrink the payload to one byte per element and add the per-page
+    # per-head f32 scale sidecars.
+    head_dim = model.d_model // model.num_heads
+    fp_bytes = 2 * page * model.num_heads * head_dim * 4 \
+        * model.num_layers
+    q_bytes = (2 * page * model.num_heads * head_dim
+               + 2 * model.num_heads * 4) * model.num_layers
+    fp_pages = 2 * pages_per_slot + 1
+    budget = fp_pages * fp_bytes
+    q_pages = budget // q_bytes
+
+    def _serve(dtype, num_pages, slots):
+        scheduler = Scheduler(model, params, slots=slots,
+                              page_size=page, num_pages=num_pages,
+                              admission_window=len(requests),
+                              strict_no_retrace=True,
+                              kv_dtype=dtype).start()
+        try:
+            buckets = sorted({scheduler._bucket(r) for r in requests})
+            scheduler.warmup(buckets,
+                             sampling_configs=[(("temperature", 0.0),)])
+            warm = runtime.compile_stats()
+            results, tokens, secs = run_serve(scheduler, requests)
+            after = runtime.compile_stats()
+            stats = scheduler.stats()
+            time.sleep(0.3)
+            scheduler.assert_drained(clear_prefix=True)
+            leaked = scheduler.pool.leak_report()
+            return results, tokens / secs, stats, leaked, (
+                after["n_traces"] - warm["n_traces"],
+                after["n_compiles"] - warm["n_compiles"])
+        finally:
+            scheduler.close()
+
+    print("[smoke:kvq] serve pass (fp pages, {} pages @ {} B)".format(
+        fp_pages, fp_bytes))
+    fp_results, fp_tps, fp_stats, fp_leaked, fp_traces = _serve(
+        "", fp_pages, slots=2)
+    print("[smoke:kvq] serve pass (int8 pages, {} pages @ {} B, same "
+          "{} B budget)".format(q_pages, q_bytes, budget))
+    q_slots = max(2, min(8, q_pages // pages_per_slot))
+    q_results, q_tps, q_stats, q_leaked, q_traces = _serve(
+        "int8", q_pages, slots=q_slots)
+
+    mism_fp = [i for i, (res, ref) in enumerate(zip(fp_results, oracle))
+               if not np.array_equal(res.tokens, ref)]
+    mism_q = [i for i, (res, ref) in enumerate(zip(q_results, fp_results))
+              if not np.array_equal(res.tokens, ref.tokens)]
+    fp_sessions = fp_stats["kv"]["capacity_sessions"]
+    q_sessions = q_stats["kv"]["capacity_sessions"]
+    capacity_ratio = (q_sessions / fp_sessions) if fp_sessions else 0.0
+
+    summary = {
+        "requests": len(requests),
+        "hbm_budget_bytes": budget,
+        "fp_page_bytes": fp_stats["kv"]["page_bytes"],
+        "int8_page_bytes": q_stats["kv"]["page_bytes"],
+        "fp_pages": fp_pages,
+        "int8_pages": q_pages,
+        "fp_capacity_sessions": fp_sessions,
+        "int8_capacity_sessions": q_sessions,
+        "capacity_ratio": capacity_ratio,
+        "min_capacity_ratio": args.min_kvq_capacity_ratio,
+        "fp_tokens_per_sec": fp_tps,
+        "int8_tokens_per_sec": q_tps,
+        "new_traces_post_warmup": q_traces[0],
+        "new_compiles_post_warmup": q_traces[1],
+        "mismatched_fp_vs_oracle": mism_fp,
+        "mismatched_int8_vs_fp": mism_q,
+        "leaked_pages": fp_leaked or q_leaked,
+    }
+    _write_summary(args.out_dir, "serving_smoke_kvq.json", summary)
+
+    print("[smoke:kvq] page bytes fp {} | int8 {} | sessions at {} B: "
+          "fp {} int8 {} ({:.2f}x, floor {:.1f}x)".format(
+              fp_stats["kv"]["page_bytes"], q_stats["kv"]["page_bytes"],
+              budget, fp_sessions, q_sessions, capacity_ratio,
+              args.min_kvq_capacity_ratio))
+    failures = []
+    if fp_stats["kv"]["page_bytes"] != fp_bytes \
+            or q_stats["kv"]["page_bytes"] != q_bytes:
+        failures.append(
+            "pool page_bytes drifted from the analytic formula "
+            "(fp {} vs {}, int8 {} vs {})".format(
+                fp_stats["kv"]["page_bytes"], fp_bytes,
+                q_stats["kv"]["page_bytes"], q_bytes))
+    if q_pages * q_bytes > budget:
+        failures.append("int8 pool {} B overshoots the {} B budget"
+                        .format(q_pages * q_bytes, budget))
+    # Capacity is arithmetic, not timing — a miss means the quantized
+    # page layout regressed, so the gate is hard at the full floor.
+    if capacity_ratio < args.min_kvq_capacity_ratio:
+        failures.append(
+            "int8 admits only {:.2f}x the fp sessions at an equal "
+            "byte budget (floor {:.1f}x)".format(
+                capacity_ratio, args.min_kvq_capacity_ratio))
+    if mism_fp:
+        failures.append("fp requests {} diverged from solo "
+                        "generate()".format(mism_fp))
+    if mism_q:
+        failures.append(
+            "int8 requests {} diverged from the fp serve (greedy "
+            "parity: quantized pages changed the decode)".format(
+                mism_q))
+    for tag, traces in (("fp", fp_traces), ("int8", q_traces)):
+        if traces[0] or traces[1]:
+            failures.append("retrace after warmup on the {} leg ({} "
+                            "traces, {} compiles)".format(tag, *traces))
+    if fp_leaked or q_leaked:
+        failures.append("page refcount leak after drain: fp={} "
+                        "int8={}".format(fp_leaked, q_leaked))
+    return _check(failures, "kvq")
+
+
+def build_conversation_sessions(model, n_sessions=4, page=16, seed=13):
+    """Multi-turn material for the offload A/B/C. Each session's
+    turn-1 prompt spans ~18.5 pages (page-aligned demote keeps 19 full
+    pages after an 18-token reply), its turn-2 prompt is the full
+    turn-1 output plus an 8-token user tail — so a promoted turn 2
+    prefills one suffix bucket instead of ~20 pages (the long prefix
+    is what makes the promote-vs-re-prefill contrast structural, not
+    a timing accident). The fillers are near-context distinct prompts
+    whose admissions churn the small pool and evict resident prefixes
+    between the turns. Returns (turn1_requests, tails, fillers);
+    turn-2 requests are built at serve time from each leg's own
+    turn-1 tokens."""
+    from cloud_tpu.serving import ServeRequest
+
+    rng = np.random.default_rng(seed)
+    turn1, tails = [], []
+    for i in range(n_sessions):
+        plen = int(rng.integers(18 * page + 2, 19 * page - 2))
+        prompt = rng.integers(1, 512, (plen,)).astype(np.int32).tolist()
+        turn1.append(ServeRequest(prompt=prompt, max_new_tokens=18,
+                                  temperature=0.0, rng_seed=7000 + i))
+        tails.append(rng.integers(1, 512, (8,)).astype(
+            np.int32).tolist())
+    fillers = [ServeRequest(
+        prompt=rng.integers(1, 512, (28 * page,)).astype(
+            np.int32).tolist(),
+        max_new_tokens=2, temperature=0.0, rng_seed=7500 + i)
+        for i in range(2)]
+    return turn1, tails, fillers
+
+
+def run_offload(args):
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.serving import Scheduler, ServeRequest
+
+    model = build_model(max_seq_len=512)
+    page = 16
+    pages_per_slot = model.max_seq_len // page
+    turn1, tails, fillers = build_conversation_sessions(model, page=page)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    # Two sessions' worth of pages: too small to keep every session's
+    # prefix resident on-device, so the filler churn (plus the explicit
+    # clear below, which pins the A/B/C classes exactly) evicts them.
+    small_pool = 2 * pages_per_slot + 1
+    ample_pool = 8 * pages_per_slot + 1
+
+    def _serve(host_tier, num_pages, evict, corrupt=False):
+        scheduler = Scheduler(model, params, slots=2, page_size=page,
+                              num_pages=num_pages, admission_window=4,
+                              strict_no_retrace=True,
+                              host_tier=host_tier).start()
+        try:
+            scheduler.warmup([model.max_seq_len],
+                             sampling_configs=[(("temperature", 0.0),)])
+            warm = runtime.compile_stats()
+            t1 = [scheduler.submit(r, timeout=30).result(timeout=600)
+                  for r in turn1]
+            if evict:
+                # Organic pressure first (the filler admissions churn
+                # the small pool's LRU), then the explicit clear: the
+                # gates below need EVERY turn-2 in its leg's class, not
+                # whichever prefixes the LRU happened to spare.
+                for f in fillers:
+                    scheduler.submit(f, timeout=30).result(timeout=600)
+                scheduler.trie.clear()
+            if corrupt:
+                for entry in scheduler.host_tier._entries.values():
+                    entry["digest"] = "deadbeef"
+            turn2 = [ServeRequest(
+                prompt=np.asarray(res.tokens).tolist() + tails[i],
+                max_new_tokens=4, temperature=0.0, rng_seed=7100 + i)
+                for i, res in enumerate(t1)]
+            t2 = [scheduler.submit(r, timeout=30).result(timeout=600)
+                  for r in turn2]
+            after = runtime.compile_stats()
+            stats = scheduler.stats()
+            time.sleep(0.3)
+            scheduler.assert_drained(clear_prefix=True)
+            leaked = scheduler.pool.leak_report()
+            return t1, t2, stats, leaked, (
+                after["n_traces"] - warm["n_traces"],
+                after["n_compiles"] - warm["n_compiles"])
+        finally:
+            scheduler.close()
+
+    print("[smoke:offload] leg A: host tier, {}-page pool (promote "
+          "path)".format(small_pool))
+    a_t1, a_t2, a_stats, a_leaked, a_traces = _serve(
+        True, small_pool, evict=True)
+    print("[smoke:offload] leg B: ample {}-page pool (device-hit "
+          "control)".format(ample_pool))
+    b_t1, b_t2, b_stats, b_leaked, b_traces = _serve(
+        False, ample_pool, evict=False)
+    print("[smoke:offload] leg C: no host tier, {}-page pool "
+          "(re-prefill control)".format(small_pool))
+    c_t1, c_t2, c_stats, c_leaked, c_traces = _serve(
+        False, small_pool, evict=True)
+    print("[smoke:offload] leg D: host tier with corrupted digests "
+          "(typed fallback)")
+    d_t1, d_t2, d_stats, _, _ = _serve(
+        True, small_pool, evict=True, corrupt=True)
+
+    t2_offload = float(np.median([r.ttft_s for r in a_t2]))
+    t2_hit = float(np.median([r.ttft_s for r in b_t2]))
+    t2_reprefill = float(np.median([r.ttft_s for r in c_t2]))
+    # "offload <= 1.5x device hit" recast as a floor for _gate_ratio:
+    # headroom 1.0 means exactly 1.5x; below HARD_GATE_FRACTION the
+    # promote path costs > 2.5x a device hit and the tier is broken.
+    hit_headroom = (args.max_offload_hit_factor * t2_hit / t2_offload
+                    if t2_offload else 0.0)
+    reprefill_ratio = (t2_reprefill / t2_offload) if t2_offload else 0.0
+
+    n = len(turn1)
+    mism_t1 = [i for i in range(n)
+               if not (np.array_equal(a_t1[i].tokens, b_t1[i].tokens)
+                       and np.array_equal(a_t1[i].tokens,
+                                          c_t1[i].tokens)
+                       and np.array_equal(a_t1[i].tokens,
+                                          d_t1[i].tokens))]
+    mism_t2 = [i for i in range(n)
+               if not (np.array_equal(a_t2[i].tokens, b_t2[i].tokens)
+                       and np.array_equal(a_t2[i].tokens,
+                                          c_t2[i].tokens)
+                       and np.array_equal(a_t2[i].tokens,
+                                          d_t2[i].tokens))]
+
+    summary = {
+        "sessions": n,
+        "small_pool_pages": small_pool,
+        "ample_pool_pages": ample_pool,
+        "ttft_turn2_offload_p50_s": t2_offload,
+        "ttft_turn2_device_hit_p50_s": t2_hit,
+        "ttft_turn2_reprefill_p50_s": t2_reprefill,
+        "hit_headroom": hit_headroom,
+        "max_offload_hit_factor": args.max_offload_hit_factor,
+        "reprefill_ratio": reprefill_ratio,
+        "min_reprefill_ratio": args.min_offload_reprefill_ratio,
+        "offload_demotes": a_stats["kv"]["page_demotes"],
+        "offload_promotes": a_stats["kv"]["page_promotes"],
+        "offload_turn2_prefix_lens": [r.prefix_len for r in a_t2],
+        "reprefill_turn2_prefix_lens": [r.prefix_len for r in c_t2],
+        "digest_failures": d_stats["kv"]["digest_failures"],
+        "digest_leg_promotes": d_stats["kv"]["page_promotes"],
+        "digest_leg_faults": d_stats["faults"],
+        "mismatched_turn1": mism_t1,
+        "mismatched_turn2": mism_t2,
+        "new_traces_post_warmup": a_traces[0],
+        "new_compiles_post_warmup": a_traces[1],
+        "leaked_pages": a_leaked or b_leaked or c_leaked,
+    }
+    _write_summary(args.out_dir, "serving_smoke_offload.json", summary)
+
+    print("[smoke:offload] turn-2 TTFT p50: promote {:.4f}s | device "
+          "hit {:.4f}s | re-prefill {:.4f}s (<= {:.1f}x hit, >= "
+          "{:.1f}x over re-prefill)".format(
+              t2_offload, t2_hit, t2_reprefill,
+              args.max_offload_hit_factor,
+              args.min_offload_reprefill_ratio))
+    print("[smoke:offload] demotes {} | promotes {} | digest "
+          "fallbacks {}".format(a_stats["kv"]["page_demotes"],
+                                a_stats["kv"]["page_promotes"],
+                                d_stats["kv"]["digest_failures"]))
+    failures, warnings = [], []
+    _gate_ratio(failures, warnings, "offload-vs-hit TTFT headroom",
+                hit_headroom, 1.0)
+    _gate_ratio(failures, warnings, "re-prefill/offload TTFT ratio",
+                reprefill_ratio, args.min_offload_reprefill_ratio)
+    if a_stats["kv"]["page_promotes"] < n:
+        failures.append(
+            "only {} promote admissions for {} follow-up turns (the "
+            "evicted prefixes were not served from the host "
+            "tier)".format(a_stats["kv"]["page_promotes"], n))
+    if any(r.prefix_len < 18 * page for r in a_t2):
+        failures.append(
+            "offload-leg turn-2 prefix lens {} below the demoted "
+            "prefix (promote served fewer pages than the tier "
+            "held)".format([r.prefix_len for r in a_t2]))
+    if any(r.prefix_len != 0 for r in c_t2):
+        failures.append(
+            "re-prefill control served prefixes {} (eviction did not "
+            "take; the C leg is not measuring a cold turn 2)".format(
+                [r.prefix_len for r in c_t2]))
+    if d_stats["kv"]["digest_failures"] < n:
+        failures.append(
+            "{} digest fallbacks for {} corrupted entries (stale "
+            "host pages were served)".format(
+                d_stats["kv"]["digest_failures"], n))
+    if d_stats["kv"]["page_promotes"]:
+        failures.append("{} promotes on the corrupt-digest leg "
+                        "(corrupt pages must never map in)".format(
+                            d_stats["kv"]["page_promotes"]))
+    if not d_stats["faults"].get("host_tier_corrupt"):
+        failures.append("digest mismatch raised no typed "
+                        "host_tier_corrupt fault")
+    if mism_t1 or mism_t2:
+        failures.append(
+            "sessions diverged across legs (turn1={} turn2={}): "
+            "promoted pages or the fallback changed the decode".format(
+                mism_t1, mism_t2))
+    for tag, traces in (("offload", a_traces), ("device-hit", b_traces),
+                        ("re-prefill", c_traces)):
+        if traces[0] or traces[1]:
+            failures.append("retrace after warmup on the {} leg ({} "
+                            "traces, {} compiles)".format(tag, *traces))
+    if a_leaked or b_leaked or c_leaked:
+        failures.append("page refcount leak after drain: A={} B={} "
+                        "C={}".format(a_leaked, b_leaked, c_leaked))
+    return _check(failures, "offload", warnings)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out-dir", default=os.environ.get(
         "CLOUD_TPU_TELEMETRY_DIR", "serving-smoke-out"))
     parser.add_argument("--scenario", default="base",
                         choices=["base", "prefix", "spec", "chaos",
-                                 "chunked", "all"])
+                                 "chunked", "kvq", "offload", "all"])
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--spec-k", type=int, default=3)
     parser.add_argument("--chunk-size", type=int, default=int(
@@ -950,14 +1342,28 @@ def main(argv=None):
     parser.add_argument("--chaos-p99-factor", type=float, default=float(
         os.environ.get("CLOUD_TPU_SMOKE_CHAOS_P99_FACTOR",
                        CHAOS_P99_FACTOR)))
+    parser.add_argument("--min-kvq-capacity-ratio", type=float,
+                        default=float(os.environ.get(
+                            "CLOUD_TPU_SMOKE_MIN_KVQ_CAPACITY",
+                            MIN_KVQ_CAPACITY_RATIO)))
+    parser.add_argument("--max-offload-hit-factor", type=float,
+                        default=float(os.environ.get(
+                            "CLOUD_TPU_SMOKE_MAX_OFFLOAD_HIT",
+                            MAX_OFFLOAD_HIT_FACTOR)))
+    parser.add_argument("--min-offload-reprefill-ratio", type=float,
+                        default=float(os.environ.get(
+                            "CLOUD_TPU_SMOKE_MIN_OFFLOAD_REPREFILL",
+                            MIN_OFFLOAD_REPREFILL_RATIO)))
     args = parser.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     scenarios = {"base": [run_base], "prefix": [run_prefix],
                  "spec": [run_spec], "chaos": [run_chaos],
-                 "chunked": [run_chunked],
+                 "chunked": [run_chunked], "kvq": [run_kvq],
+                 "offload": [run_offload],
                  "all": [run_base, run_prefix, run_spec, run_chaos,
-                         run_chunked]}[args.scenario]
+                         run_chunked, run_kvq,
+                         run_offload]}[args.scenario]
     rc = 0
     for scenario in scenarios:
         rc = scenario(args) or rc

@@ -1,0 +1,275 @@
+"""graftpack: quantized KV pages + host-tier page offload.
+
+Two layers under test. The HostPageTier container itself (pure host
+python): page-aligned keying, longest-prefix probe, LRU eviction under
+a page budget, oversize refusal, digest bookkeeping left to the caller.
+And the scheduler end-to-end demote -> evict -> promote cycle: a
+completed turn's prefix pages survive trie eviction in host RAM, the
+next turn admits against them bit-identically to solo generate(), and
+a corrupted snapshot is a typed, counted fallback to re-prefill —
+never served.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from cloud_tpu.serving.kvpool import HostPageTier, PagePool
+
+
+def _pages(tag):
+    """A stand-in snapshot pytree (the tier never looks inside it)."""
+    return {"k": np.full((2, 2), tag, np.float32)}
+
+
+class TestHostPageTier:
+
+    def test_rejects_degenerate_budget(self):
+        with pytest.raises(ValueError):
+            HostPageTier(0, 4)
+
+    def test_put_requires_page_aligned_key(self):
+        tier = HostPageTier(8, 4)
+        with pytest.raises(ValueError, match="page-aligned"):
+            tier.put([1, 2, 3], _pages(1), 1, "d1")
+
+    def test_put_get_probe_roundtrip(self):
+        tier = HostPageTier(8, 4)
+        assert tier.put([1, 2, 3, 4, 5, 6, 7, 8], _pages(1), 2, "d1")
+        assert tier.contains([1, 2, 3, 4, 5, 6, 7, 8])
+        # probe: longest page-aligned prefix, excluding the final
+        # token (it is sampled-from, never cached).
+        assert tier.probe([1, 2, 3, 4, 5, 6, 7, 8, 9, 9]) == 8
+        assert tier.probe([1, 2, 3, 4, 5, 6, 7, 8, 9]) == 8
+        # Entries are exact page-aligned keys: a shorter prefix of a
+        # stored session is NOT implied (demote stores every turn's
+        # own prefix, so layering comes from successive puts).
+        assert tier.probe([1, 2, 3, 4, 5, 6, 7, 8]) == 0
+        assert tier.probe([1, 2, 3, 4, 9]) == 0
+        assert tier.probe([9, 2, 3, 4, 5]) == 0
+        entry = tier.get([1, 2, 3, 4, 5, 6, 7, 8, 9], 2)
+        assert entry is not None
+        assert entry["digest"] == "d1"
+        assert entry["n_pages"] == 2
+        assert tier.get([1, 2, 3, 4, 9, 9, 9, 9], 2) is None
+        assert tier.demotes == 1
+
+    def test_shorter_prefix_of_same_session_matches(self):
+        tier = HostPageTier(8, 4)
+        tier.put([1, 2, 3, 4], _pages(1), 1, "d1")
+        tier.put([1, 2, 3, 4, 5, 6, 7, 8], _pages(2), 2, "d2")
+        # Longest wins; the 1-page entry still serves short probes.
+        assert tier.probe([1, 2, 3, 4, 5, 6, 7, 8, 9]) == 8
+        assert tier.probe([1, 2, 3, 4, 5]) == 4
+
+    def test_lru_eviction_under_page_budget(self):
+        tier = HostPageTier(4, 4)
+        tier.put([1] * 8, _pages(1), 2, "d1")
+        tier.put([2] * 8, _pages(2), 2, "d2")
+        assert tier.held_pages() == 4
+        # Refresh entry 1, then overflow: entry 2 is now LRU.
+        assert tier.get([1] * 8, 2) is not None
+        tier.put([3] * 8, _pages(3), 2, "d3")
+        assert tier.contains([1] * 8)
+        assert not tier.contains([2] * 8)
+        assert tier.contains([3] * 8)
+        assert tier.evictions == 1
+        assert tier.held_pages() == 4
+
+    def test_oversized_snapshot_refused_not_thrashed(self):
+        tier = HostPageTier(2, 4)
+        tier.put([1] * 8, _pages(1), 2, "d1")
+        assert not tier.put([2] * 12, _pages(2), 3, "d2")
+        # The refusal must not evict what was already resident.
+        assert tier.contains([1] * 8)
+        assert tier.demotes == 1
+
+    def test_reput_same_key_replaces_in_place(self):
+        tier = HostPageTier(2, 4)
+        tier.put([1] * 8, _pages(1), 2, "d1")
+        assert tier.put([1] * 8, _pages(2), 2, "d2")
+        assert tier.held_pages() == 2
+        assert tier.evictions == 0
+        assert tier.get([1] * 8, 2)["digest"] == "d2"
+
+    def test_drop_and_clear(self):
+        tier = HostPageTier(8, 4)
+        tier.put([1] * 4, _pages(1), 1, "d1")
+        tier.put([2] * 4, _pages(2), 1, "d2")
+        tier.drop([1] * 4, 1)
+        assert not tier.contains([1] * 4)
+        assert len(tier) == 1
+        tier.clear()
+        assert len(tier) == 0 and tier.held_pages() == 0
+
+    def test_stats_and_reset(self):
+        tier = HostPageTier(8, 4)
+        tier.put([1] * 4, _pages(1), 1, "d1")
+        tier.note_promote()
+        tier.note_digest_failure()
+        stats = tier.stats()
+        assert stats["entries"] == 1 and stats["pages"] == 1
+        assert stats["max_pages"] == 8
+        assert stats["demotes"] == 1 and stats["promotes"] == 1
+        assert stats["digest_failures"] == 1
+        tier.reset_stats()
+        assert tier.stats()["demotes"] == 0
+        assert tier.stats()["promotes"] == 0
+
+
+class TestPagePoolByteAccounting:
+
+    def test_pool_stats_carry_dtype_and_bytes(self):
+        pool = PagePool(5, 16, 4, page_dtype="int8", page_bytes=544)
+        stats = pool.pool_stats()
+        assert stats["page_dtype"] == "int8"
+        assert stats["kv_bytes_total"] == pool.capacity * 544
+        assert stats["kv_bytes_held"] == 0
+        held = pool.reserve(3)
+        assert pool.pool_stats()["kv_bytes_held"] == 3 * 544
+        pool.free(held)
+        assert pool.pool_stats()["kv_bytes_held"] == 0
+
+
+# -- scheduler end-to-end (jit-heavy: slow tier) ----------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import TransformerLM
+    return TransformerLM(vocab_size=64, num_layers=2, num_heads=2,
+                         d_model=32, d_ff=64, max_seq_len=32,
+                         compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    import jax
+    import jax.numpy as jnp
+    return model.init(jax.random.PRNGKey(1),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+
+
+def _oracle(model, params, req):
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.models import generate
+    toks = generate(model, params,
+                    jnp.asarray(req.prompt, jnp.int32)[None],
+                    req.max_new_tokens,
+                    rng=jax.random.PRNGKey(req.rng_seed),
+                    temperature=req.temperature, top_k=req.top_k,
+                    top_p=req.top_p, eos_token=req.eos_token)
+    return np.asarray(toks)[0]
+
+
+def _wait_for_demote(scheduler, key, timeout=10.0):
+    """The demote fires between a request's final tick and complete on
+    the tick thread — poll briefly rather than racing it."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if scheduler.host_tier.contains(key):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_ctor_validation(model, params):
+    from cloud_tpu.serving import Scheduler
+    with pytest.raises(ValueError, match="prefix_cache"):
+        Scheduler(model, params, host_tier=True, prefix_cache=False)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        Scheduler(model, params, kv_dtype="fp8")
+
+
+@pytest.mark.slow
+class TestDemotePromote:
+
+    # fp page: 2*page*H*D*4 bytes/layer; int8 adds the [P, H] f32
+    # scale sidecars. page=4, H=2, D=16, layers=2 (pins the
+    # engine.page_hbm_bytes() formula at a second geometry besides
+    # the smoke's).
+    PAGE_BYTES = {"": 2 * 4 * 2 * 16 * 4 * 2,
+                  "int8": (2 * 4 * 2 * 16 + 2 * 2 * 4) * 2}
+
+    @pytest.mark.parametrize("kv_dtype", ["", "int8"])
+    def test_demote_then_promote_bit_identical(self, model, params,
+                                               kv_dtype):
+        from cloud_tpu.serving import Scheduler, ServeRequest
+        turn1 = ServeRequest(prompt=[5, 6, 7, 8], max_new_tokens=6,
+                             temperature=0.0, rng_seed=3)
+        with Scheduler(model, params, slots=2, page_size=4,
+                       host_tier=True, kv_dtype=kv_dtype) as sched:
+            kv = sched.stats()["kv"]
+            assert kv["page_dtype"] == kv_dtype
+            assert kv["page_bytes"] == self.PAGE_BYTES[kv_dtype]
+            r1 = sched.submit(turn1, timeout=30).result(timeout=300)
+            np.testing.assert_array_equal(
+                r1.tokens, _oracle(model, params, turn1))
+            # 10 tokens, 9 written -> 2 full pages demoted.
+            key = list(r1.tokens)[:8]
+            assert _wait_for_demote(sched, key)
+            assert sched.stats()["kv"]["page_demotes"] == 1
+            # Device eviction: the host copy must now be the only way
+            # back short of re-prefill.
+            sched.trie.clear()
+            turn2 = ServeRequest(
+                prompt=[int(t) for t in r1.tokens] + [9, 10],
+                max_new_tokens=4, temperature=0.0, rng_seed=5)
+            r2 = sched.submit(turn2, timeout=30).result(timeout=300)
+            assert r2.prefix_len == 8
+            np.testing.assert_array_equal(
+                r2.tokens, _oracle(model, params, turn2))
+            kv = sched.stats()["kv"]
+            assert kv["page_promotes"] == 1
+            assert kv["digest_failures"] == 0
+            assert sched.host_tier.promotes == 1
+            # Leak-free drain: host entries are numpy copies and hold
+            # no pool references.
+            time.sleep(0.3)
+            sched.assert_drained(clear_prefix=True)
+            assert sched.pool.leak_report() == {}
+
+    def test_digest_mismatch_is_typed_fallback(self, model, params):
+        from cloud_tpu.serving import Scheduler, ServeRequest
+        turn1 = ServeRequest(prompt=[5, 6, 7, 8], max_new_tokens=6,
+                             temperature=0.0, rng_seed=3)
+        with Scheduler(model, params, slots=2, page_size=4,
+                       host_tier=True) as sched:
+            r1 = sched.submit(turn1, timeout=30).result(timeout=300)
+            key = list(r1.tokens)[:8]
+            assert _wait_for_demote(sched, key)
+            # Corrupt the STORED DIGEST stamp (the snapshot arrays are
+            # device_get views and may be read-only) — promote must
+            # detect the mismatch, drop the entry, and re-prefill.
+            for entry in sched.host_tier._entries.values():
+                entry["digest"] = "deadbeef"
+            sched.trie.clear()
+            turn2 = ServeRequest(
+                prompt=[int(t) for t in r1.tokens] + [9, 10],
+                max_new_tokens=4, temperature=0.0, rng_seed=5)
+            r2 = sched.submit(turn2, timeout=30).result(timeout=300)
+            assert r2.prefix_len == 0
+            np.testing.assert_array_equal(
+                r2.tokens, _oracle(model, params, turn2))
+            stats = sched.stats()
+            assert stats["kv"]["digest_failures"] == 1
+            assert stats["kv"]["page_promotes"] == 0
+            assert stats["faults"].get("host_tier_corrupt", 0) == 1
+            # The corrupt entry was dropped, not retried forever.
+            assert not sched.host_tier.contains(key)
+
+
+def test_conversation_spec_validation():
+    from cloud_tpu.serving.loadgen import ConversationSpec
+    ConversationSpec().validate()
+    with pytest.raises(ValueError, match="n_sessions"):
+        ConversationSpec(n_sessions=0).validate()
+    with pytest.raises(ValueError, match="user_tokens"):
+        ConversationSpec(user_tokens=0).validate()
+    with pytest.raises(ValueError, match="think_time"):
+        ConversationSpec(think_time=-1.0).validate()

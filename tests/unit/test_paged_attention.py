@@ -215,6 +215,162 @@ def test_shape_validation():
         pa.paged_decode_attention(q, kp, vp[:-1], pt, allowed)
 
 
+# -- int8 quantized pages (graftpack, ISSUE 17) -----------------------
+
+
+def _quantize_pages(pages):
+    """Per-page per-head symmetric int8 quantization — the same
+    contract the engine's page-write paths use: scale = amax / 127 over
+    the page's (positions, head_dim) block, dequant = int8 * scale. An
+    all-zero (never-written) page gets scale 0 so it dequantizes to
+    exact zeros."""
+    arr = np.asarray(pages, np.float32)
+    amax = np.max(np.abs(arr), axis=(1, 3))          # [num_pages, H]
+    scale = (amax / 127.0).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1.0)
+    q = np.clip(np.rint(arr / safe[:, None, :, None]), -127, 127)
+    return jnp.asarray(q, jnp.int8), jnp.asarray(scale)
+
+
+def _int8_scenario(**kwargs):
+    """A `_scenario` whose K/V pages are quantized to int8 + scales,
+    plus the dequantized f32 pages every impl's output must match."""
+    q, kp, vp, pt, allowed = _scenario(**kwargs)
+    kq, ks = _quantize_pages(kp)
+    vq, vs = _quantize_pages(vp)
+    kp_deq = jnp.asarray(np.asarray(kq, np.float32)
+                         * np.asarray(ks)[:, None, :, None])
+    vp_deq = jnp.asarray(np.asarray(vq, np.float32)
+                         * np.asarray(vs)[:, None, :, None])
+    return q, (kq, ks, kp_deq), (vq, vs, vp_deq), pt, allowed
+
+
+def _all_impls_int8(q, k3, v3, pt, allowed):
+    kq, ks, _ = k3
+    vq, vs, _ = v3
+    ref = pa.paged_attention_reference(q, kq, vq, pt, allowed,
+                                       key_scales=ks, value_scales=vs)
+    walk = pa._paged_walk_lax(q, kq, vq, pt, allowed,
+                              1.0 / np.sqrt(q.shape[-1]),
+                              key_scales=ks, value_scales=vs)
+    kern = pa.paged_decode_attention(q, kq, vq, pt, allowed,
+                                     interpret=True, key_scales=ks,
+                                     value_scales=vs)
+    return ref, walk, kern
+
+
+@pytest.mark.parametrize("seq", [1, 4])
+def test_int8_parity_across_impls(seq):
+    """Quantized pages: reference/walk/kernel must agree with each
+    other AND with the fp reference run on the explicitly dequantized
+    pages — the dequant must be mathematically inside the attention,
+    not an approximation of it."""
+    q, k3, v3, pt, allowed = _int8_scenario(seq=seq)
+    ref, walk, kern = _all_impls_int8(q, k3, v3, pt, allowed)
+    oracle = pa.paged_attention_reference(q, k3[2], v3[2], pt, allowed)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(oracle),
+                               atol=TOL, rtol=TOL)
+    np.testing.assert_allclose(np.asarray(walk), np.asarray(oracle),
+                               atol=TOL, rtol=TOL)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(oracle),
+                               atol=TOL, rtol=TOL)
+
+
+def test_int8_shared_donor_pages():
+    """CoW-shared donor pages carry ONE scale row per page — slots
+    sharing a page must dequantize it identically."""
+    q, k3, v3, pt, allowed = _int8_scenario(slots=3, seq=1)
+    pt = np.asarray(pt).copy()
+    pt[1, :2] = pt[0, :2]
+    pt[2, 0] = pt[0, 0]
+    pt = jnp.asarray(pt)
+    ref, walk, kern = _all_impls_int8(q, k3, v3, pt, allowed)
+    oracle = pa.paged_attention_reference(q, k3[2], v3[2], pt, allowed)
+    np.testing.assert_allclose(np.asarray(kern), np.asarray(oracle),
+                               atol=TOL, rtol=TOL)
+    np.testing.assert_allclose(np.asarray(walk), np.asarray(oracle),
+                               atol=TOL, rtol=TOL)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(oracle),
+                               atol=TOL, rtol=TOL)
+
+
+def test_int8_zero_scale_page_is_exact_zero():
+    """A never-written page carries scale 0: whatever int8 garbage the
+    pool left in it must dequantize to exact zeros and (masked) move no
+    output bit — the promote path relies on this for the scratch-padded
+    page-table tail."""
+    q, k3, v3, pt, allowed = _int8_scenario(slots=2, pages_per_slot=3,
+                                            seq=1)
+    kq, ks, _ = k3
+    vq, vs, _ = v3
+    pt = np.asarray(pt).copy()
+    pt[:, -1] = 0  # tail parked on scratch page 0
+    pt = jnp.asarray(pt)
+    allowed = np.asarray(allowed).copy()
+    allowed[:, :, -16:] = False
+    allowed = jnp.asarray(allowed)
+
+    def run(kq, ks):
+        return pa.paged_decode_attention(q, kq, vq, pt, allowed,
+                                         interpret=True, key_scales=ks,
+                                         value_scales=vs)
+
+    clean = run(kq, ks)
+    garbage = run(kq.at[0].set(127), ks.at[0].set(0.0))
+    np.testing.assert_array_equal(np.asarray(clean),
+                                  np.asarray(garbage))
+
+
+def test_int8_evicted_slot_outputs_exact_zeros():
+    """The kernel/walk all-False-mask contract survives quantization:
+    an evicted slot's rows are exact zeros, not dequant noise."""
+    q, k3, v3, pt, allowed = _int8_scenario(slots=3, seq=1)
+    allowed = np.asarray(allowed).copy()
+    allowed[1] = False
+    allowed = jnp.asarray(allowed)
+    _, walk, kern = _all_impls_int8(q, k3, v3, pt, allowed)
+    np.testing.assert_array_equal(np.asarray(walk)[1],
+                                  np.zeros_like(np.asarray(walk)[1]))
+    np.testing.assert_array_equal(np.asarray(kern)[1],
+                                  np.zeros_like(np.asarray(kern)[1]))
+
+
+def test_int8_scale_validation():
+    """Both-or-neither scales; int8 pages required; [N, H] f32 shape."""
+    q, kp, vp, pt, allowed = _scenario(seq=1)
+    kq, ks = _quantize_pages(kp)
+    vq, vs = _quantize_pages(vp)
+    with pytest.raises(ValueError, match="given together"):
+        pa.paged_decode_attention(q, kq, vq, pt, allowed,
+                                  interpret=True, key_scales=ks)
+    with pytest.raises(ValueError, match="int8 pages"):
+        pa.paged_decode_attention(q, kp, vp, pt, allowed,
+                                  interpret=True, key_scales=ks,
+                                  value_scales=vs)
+    with pytest.raises(ValueError, match="num_pages, heads"):
+        pa.paged_decode_attention(q, kq, vq, pt, allowed,
+                                  interpret=True, key_scales=ks[:-1],
+                                  value_scales=vs)
+
+
+def test_int8_dispatch_through_public_entrypoint():
+    """paged_attention() forwards scales to whichever impl it picks."""
+    q, k3, v3, pt, allowed = _int8_scenario(seq=1)
+    kq, ks, _ = k3
+    vq, vs, _ = v3
+    ref = pa.paged_attention_reference(q, kq, vq, pt, allowed,
+                                       key_scales=ks, value_scales=vs)
+    walk = pa._paged_walk_lax(q, kq, vq, pt, allowed,
+                              1.0 / np.sqrt(q.shape[-1]),
+                              key_scales=ks, value_scales=vs)
+    got = pa.paged_attention(q, kq, vq, pt, allowed, impl="reference",
+                             key_scales=ks, value_scales=vs)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    got = pa.paged_attention(q, kq, vq, pt, allowed, impl="paged",
+                             key_scales=ks, value_scales=vs)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(walk))
+
+
 def test_cost_hook():
     """The telemetry row: positive flops and bytes, and the fused
     bytes figure stays below the dense-gather materialization (the
