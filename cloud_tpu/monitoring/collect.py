@@ -709,6 +709,41 @@ def serve_report(lifecycles, globals_=(), slo_ttft=None, slo_tpot=None):
             "clean": _goodput(clean_rows, len(clean_rows)),
         },
     }
+    # graftflex: resize census (global resize events, stamped from/to/
+    # reason) + per-geometry occupancy split (tick_commit `slots`
+    # stamps). The split is what keeps an autoscale-vs-fixed A/B
+    # honest: a mean over mixed widths hides that the narrow rung ran
+    # full while the wide rung coasted.
+    resize_events = sorted(
+        (e for e in globals_ if e["event"] == "resize"),
+        key=lambda e: e["_monotonic"])
+    by_geom = {}
+    for events in lifecycles.values():
+        for event in events:
+            if (event["event"] == "tick_commit"
+                    and event.get("slots") is not None
+                    and event.get("active_slots") is not None):
+                by_geom.setdefault(int(event["slots"]), []).append(
+                    event["active_slots"])
+    report["geometry"] = {
+        "resizes": {
+            "grow": sum(1 for e in resize_events
+                        if e.get("to", 0) > e.get("from", 0)),
+            "shrink": sum(1 for e in resize_events
+                          if e.get("to", 0) < e.get("from", 0)),
+        },
+        "resize_events": [
+            {"from": e.get("from"), "to": e.get("to"),
+             "reason": e.get("reason"), "tick": e.get("tick")}
+            for e in resize_events],
+        "occupancy_by_slots": {
+            str(slots): {
+                "tick_commits": len(vals),
+                "active_mean": sum(vals) / len(vals),
+                "utilization": sum(vals) / (len(vals) * slots),
+            }
+            for slots, vals in sorted(by_geom.items())},
+    }
     return report
 
 
@@ -886,6 +921,21 @@ def serve_trace_lane(lifecycles, globals_=(), pid=0):
                        "name": event["event"], "ts": _us(event["_monotonic"]),
                        "args": {k: v for k, v in event.items()
                                 if not k.startswith("_")}})
+    # graftflex geometry lane: a Perfetto counter stepping at each
+    # resize, seeded with the pre-resize width (the first event's
+    # `from`) so the rung the run STARTED on is visible too. Fixed-
+    # geometry runs have no resize events and draw no lane.
+    resizes = sorted((e for e in globals_ if e["event"] == "resize"),
+                     key=lambda e: e["_monotonic"])
+    if resizes:
+        events.append({"ph": "C", "pid": pid, "tid": 0,
+                       "name": "slot_count", "ts": _us(t0),
+                       "args": {"slots": resizes[0].get("from")}})
+        for event in resizes:
+            events.append({"ph": "C", "pid": pid, "tid": 0,
+                           "name": "slot_count",
+                           "ts": _us(event["_monotonic"]),
+                           "args": {"slots": event.get("to")}})
     ordered = sorted(lifecycles.items(),
                      key=lambda kv: kv[1][0]["_monotonic"])
     for tid, (key, levents) in enumerate(ordered, start=1):

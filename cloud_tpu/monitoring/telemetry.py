@@ -144,6 +144,16 @@ SERVE_PAGE_DEMOTES_TOTAL = "cloud_tpu_serve_page_demotes_total"
 SERVE_PAGE_PROMOTES_TOTAL = "cloud_tpu_serve_page_promotes_total"
 SERVE_DIGEST_FAILURES_TOTAL = "cloud_tpu_serve_digest_failures_total"
 
+#: graftflex (elastic tick geometry) names. The slot-count gauge is
+#: the CURRENT ladder rung; the resize counter labels by direction
+#: (grow/shrink) via the `%s` suffix; the per-tick latency histogram
+#: labels by the slot count the tick ran at — one histogram per rung,
+#: so a goodput A/B never averages a 4-wide tick against a 32-wide
+#: one (the mixed-width trap the geometry stamp closes).
+SERVE_SLOT_COUNT = "cloud_tpu_serve_slot_count"
+SERVE_RESIZES_TOTAL = "cloud_tpu_serve_resizes_total_%s"
+SERVE_TICK_SECONDS = "cloud_tpu_serve_tick_seconds_slots_%s"
+
 #: graftsweep (tuner/sweep.py) names. Counters accrue across every
 #: sweep a process runs; the gauges hold the LATEST sweep's values.
 #: `_warm_trials_total` counts reused-Trainer trials that finished

@@ -1,0 +1,273 @@
+"""Learned TTFT admission predictor fit offline from the reqtrace corpus.
+
+The scheduler's admission controller must answer one question before a request
+is allowed to queue: *if admitted now, when does its first token land?*  Until
+this module existed the answer came from two live histograms (prefill p50 and
+tick p50) accumulated since process start — blind for the first few dozen
+requests after a restart, and blind to bucket-dependent prefill cost.  But the
+graftlens reqtrace corpus already records exact per-phase ground truth for
+every historical request: ``prefill`` events carry the padded bucket and the
+measured duration, ``prefill_chunk`` events carry per-chunk durations,
+``complete`` events carry end-to-end latency + TTFT + token count, and
+``pages_reserved`` events carry the page-reservation wait.  This module fits a
+small per-phase quantile model from that corpus and serves predictions that
+mirror the live heuristic's phase arithmetic exactly — so the scheduler can
+swap it in without changing admission semantics, and fall back to the
+histogram heuristic whenever the model is absent or a phase is missing.
+
+Fit offline, load at serve time::
+
+    python -m cloud_tpu.serving.admission fit --trace /var/logs/reqtrace \\
+        --out admission_model.json
+    python -m cloud_tpu.serving.admission show --model admission_model.json
+
+    CLOUD_TPU_SERVE_ADMISSION_MODEL=admission_model.json  # read at start()
+
+Model shape (``cloud_tpu.admission_model.v1`` JSON):
+
+* ``prefill`` — median prefill seconds as a linear function of the padded
+  prompt bucket.  Fit as a binned quantile regression: samples are grouped by
+  bucket, the q=0.5 quantile is taken per bin, and a count-weighted least
+  squares line is fit through the bin quantiles.  Deterministic, exact on
+  clean corpora, and robust to bucket imbalance (each bucket contributes its
+  own quantile, not its raw sample mass).
+* ``prefill_chunk`` — scalar q=0.5 of per-chunk prefill seconds (chunks are
+  fixed-shape, so duration does not depend on the prompt).
+* ``token`` — scalar q=0.5 of steady-state seconds-per-token, derived from
+  ``complete`` events as ``(latency_s - ttft_s) / (tokens - 1)``.
+* ``reserve_wait`` — scalar q=0.95 of page-reservation wait seconds, added
+  when the pool is short at admission time (mirrors the heuristic's
+  pessimistic reserve term).
+
+``predict_ttft`` returns ``None`` (never a guess) when the phases required
+for the request's admission path are missing, which the scheduler treats as
+"fall back to the histogram heuristic".
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+FORMAT = "cloud_tpu.admission_model.v1"
+
+#: Phase quantiles baked into the fit.  The median is the right operating
+#: point for additive phase arithmetic (summing p95s compounds pessimism);
+#: reserve_wait stays pessimistic because a short pool is already a tail
+#: condition when it triggers.
+_PHASE_Q = {"prefill": 0.5, "prefill_chunk": 0.5, "token": 0.5,
+            "reserve_wait": 0.95}
+
+
+def _quantile(values, q):
+    return float(np.quantile(np.asarray(values, dtype=np.float64), q))
+
+
+def _fit_binned_linear(samples, q):
+    """Count-weighted LS line through per-bucket quantiles.
+
+    ``samples`` is a list of ``(bucket, seconds)`` pairs.  Returns
+    ``(intercept, slope, n)``.  With a single distinct bucket the slope is
+    pinned to zero so the model extrapolates flat rather than wildly.
+    """
+    by_bucket = {}
+    for bucket, dur in samples:
+        by_bucket.setdefault(int(bucket), []).append(float(dur))
+    buckets = sorted(by_bucket)
+    qs = np.asarray([_quantile(by_bucket[b], q) for b in buckets])
+    counts = np.asarray([len(by_bucket[b]) for b in buckets], dtype=np.float64)
+    xs = np.asarray(buckets, dtype=np.float64)
+    if len(buckets) == 1:
+        return float(qs[0]), 0.0, len(samples)
+    w = counts / counts.sum()
+    xm = float((w * xs).sum())
+    ym = float((w * qs).sum())
+    var = float((w * (xs - xm) ** 2).sum())
+    slope = float((w * (xs - xm) * (qs - ym)).sum() / var) if var > 0 else 0.0
+    return ym - slope * xm, slope, len(samples)
+
+
+class AdmissionModel:
+    """A fitted per-phase TTFT model; see the module docstring for shape."""
+
+    def __init__(self, doc):
+        if not isinstance(doc, dict) or doc.get("format") != FORMAT:
+            raise ValueError(
+                "not a %s document (format=%r)" % (FORMAT, doc.get("format")
+                                                   if isinstance(doc, dict)
+                                                   else type(doc).__name__))
+        self.doc = doc
+        self.phases = doc["phases"]
+        if not isinstance(self.phases, dict):
+            raise ValueError("phases must be a mapping")
+        for name, phase in self.phases.items():
+            kind = phase.get("kind")
+            if kind == "linear":
+                [float(v) for v in phase["weights"]]
+            elif kind == "quantile":
+                float(phase["value"])
+            else:
+                raise ValueError("phase %r has unknown kind %r" % (name, kind))
+
+    def _scalar(self, name):
+        phase = self.phases.get(name)
+        return None if phase is None else max(float(phase["value"]), 0.0)
+
+    def _prefill_s(self, bucket):
+        phase = self.phases.get("prefill")
+        if phase is None:
+            return None
+        w0, w1 = (float(v) for v in phase["weights"])
+        return max(w0 + w1 * float(bucket), 0.0)
+
+    def predict_ttft(self, accrued, position, bucket, prompt_len, n_chunks,
+                     pool_short):
+        """Predicted TTFT in seconds, or None to fall back to the heuristic.
+
+        Mirrors the scheduler's histogram arithmetic phase for phase:
+        ``position`` requests drain ahead of this one, then its own prefill
+        runs (``n_chunks`` chunk passes interleaved with decode ticks when
+        chunked prefill is on, one dense pass otherwise).
+        """
+        del prompt_len  # the bucket is the cost-relevant resolution
+        if n_chunks is not None:
+            chunk_s = self._scalar("prefill_chunk")
+            if chunk_s is None:
+                return None
+            token_s = self._scalar("token") or 0.0
+            predicted = (accrued + position * chunk_s + n_chunks * chunk_s
+                         + max(n_chunks - 1, 0) * token_s)
+        else:
+            prefill_s = self._prefill_s(bucket)
+            if prefill_s is None:
+                return None
+            predicted = accrued + (position + 1) * prefill_s
+        if pool_short:
+            reserve_s = self._scalar("reserve_wait")
+            if reserve_s is not None:
+                predicted += reserve_s
+        return float(predicted)
+
+
+def load_model(path):
+    """Load a fitted model; raises OSError/ValueError/KeyError on bad input."""
+    with open(path, "r", encoding="utf-8") as handle:
+        doc = json.load(handle)
+    return AdmissionModel(doc)
+
+
+def _iter_trace_files(paths):
+    for path in paths:
+        if os.path.isdir(path):
+            names = sorted(name for name in os.listdir(path)
+                           if name.endswith(".jsonl"))
+            if not names:
+                raise ValueError("no .jsonl trace files under %s" % path)
+            for name in names:
+                yield os.path.join(path, name)
+        else:
+            yield path
+
+
+def _iter_events(files):
+    for path in files:
+        with open(path, "r", encoding="utf-8") as handle:
+            for line in handle:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line from a crashed writer
+                if record.get("kind") != "reqtrace":
+                    continue
+                payload = record.get("payload")
+                if isinstance(payload, dict):
+                    yield payload
+
+
+def fit(trace_paths):
+    """Fit a model document from reqtrace JSONL files or directories."""
+    files = list(_iter_trace_files(trace_paths))
+    prefill, chunks, tokens, reserves = [], [], [], []
+    n_events = 0
+    for payload in _iter_events(files):
+        n_events += 1
+        event = payload.get("event")
+        if event == "prefill" and "bucket" in payload and "dur_s" in payload:
+            # Chunked prefills stamp a `chunks` count and their dur_s spans
+            # the interleaved decode ticks — wrong cost basis for the dense
+            # path, which is the only consumer of this phase.
+            if "chunks" not in payload:
+                prefill.append((payload["bucket"], payload["dur_s"]))
+        elif event == "prefill_chunk" and "dur_s" in payload:
+            chunks.append(float(payload["dur_s"]))
+        elif event == "complete":
+            latency = payload.get("latency_s")
+            ttft = payload.get("ttft_s")
+            n_tokens = payload.get("tokens", 0)
+            if latency is not None and ttft is not None and n_tokens > 1:
+                tokens.append(max(latency - ttft, 0.0) / (n_tokens - 1))
+        elif event == "pages_reserved" and "wait_s" in payload:
+            reserves.append(float(payload["wait_s"]))
+    if n_events == 0:
+        raise ValueError("no reqtrace events in %s" % (trace_paths,))
+    phases = {}
+    if prefill:
+        w0, w1, n = _fit_binned_linear(prefill, _PHASE_Q["prefill"])
+        phases["prefill"] = {"kind": "linear", "q": _PHASE_Q["prefill"],
+                             "features": ["const", "bucket"],
+                             "weights": [w0, w1], "n": n}
+    for name, values in (("prefill_chunk", chunks), ("token", tokens),
+                         ("reserve_wait", reserves)):
+        if values:
+            phases[name] = {"kind": "quantile", "q": _PHASE_Q[name],
+                            "value": _quantile(values, _PHASE_Q[name]),
+                            "n": len(values)}
+    return {"format": FORMAT,
+            "fit": {"events": n_events, "files": [os.path.basename(f)
+                                                  for f in files],
+                    "requests": len(tokens)},
+            "phases": phases}
+
+
+def _cmd_fit(args):
+    doc = fit(args.trace)
+    with open(args.out, "w", encoding="utf-8") as handle:
+        json.dump(doc, handle, indent=2, sort_keys=True)
+        handle.write("\n")
+    if not args.quiet:
+        print("wrote %s: %d events -> phases %s"
+              % (args.out, doc["fit"]["events"], sorted(doc["phases"])))
+    return 0
+
+
+def _cmd_show(args):
+    model = load_model(args.model)
+    print(json.dumps(model.doc, indent=2, sort_keys=True))
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m cloud_tpu.serving.admission",
+        description="Fit/inspect the reqtrace-derived TTFT admission model.")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_fit = sub.add_parser("fit", help="fit a model from reqtrace JSONL")
+    p_fit.add_argument("--trace", nargs="+", required=True,
+                       help="reqtrace .jsonl files or directories holding them")
+    p_fit.add_argument("--out", default="admission_model.json")
+    p_fit.add_argument("--quiet", action="store_true")
+    p_fit.set_defaults(func=_cmd_fit)
+    p_show = sub.add_parser("show", help="print a fitted model")
+    p_show.add_argument("--model", required=True)
+    p_show.set_defaults(func=_cmd_show)
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

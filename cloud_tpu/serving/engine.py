@@ -93,6 +93,27 @@ def _map_attention(cache, fn, *rest):
     return cache
 
 
+_GATHER_READS = ("key_pages", "value_pages", "key_scales",
+                 "value_scales")
+
+
+def _pool_pages_view(cache):
+    """Geometry-free view of a pool cache for the prefix gather. The
+    gather reads only the page arrays (pool-indexed, fixed shape), but
+    per-slot state (page_table [slots, ppn], slot_steps [slots],
+    slot_valid [slots, L], pos_count [slots]) rides along in the
+    pytree and would bind the executable's signature to one slot
+    count — a prefix hit after an elastic resize would then retrace.
+    Whitelisting the page arrays here, outside the jit boundary (keys
+    kept, unread leaves None'd), keeps one executable across every
+    geometry rung."""
+    view = _map_attention(
+        cache, lambda att: {k: (v if k in _GATHER_READS else None)
+                            for k, v in att.items()})
+    view["pos_count"] = None
+    return view
+
+
 def _sample_one(logits, key, temperature, top_k, top_p):
     """One slot's sampler: `generate()`'s sample() with the sampling
     config as runtime values. Disabled values are exact identities —
@@ -379,7 +400,7 @@ class DecodeEngine:
 
     def __init__(self, model, params, slots, page_size, num_pages,
                  max_new_cap=None, draft_model=None, draft_params=None,
-                 spec_k=0, page_dtype=""):
+                 spec_k=0, page_dtype="", ladder=None):
         from cloud_tpu.models.transformer import TransformerLM
 
         if not isinstance(model, TransformerLM):
@@ -403,6 +424,28 @@ class DecodeEngine:
         self.max_new_cap = int(max_new_cap or model.max_seq_len)
         if self.max_new_cap < 2:
             raise ValueError("max_new_cap must be >= 2.")
+        # graftflex geometry ladder: the slot counts this engine may
+        # resize between. Page tables are pool-indexed, so a resize
+        # migrates slot ROWS only (a fixed-shape gather per geometry
+        # pair) — KV pages never move and one PagePool serves every
+        # rung. A singleton ladder is the fixed-geometry engine.
+        ladder = tuple(int(s) for s in (ladder or (self.slots,)))
+        if any(s < 1 for s in ladder):
+            raise ValueError(
+                "ladder rungs must be positive; got {}.".format(ladder))
+        if list(ladder) != sorted(set(ladder)):
+            raise ValueError(
+                "ladder must be strictly increasing; got {}.".format(
+                    ladder))
+        if len(ladder) > 1 and any(s & (s - 1) for s in ladder):
+            raise ValueError(
+                "ladder rungs must be powers of two (the pre-warmed "
+                "geometry set stays small); got {}.".format(ladder))
+        if self.slots not in ladder:
+            raise ValueError(
+                "initial slots ({}) must be a ladder rung; got "
+                "{}.".format(self.slots, ladder))
+        self.ladder = ladder
         self._params = params
         self.spec_k = int(spec_k)
         self.spec_on = draft_model is not None and self.spec_k > 0
@@ -478,6 +521,8 @@ class DecodeEngine:
                 jit, donate_argnums=(0, 1, 2))(self._insert_spec_impl))
             self._evict = best_effort_donation(functools.partial(
                 jit, donate_argnums=(0, 1, 2))(self._evict_spec_impl))
+            self._resize = best_effort_donation(functools.partial(
+                jit, donate_argnums=(0, 1, 2))(self._resize_spec_impl))
         else:
             self._tick = best_effort_donation(functools.partial(
                 jit, donate_argnums=(1, 2))(self._tick_impl))
@@ -485,15 +530,25 @@ class DecodeEngine:
                 jit, donate_argnums=(0, 1))(self._insert_impl))
             self._evict = best_effort_donation(functools.partial(
                 jit, donate_argnums=(0, 1))(self._evict_impl))
-        self._gather = best_effort_donation(functools.partial(
+            self._resize = best_effort_donation(functools.partial(
+                jit, donate_argnums=(0, 1))(self._resize_impl))
+        gather_exec = best_effort_donation(functools.partial(
             jit, donate_argnums=(0,))(self._gather_impl))
+
+        def gather(dense_cache, pool_cache, page_vec, prefix_len):
+            # The view strips slot-count-bound leaves so the gather
+            # signature is identical at every geometry rung.
+            return gather_exec(dense_cache, _pool_pages_view(pool_cache),
+                               page_vec, prefix_len)
+
+        self._gather = gather
         # Host-tier executables: snapshot READS the pool cache (no
         # donation — the tick keeps it); promote replaces it.
         self._snapshot = jit(self._snapshot_impl)
         self._promote = best_effort_donation(functools.partial(
             jit, donate_argnums=(0,))(self._promote_impl))
         self._warm_stats = None
-        self._kernel_costs = None
+        self._kernel_costs = {}
 
     # -- prefill ------------------------------------------------------
 
@@ -699,6 +754,43 @@ class DecodeEngine:
             self.cache, self.ctl = self._evict(
                 self.cache, self.ctl, jnp.asarray(evict_mask, bool))
 
+    def resize(self, new_slots, perm):
+        """Moves the engine to ladder rung `new_slots` at a tick
+        boundary. `perm` is int32 `[new_slots]`: new slot i takes old
+        slot `perm[i]`'s rows (-1 = empty). Geometry-BOUND state only
+        moves — page tables, validity, positions, and the control rows
+        (rng schedules, eos latches, step counters) gather through one
+        fixed-shape executable per (old, new) pair; the KV pages (and
+        the draft twin's, under the same perm) stay exactly where they
+        are in the shared pool. In-flight slots therefore continue
+        bit-identical: their step_keys rows, steps_done counters and
+        done/eos latches ride the gather unchanged. Tick thread only —
+        must run between ticks, never mid-tick."""
+        new_slots = int(new_slots)
+        if new_slots not in self.ladder:
+            raise ValueError(
+                "resize target {} is not a ladder rung {}.".format(
+                    new_slots, self.ladder))
+        perm = np.asarray(perm, np.int32).reshape(-1)
+        if perm.shape[0] != new_slots:
+            raise ValueError(
+                "perm must have {} rows; got {}.".format(
+                    new_slots, perm.shape[0]))
+        live = perm[perm >= 0]
+        if (perm >= self.slots).any() or len(set(live.tolist())) \
+                != live.shape[0]:
+            raise ValueError(
+                "perm rows must be -1 or unique old-slot indices "
+                "< {}; got {}.".format(self.slots, perm.tolist()))
+        pv = jnp.asarray(perm, jnp.int32)
+        if self.spec_on:
+            self.cache, self.draft_cache, self.ctl = self._resize(
+                self.cache, self.draft_cache, self.ctl, pv)
+        else:
+            self.cache, self.ctl = self._resize(self.cache, self.ctl,
+                                                pv)
+        self.slots = new_slots
+
     # -- retrace sentinel ---------------------------------------------
 
     def mark_warm(self):
@@ -718,34 +810,38 @@ class DecodeEngine:
                 "serving path traced/compiled after warm-up: {} "
                 "(static-shape leak).".format(grew))
 
-    def kernel_costs(self):
+    def kernel_costs(self, slots=None):
         """Per-TICK cost rows for the telemetry kernel gauges: the
         paged-attention flops / bytes-moved one tick dispatches (all
         layers, verify-window width when speculating), from the jit
         cost-analysis hook in ops/paged_attention.py. Computed lazily
         (one uninstrumented lowering — the retrace sentinel counts only
-        `instrumented_jit` sites) and cached; the scheduler pairs it
-        with the measured tick latency for the pct_peak gauge."""
-        if self._kernel_costs is None:
+        `instrumented_jit` sites) and cached PER GEOMETRY: a tick's
+        cost scales with its slot count, so A/B rows from different
+        ladder rungs must never share one entry. Defaults to the
+        current rung; the scheduler pairs the rows with the measured
+        tick latency for the pct_peak gauge."""
+        slots = int(self.slots if slots is None else slots)
+        if slots not in self._kernel_costs:
             from cloud_tpu import ops
 
             model = self.model
             head_dim = model.d_model // model.num_heads
             seq = self.spec_k + 1 if self.spec_on else 1
             cost = ops.paged_attention_cost(
-                self.slots, seq, model.num_heads, head_dim,
+                slots, seq, model.num_heads, head_dim,
                 self.page_size, self.pages_per_slot,
                 dtype=model.compute_dtype,
                 kv_dtype=(jnp.int8 if self.page_dtype == "int8"
                           else None))
             layers = model.num_layers
-            self._kernel_costs = {
+            self._kernel_costs[slots] = {
                 "paged_attention": {
                     "flops": cost["flops"] * layers,
                     "bytes_moved": cost["bytes_moved"] * layers,
                 },
             }
-        return self._kernel_costs
+        return self._kernel_costs[slots]
 
     # -- jitted bodies ------------------------------------------------
 
@@ -790,8 +886,10 @@ class DecodeEngine:
 
         result = _map_attention(pool_cache, seed, dense_cache)
         # _map_attention keeps non-attention leaves from its FIRST
-        # tree; the only one is pos_count, whose pool shape is [S] —
-        # replace it with the dense [1] counter at the prefix depth.
+        # tree; the only one is pos_count, stripped to None by the
+        # caller's _pool_pages_view (its pool shape [slots] would bind
+        # the geometry) — install the dense [1] counter at the prefix
+        # depth.
         result["pos_count"] = jnp.full((1,), prefix_len, jnp.int32)
         return result
 
@@ -956,7 +1054,11 @@ class DecodeEngine:
         from cloud_tpu.models.speculative import greedy_accept
 
         k = self.spec_k
-        slots = self.slots
+        # Width from the traced aval, not self.slots: the ladder
+        # retraces this body once per rung, and the host attribute may
+        # already point at the NEXT rung while a cached executable
+        # replays an earlier one.
+        slots = ctl["active"].shape[0]
         active = ctl["active"]
         mask1 = active[:, None]
 
@@ -1183,6 +1285,56 @@ class DecodeEngine:
         new_cache, out_ctl = self._evict_impl(cache, ctl, evict_mask)
         new_dcache = self._clear_slots(dcache, ~evict_mask)
         return new_cache, new_dcache, out_ctl
+
+    def _resize_slots(self, cache, perm):
+        """Geometry-bound slot rows gathered to the new width; the
+        page arrays flow through donated and untouched. An empty new
+        row (perm -1, src clipped to 0) zeroes exactly the leaves
+        `_evict_impl` zeroes, so a fresh rung looks like freshly
+        evicted slots."""
+        mask = perm >= 0
+        src = jnp.clip(perm, 0)
+
+        def rs(att):
+            out = dict(att)
+            out["page_table"] = jnp.where(mask[:, None],
+                                          att["page_table"][src], 0)
+            out["slot_steps"] = jnp.where(mask, att["slot_steps"][src],
+                                          0)
+            out["slot_valid"] = att["slot_valid"][src] & mask[:, None]
+            return out
+
+        new_cache = _map_attention(cache, rs)
+        new_cache["pos_count"] = jnp.where(mask,
+                                           cache["pos_count"][src], 0)
+        return new_cache
+
+    def _resize_ctl(self, ctl, perm):
+        """Control rows under the same perm. The masked leaves mirror
+        `_evict_impl`'s zeroing; sampling config / eos / step_keys rows
+        gather unmasked — evict leaves them stale too, and a clipped
+        src just copies a real row's staleness. In-flight rows carry
+        their exact rng schedule, latch and counters, which is the
+        bit-identity contract across a resize."""
+        mask = perm >= 0
+        src = jnp.clip(perm, 0)
+        out_ctl = {k: v[src] for k, v in ctl.items()}
+        out_ctl["active"] = ctl["active"][src] & mask
+        out_ctl["done"] = ctl["done"][src] & mask
+        out_ctl["steps_done"] = jnp.where(mask, ctl["steps_done"][src],
+                                          0)
+        out_ctl["cur_tok"] = jnp.where(mask, ctl["cur_tok"][src], 0)
+        out_ctl["max_steps"] = jnp.where(mask, ctl["max_steps"][src], 0)
+        return out_ctl
+
+    def _resize_impl(self, cache, ctl, perm):
+        return (self._resize_slots(cache, perm),
+                self._resize_ctl(ctl, perm))
+
+    def _resize_spec_impl(self, cache, dcache, ctl, perm):
+        return (self._resize_slots(cache, perm),
+                self._resize_slots(dcache, perm),
+                self._resize_ctl(ctl, perm))
 
 
 __all__ = ["ChunkedPrefill", "DecodeEngine", "PrefillResult",

@@ -139,44 +139,39 @@ def _percentiles(values):
     }
 
 
-def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
-             result_timeout=300.0):
-    """Drives one open-arrival run against a started, warmed Scheduler.
-
-    Returns the run report dict (format cloud_tpu.loadgen.v1): offered /
-    completed / rejected / failed / shed counts (shed = refused by the
-    SLO admission gate, a typed ServeShed), offered vs. achieved rps,
-    TTFT / TPOT / latency percentiles, goodput against the SLOs, and a
-    per-request row list (the collector's cross-check against the
-    reqtrace waterfall).
-    """
-    arrivals = build_arrivals(spec)
-    requests = build_requests(spec, scheduler.engine.model.vocab_size,
-                              scheduler.engine.max_seq_len)
+def _run_open_loop(scheduler, requests, arrivals, submit_timeout,
+                   slo_ttft, slo_tpot, result_timeout, tags=None,
+                   keep_tokens=False):
+    """Open-loop core shared by every arrival scenario: submit each
+    request at its scheduled offset from run start regardless of
+    completions, then harvest every future. `tags` (optional, parallel
+    to `requests`) is a dict merged into each per-request row — how the
+    diurnal scenario stamps rows with their segment. Returns
+    (rows, counts, wall_s)."""
     inflight = []
     t0 = time.monotonic()
-    for request, t_arr in zip(requests, arrivals):
+    for i, (request, t_arr) in enumerate(zip(requests, arrivals)):
         delay = t0 + float(t_arr) - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         t_sub = time.monotonic() - t0
         try:
-            future = scheduler.submit(request,
-                                      timeout=spec.submit_timeout)
+            future = scheduler.submit(request, timeout=submit_timeout)
         except queue.Full:
-            inflight.append((request, t_sub, None))
-            continue
-        inflight.append((request, t_sub, future))
+            future = None
+        inflight.append((i, request, t_sub, future))
 
     rows = []
     completed = rejected = failed = shed = 0
     t_last_done = t0
-    for request, t_sub, future in inflight:
+    for i, request, t_sub, future in inflight:
         row = {
             "submit_s": round(t_sub, 6),
             "prompt_len": len(request.prompt),
             "max_new": request.max_new_tokens,
         }
+        if tags is not None:
+            row.update(tags[i])
         if future is None:
             rejected += 1
             row["status"] = "rejected"
@@ -207,12 +202,40 @@ def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
                    tpot_s=None if tpot is None else round(tpot, 6),
                    prefix_len=int(result.prefix_len),
                    hit=bool(result.prefix_len > 0))
+        if keep_tokens:
+            row["tokens"] = [int(t) for t in result.tokens]
         row["good"] = bool(
             (slo_ttft is None or result.ttft_s <= slo_ttft)
             and (slo_tpot is None or tpot is None or tpot <= slo_tpot))
         rows.append(row)
 
     wall = max(t_last_done - t0, 1e-9)
+    counts = {"completed": completed, "rejected": rejected,
+              "failed": failed, "shed": shed}
+    return rows, counts, wall
+
+
+def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
+             result_timeout=300.0):
+    """Drives one open-arrival run against a started, warmed Scheduler.
+
+    Returns the run report dict (format cloud_tpu.loadgen.v1): offered /
+    completed / rejected / failed / shed counts (shed = refused by the
+    SLO admission gate, a typed ServeShed), offered vs. achieved rps,
+    TTFT / TPOT / latency percentiles, goodput against the SLOs, and a
+    per-request row list (the collector's cross-check against the
+    reqtrace waterfall).
+    """
+    arrivals = build_arrivals(spec)
+    requests = build_requests(spec, scheduler.engine.model.vocab_size,
+                              scheduler.engine.max_seq_len)
+    rows, counts, wall = _run_open_loop(
+        scheduler, requests, arrivals, spec.submit_timeout,
+        slo_ttft, slo_tpot, result_timeout)
+    completed = counts["completed"]
+    rejected = counts["rejected"]
+    failed = counts["failed"]
+    shed = counts["shed"]
     offered_span = max(float(arrivals[-1]), 1e-9)
     good = sum(1 for r in rows if r.get("good"))
     done_rows = [r for r in rows if r["status"] == "complete"]
@@ -245,6 +268,156 @@ def run_load(scheduler, spec, slo_ttft=None, slo_tpot=None,
                                  for r in done_rows]),
         "hit_rate": (sum(1 for r in done_rows if r.get("hit"))
                      / max(len(done_rows), 1)),
+        "per_request": rows,
+    }
+
+
+@dataclasses.dataclass
+class DiurnalSpec:
+    """Sinusoidal-ramp offered rate (graftflex's A/B workload): the run
+    is `segments` back-to-back windows of `segment_s` seconds whose
+    offered rate traces half a diurnal cycle — starts at `rate_lo`,
+    peaks at `rate_hi` mid-run, and ramps back down. Within each
+    segment arrivals come from the existing Poisson/bursty machinery at
+    that segment's rate, so the only new ingredient is the envelope.
+    The ramp-up exercises grow resizes, the ramp-down shrink resizes,
+    and the per-segment goodput-vs-offered curve is the autoscale-vs-
+    fixed comparison surface. All randomness flows from `seed`."""
+    rate_lo: float = 2.0
+    rate_hi: float = 16.0
+    segments: int = 6
+    segment_s: float = 2.0
+    process: str = "poisson"
+    burstiness: float = 4.0
+    prompt_buckets: tuple = ((6, 0.4), (12, 0.35), (24, 0.25))
+    max_new_lo: int = 2
+    max_new_hi: int = 8             # inclusive
+    shared_prefix_ratio: float = 0.0
+    shared_prefix_len: int = 16
+    seed: int = 0
+    submit_timeout: float = 0.05
+
+    def validate(self):
+        if not 0 < self.rate_lo <= self.rate_hi:
+            raise ValueError("need 0 < rate_lo <= rate_hi.")
+        if self.segments < 2:
+            raise ValueError("segments must be >= 2.")
+        if self.segment_s <= 0:
+            raise ValueError("segment_s must be > 0.")
+
+    def segment_rates(self):
+        """Offered rate per segment: raised-cosine from rate_lo up to
+        rate_hi and back — segment 0 sits at the trough, the midpoint
+        at the crest."""
+        n = self.segments
+        return [self.rate_lo + (self.rate_hi - self.rate_lo) * 0.5
+                * (1.0 - float(np.cos(2.0 * np.pi * k / n)))
+                for k in range(n)]
+
+
+def build_diurnal(spec, vocab_size, max_seq_len):
+    """The complete diurnal traffic for `spec`, sorted by arrival
+    time: a list of (arrival_s, segment, request) entries. Each
+    segment draws its own arrival schedule and request population from
+    distinct seed streams, so two schedulers fed the same spec (an
+    autoscale-vs-fixed A/B) replay identical traffic. A low-rate
+    segment's tail can spill past its window; the merge-sort hands the
+    submit loop one monotonic timeline."""
+    spec.validate()
+    entries = []
+    for k, rate in enumerate(spec.segment_rates()):
+        seg_spec = LoadSpec(
+            rate=rate,
+            n_requests=max(1, int(round(rate * spec.segment_s))),
+            process=spec.process, burstiness=spec.burstiness,
+            prompt_buckets=spec.prompt_buckets,
+            max_new_lo=spec.max_new_lo, max_new_hi=spec.max_new_hi,
+            shared_prefix_ratio=spec.shared_prefix_ratio,
+            shared_prefix_len=spec.shared_prefix_len,
+            seed=spec.seed + 101 * k + 1,
+            submit_timeout=spec.submit_timeout)
+        arrivals = build_arrivals(seg_spec) + k * spec.segment_s
+        requests = build_requests(seg_spec, vocab_size, max_seq_len)
+        for t_arr, request in zip(arrivals, requests):
+            entries.append((float(t_arr), k, request))
+    entries.sort(key=lambda e: e[0])
+    return entries
+
+
+def run_diurnal(scheduler, spec, slo_ttft=None, slo_tpot=None,
+                result_timeout=300.0, keep_tokens=False):
+    """Drives one sinusoidal-ramp run against a started, warmed
+    Scheduler.
+
+    Every per-request row is stamped with its segment and its index
+    `i` into the deterministic `build_diurnal` population (how an A/B
+    harness lines rows up against a solo-generate oracle);
+    `keep_tokens=True` additionally records each completed request's
+    token ids for bit-identity checks. Returns the run report (format
+    cloud_tpu.loadgen_diurnal.v1): the overall counts/goodput/
+    percentiles of run_load plus `offered_curve` — per-segment offered
+    rate vs goodput vs TTFT — and `worst_ttft_p99`, the worst
+    per-segment TTFT p99 (the "equal worst-case p99" side of the
+    ROADMAP autoscaling gate)."""
+    entries = build_diurnal(spec, scheduler.engine.model.vocab_size,
+                            scheduler.engine.max_seq_len)
+    rates = spec.segment_rates()
+    rows, counts, wall = _run_open_loop(
+        scheduler, [e[2] for e in entries], [e[0] for e in entries],
+        spec.submit_timeout, slo_ttft, slo_tpot, result_timeout,
+        tags=[{"segment": seg, "i": i}
+              for i, (_, seg, _) in enumerate(entries)],
+        keep_tokens=keep_tokens)
+
+    curve = []
+    for k, rate in enumerate(rates):
+        seg_rows = [r for r in rows if r["segment"] == k]
+        seg_done = [r for r in seg_rows if r["status"] == "complete"]
+        good = sum(1 for r in seg_rows if r.get("good"))
+        curve.append({
+            "segment": k,
+            "offered_rate": rate,
+            "offered": len(seg_rows),
+            "completed": len(seg_done),
+            "good": good,
+            "goodput": good / max(len(seg_rows), 1),
+            "ttft": _percentiles([r.get("ttft_s") for r in seg_done]),
+        })
+    good = sum(1 for r in rows if r.get("good"))
+    done_rows = [r for r in rows if r["status"] == "complete"]
+    worst_p99 = [c["ttft"]["p99"] for c in curve
+                 if c["ttft"]["p99"] is not None]
+    return {
+        "format": "cloud_tpu.loadgen_diurnal.v1",
+        "spec": {
+            "rate_lo": spec.rate_lo,
+            "rate_hi": spec.rate_hi,
+            "segments": spec.segments,
+            "segment_s": spec.segment_s,
+            "segment_rates": rates,
+            "process": spec.process,
+            "burstiness": spec.burstiness,
+            "prompt_buckets": [list(b) for b in spec.prompt_buckets],
+            "max_new": [spec.max_new_lo, spec.max_new_hi],
+            "shared_prefix_ratio": spec.shared_prefix_ratio,
+            "shared_prefix_len": spec.shared_prefix_len,
+            "seed": spec.seed,
+        },
+        "offered": len(rows),
+        "completed": counts["completed"],
+        "rejected": counts["rejected"],
+        "failed": counts["failed"],
+        "shed": counts["shed"],
+        "duration_s": wall,
+        "slo": {"ttft_s": slo_ttft, "tpot_s": slo_tpot},
+        "good": good,
+        "goodput": good / max(len(rows), 1),
+        "worst_ttft_p99": max(worst_p99) if worst_p99 else None,
+        "ttft": _percentiles([r.get("ttft_s") for r in done_rows]),
+        "tpot": _percentiles([r.get("tpot_s") for r in done_rows]),
+        "latency": _percentiles([r.get("latency_s")
+                                 for r in done_rows]),
+        "offered_curve": curve,
         "per_request": rows,
     }
 
@@ -381,14 +554,24 @@ def _build_scheduler(args):
     params = model.init(jax.random.PRNGKey(1),
                         jnp.zeros((1, 8), jnp.int32))["params"]
     pages_per_slot = model.max_seq_len // args.page_size
-    num_pages = args.num_pages or (args.slots + 4) * pages_per_slot + 1
+    num_pages = args.num_pages or None
+    slots_min = getattr(args, "slots_min", None)
+    slots_max = getattr(args, "slots_max", None)
+    if num_pages is None and slots_min is None and slots_max is None:
+        # Fixed geometry keeps the historic pool size; an elastic
+        # ladder lets the Scheduler size the pool for its widest rung.
+        num_pages = (args.slots + 4) * pages_per_slot + 1
     return Scheduler(model, params, slots=args.slots,
                      page_size=args.page_size,
                      num_pages=num_pages,
                      admission_window=args.slots,
                      strict_no_retrace=False,
                      kv_dtype=args.kv_dtype,
-                     host_tier=args.host_tier)
+                     host_tier=args.host_tier,
+                     slots_min=slots_min,
+                     slots_max=slots_max,
+                     admission_model=getattr(args, "admission_model",
+                                             None))
 
 
 def main(argv=None):
@@ -416,9 +599,27 @@ def main(argv=None):
     parser.add_argument("--layers", type=int, default=6,
                         help="model depth (2 keeps CI fast)")
     parser.add_argument("--scenario", default="open",
-                        choices=("open", "conversation"),
-                        help="open-arrival singles, or multi-turn "
-                        "conversations (the host-tier workload)")
+                        choices=("open", "conversation", "diurnal"),
+                        help="open-arrival singles, multi-turn "
+                        "conversations (the host-tier workload), or a "
+                        "sinusoidal-ramp offered rate (the autoscale "
+                        "A/B workload)")
+    parser.add_argument("--rate-lo", type=float, default=2.0,
+                        help="diurnal trough arrivals/sec")
+    parser.add_argument("--rate-hi", type=float, default=16.0,
+                        help="diurnal crest arrivals/sec")
+    parser.add_argument("--segments", type=int, default=6)
+    parser.add_argument("--segment-seconds", type=float, default=2.0)
+    parser.add_argument("--slots-min", type=int, default=None,
+                        help="elastic ladder floor (enables graftflex "
+                        "autoscaling; default: CLOUD_TPU_SERVE_"
+                        "SLOTS_MIN)")
+    parser.add_argument("--slots-max", type=int, default=None,
+                        help="elastic ladder ceiling (default: "
+                        "CLOUD_TPU_SERVE_SLOTS_MAX)")
+    parser.add_argument("--admission-model", default=None,
+                        help="fitted admission model JSON (default: "
+                        "CLOUD_TPU_SERVE_ADMISSION_MODEL)")
     parser.add_argument("--conversations", type=int, default=4)
     parser.add_argument("--turns", type=int, default=3)
     parser.add_argument("--user-tokens", type=int, default=8)
@@ -445,6 +646,8 @@ def main(argv=None):
     scheduler.start()
     if args.scenario == "conversation":
         return _main_conversation(args, scheduler)
+    if args.scenario == "diurnal":
+        return _main_diurnal(args, scheduler)
     rates = args.rate or [8.0]
     specs = [LoadSpec(rate=rate, n_requests=args.requests,
                       process=args.process,
@@ -539,6 +742,77 @@ def _main_conversation(args, scheduler):
             "prefix_hit_rate": stats["prefix_hit_rate"],
             "kv": stats["kv"],
             "leaked_pages": leaked,
+        },
+    }
+    out_path = os.path.join(args.out_dir, "loadgen_report.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    print("[loadgen] wrote {}".format(out_path))
+    return 0
+
+
+def _main_diurnal(args, scheduler):
+    """Diurnal-scenario driver: warm every bucket the per-segment
+    request populations will hit (plus the resize ladder, which
+    warmup() walks on its own when one is configured), run the ramp,
+    and report the goodput-vs-offered curve next to the scheduler's
+    geometry census."""
+    from cloud_tpu.serving import reqtrace
+    spec = DiurnalSpec(
+        rate_lo=args.rate_lo, rate_hi=args.rate_hi,
+        segments=args.segments, segment_s=args.segment_seconds,
+        process=args.process, burstiness=args.burstiness,
+        shared_prefix_ratio=args.shared_prefix_ratio,
+        shared_prefix_len=args.shared_prefix_len, seed=args.seed)
+    try:
+        vocab = scheduler.engine.model.vocab_size
+        max_seq_len = scheduler.engine.max_seq_len
+        all_requests = []
+        for k, rate in enumerate(spec.segment_rates()):
+            seg_spec = LoadSpec(
+                rate=rate,
+                n_requests=max(1, int(round(rate * spec.segment_s))),
+                process=spec.process, burstiness=spec.burstiness,
+                shared_prefix_ratio=spec.shared_prefix_ratio,
+                shared_prefix_len=spec.shared_prefix_len,
+                seed=spec.seed + 101 * k + 1)
+            all_requests.extend(build_requests(seg_spec, vocab,
+                                               max_seq_len))
+        buckets = sorted({scheduler._bucket(r) for r in all_requests})
+        print("[loadgen] warmup over buckets {} ladder {}".format(
+            buckets, list(scheduler.engine.ladder)))
+        scheduler.warmup(buckets,
+                         sampling_configs=[(("temperature", 0.0),)])
+        print("[loadgen] diurnal {} segments x {:.3g}s, {:.3g} -> "
+              "{:.3g} req/s".format(spec.segments, spec.segment_s,
+                                    spec.rate_lo, spec.rate_hi))
+        run = run_diurnal(scheduler, spec, slo_ttft=args.slo_ttft,
+                          slo_tpot=args.slo_tpot)
+        for seg in run["offered_curve"]:
+            print("[loadgen]   seg {} @ {:.3g} rps: goodput {:.3f}, "
+                  "ttft p99 {}".format(seg["segment"],
+                                       seg["offered_rate"],
+                                       seg["goodput"],
+                                       seg["ttft"]["p99"]))
+        stats = scheduler.stats()
+    finally:
+        scheduler.close()
+        tracer = reqtrace.get()
+        if tracer is not None:
+            tracer.flush()
+    geometry = stats.get("geometry", {})
+    print("[loadgen]   goodput {:.3f}, worst seg ttft p99 {}, resizes "
+          "{}".format(run["goodput"], run["worst_ttft_p99"],
+                      geometry.get("resizes")))
+    report = {
+        "format": "cloud_tpu.loadgen_sweep.v1",
+        "runs": [run],
+        "scheduler_stats": {
+            "queue_wait": stats["queue_wait"],
+            "ttft": stats["ttft"],
+            "prefix_hit_rate": stats["prefix_hit_rate"],
+            "geometry": geometry,
+            "admission_predictor": stats.get("admission_predictor"),
         },
     }
     out_path = os.path.join(args.out_dir, "loadgen_report.json")

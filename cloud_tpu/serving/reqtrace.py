@@ -30,6 +30,19 @@ TTFT into promoted vs device-cache-hit vs re-prefill classes.
 same rid; neither event is a lifecycle boundary, so phase sums
 telescope unchanged.
 
+graftflex (elastic tick geometry) adds a GLOBAL event — emitted with
+``rid=None`` because a resize belongs to the replica, not to any one
+request: ``resize{from, to, reason, tick}`` fires at the tick
+boundary where the slot count moves one ladder rung (``reason`` is
+``grow``/``shrink`` for policy resizes, ``warmup`` for the ladder walk,
+or a caller-supplied tag for forced resizes). A multi-rung forced jump
+emits one event per adjacent step, so the event stream replays the
+exact executable dispatches. ``tick_commit`` events carry a ``slots``
+field stamping the geometry they committed under, which is how
+``collect --serve`` splits occupancy per rung and draws the slot-count
+counter lane; per-request phase sums are untouched (a resize is not a
+lifecycle boundary — in-flight rows migrate bit-identically).
+
 graftstorm (serving chaos) adds mid-lifecycle fault events: a chaos
 injection that hits an in-flight request emits ``slot_fault`` (with the
 taxonomy ``kind`` and the victim slot) followed by ``requeue`` (with

@@ -84,6 +84,22 @@ per-chunk histogram. The decode-gap histogram (commit-to-commit
 interval over active slots) is the p99 this interleave protects —
 tick COMPUTE time alone cannot see a tick loop stalled behind a
 monolithic prefill.
+
+Elastic tick geometry (graftflex): with a slot-count ladder configured
+(`ladder=`, or pow2 rungs derived from `CLOUD_TPU_SERVE_SLOTS_MIN` /
+`CLOUD_TPU_SERVE_SLOTS_MAX`), the tick's batch width follows offered
+load through pre-warmed per-rung executables: a full rung with waiting
+work grows to the next rung at the SAME tick boundary (a slammed
+replica widens instead of shedding), a rung whose live set fits the
+next rung down shrinks after `resize_quiet_ticks` consecutive quiet
+boundaries (hysteresis — oscillating load never flaps). Page tables
+are pool-indexed, so a resize gathers slot ROWS only (rng schedules,
+eos latches, spec state ride along bit-identical); KV pages never
+move, and warmup walks every rung so steady state stays at zero new
+traces. Every per-tick stat stamps its geometry, and the admission
+predictor can be replaced by an offline model fit from the reqtrace
+corpus (`python -m cloud_tpu.serving.admission fit`, loaded via
+`CLOUD_TPU_SERVE_ADMISSION_MODEL` at start()).
 """
 
 import collections
@@ -303,11 +319,45 @@ class Scheduler:
                  prefix_cache=True, prefix_cache_pages=None,
                  draft_model=None, draft_params=None, spec_k=0,
                  slo_ttft=None, shed_policy=None, prefill_chunk=None,
-                 kv_dtype=None, host_tier=None, host_tier_pages=None):
+                 kv_dtype=None, host_tier=None, host_tier_pages=None,
+                 ladder=None, slots_min=None, slots_max=None,
+                 resize_quiet_ticks=32, admission_model=None):
+        # -- graftflex: elastic tick geometry -------------------------
+        # The ladder is the pow2 set of pre-warmed slot counts the tick
+        # may resize between. Explicit `ladder=` wins; otherwise the
+        # CLOUD_TPU_SERVE_SLOTS_MIN/_MAX knobs (or ctor args) derive
+        # the pow2 rungs in [min, max]; otherwise the geometry is fixed
+        # at `slots` (exactly the pre-graftflex engine).
+        if slots_min is None:
+            env = os.environ.get("CLOUD_TPU_SERVE_SLOTS_MIN",
+                                 "").strip()
+            slots_min = int(env) if env else None
+        if slots_max is None:
+            env = os.environ.get("CLOUD_TPU_SERVE_SLOTS_MAX",
+                                 "").strip()
+            slots_max = int(env) if env else None
+        if ladder is None and (slots_min is not None
+                               or slots_max is not None):
+            lo = int(slots_min if slots_min is not None else 1)
+            hi = int(slots_max if slots_max is not None
+                     else max(slots, lo))
+            if lo < 1 or hi < lo:
+                raise ValueError(
+                    "need 1 <= slots_min <= slots_max; got min={} "
+                    "max={}.".format(lo, hi))
+            rungs, w = set(), 1
+            while w <= hi:
+                if w >= lo:
+                    rungs.add(w)
+                w *= 2
+            ladder = tuple(sorted(rungs | {int(slots)}))
         if num_pages is None:
-            # Default: every slot can hold a full-length sequence, plus
-            # scratch — paging then bounds fragmentation, not memory.
-            num_pages = slots * (model.max_seq_len // page_size) + 1
+            # Default: every slot of the WIDEST rung can hold a
+            # full-length sequence, plus scratch — paging then bounds
+            # fragmentation, not memory, and a grow never needs new
+            # pages (the pool serves every geometry).
+            widest = max(ladder) if ladder else slots
+            num_pages = widest * (model.max_seq_len // page_size) + 1
         # -- graftpack: KV page dtype + host page tier ----------------
         if kv_dtype is None:
             kv_dtype = os.environ.get("CLOUD_TPU_SERVE_KV_DTYPE",
@@ -338,7 +388,8 @@ class Scheduler:
                                    num_pages, max_new_cap=max_new_cap,
                                    draft_model=draft_model,
                                    draft_params=draft_params,
-                                   spec_k=spec_k, page_dtype=kv_dtype)
+                                   spec_k=spec_k, page_dtype=kv_dtype,
+                                   ladder=ladder)
         self.pool = PagePool(num_pages, page_size,
                              self.engine.pages_per_slot,
                              page_dtype=kv_dtype,
@@ -457,6 +508,42 @@ class Scheduler:
         # stalled behind a monolithic prefill).
         self._prefill_chunk_hist = Histogram("prefill_chunk")
         self._decode_gap_hist = Histogram("decode_gap")
+        # -- graftflex: resize policy + per-geometry stats ------------
+        # Hysteresis: grow fires eagerly (full rung + waiting work at a
+        # tick boundary); shrink only after this many consecutive quiet
+        # boundaries, so oscillating load never flaps the geometry.
+        self._resize_quiet_ticks = int(resize_quiet_ticks)
+        if self._resize_quiet_ticks < 1:
+            raise ValueError("resize_quiet_ticks must be >= 1; got "
+                             "{}.".format(resize_quiet_ticks))
+        self._quiet_ticks = 0
+        self._resize_counts = {"grow": 0, "shrink": 0}
+        self._resize_events = []
+        # (new_slots, reason) queued for the tick thread's next
+        # boundary — the warmup ladder walk and tests use this hook;
+        # the load-adaptive policy calls the same machinery.
+        self._requested_resize = None
+        # Per-geometry rollups: every per-tick stat stamps the rung it
+        # ran under, so A/B comparisons never mix widths silently.
+        self._geom_stats = {}
+        # -- graftflex: learned admission predictor -------------------
+        self._admission_model_path = admission_model
+        self._admission_model = None
+        self._admission_model_error = None
+        self._admission_model_hits = 0
+
+    def _geom(self, slots=None):
+        """The per-geometry stats record for `slots` (default: the
+        current rung), created on first touch."""
+        slots = int(self.engine.slots if slots is None else slots)
+        g = self._geom_stats.get(slots)
+        if g is None:
+            from cloud_tpu.monitoring.telemetry import Histogram
+            g = {"ticks": 0, "active_sum": 0,
+                 "tick_hist": Histogram("tick_latency_g%d" % slots),
+                 "decode_gap_hist": Histogram("decode_gap_g%d" % slots)}
+            self._geom_stats[slots] = g
+        return g
 
     # -- lifecycle ----------------------------------------------------
 
@@ -465,6 +552,7 @@ class Scheduler:
             return self
         self._started = True
         self._trace = reqtrace.maybe_enable()
+        self._load_admission_model()
         self._t_start = time.monotonic()
         self._prefill_thread = threading.Thread(
             target=self._prefill_loop, name="graftserve-prefill",
@@ -496,6 +584,167 @@ class Scheduler:
 
     def __exit__(self, *exc):
         self.close()
+
+    def _load_admission_model(self):
+        """Loads the offline-fit admission predictor (ctor arg, else
+        `CLOUD_TPU_SERVE_ADMISSION_MODEL`). Absent or unreadable models
+        fall back to the live-histogram heuristic — the predictor is an
+        accuracy upgrade, never an availability dependency."""
+        path = self._admission_model_path
+        if path is None:
+            path = os.environ.get("CLOUD_TPU_SERVE_ADMISSION_MODEL",
+                                  "").strip() or None
+        if not path:
+            return
+        self._admission_model_path = path
+        from cloud_tpu.serving import admission
+        try:
+            self._admission_model = admission.load_model(path)
+        except (OSError, ValueError, KeyError) as exc:
+            self._admission_model = None
+            self._admission_model_error = "{}: {}".format(
+                type(exc).__name__, exc)
+
+    # -- graftflex: elastic tick geometry -----------------------------
+
+    @staticmethod
+    def resize_decision(ladder, slots, active, waiting, quiet_ticks,
+                        quiet_threshold):
+        """Pure hysteresis policy, one call per tick boundary. Returns
+        `(target_rung_or_None, quiet_ticks')`.
+
+        GROW (eager, the high watermark): the current rung is full AND
+        work is waiting — a slammed replica widens instead of shedding,
+        immediately. SHRINK (lazy): the active set fits the next rung
+        down and nothing waits, for `quiet_threshold` CONSECUTIVE
+        boundaries — any burst in between resets the counter, so
+        oscillating load holds the wide geometry instead of flapping.
+        """
+        idx = ladder.index(slots)
+        if waiting > 0 and active >= slots and idx + 1 < len(ladder):
+            return ladder[idx + 1], 0
+        if idx > 0 and waiting == 0 and active <= ladder[idx - 1]:
+            quiet_ticks += 1
+            if quiet_ticks >= quiet_threshold:
+                return ladder[idx - 1], 0
+            return None, quiet_ticks
+        return None, 0
+
+    def request_resize(self, new_slots, reason="manual", wait=True,
+                       timeout=60.0):
+        """Queues a resize to ladder rung `new_slots` for the tick
+        thread's next boundary (resizes NEVER happen mid-tick). The
+        warmup ladder walk and tests drive this; live traffic resizes
+        through the same `_resize_to` via the hysteresis policy. With
+        `wait`, blocks until the engine reports the new geometry."""
+        new_slots = int(new_slots)
+        if new_slots not in self.engine.ladder:
+            raise ValueError(
+                "resize target {} is not a ladder rung {}.".format(
+                    new_slots, self.engine.ladder))
+        self._requested_resize = (new_slots, reason)
+        self._wake.set()
+        if not wait:
+            return
+        deadline = time.monotonic() + timeout
+        while self.engine.slots != new_slots:
+            if self._failure is not None:
+                raise self._failure
+            if self._stop.is_set():
+                raise RuntimeError("scheduler closed during resize")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    "resize to {} slots not applied within {}s".format(
+                        new_slots, timeout))
+            time.sleep(0.002)
+
+    def _maybe_resize(self):
+        """Tick-boundary resize hook (tick thread only). Forced
+        requests (warmup walk, tests) apply first — retried until the
+        occupancy fits; then the hysteresis policy reads live
+        occupancy + waiting-work depth. Policy resizes are disabled
+        during warmup so the ladder walk owns the geometry."""
+        forced = self._requested_resize
+        if forced is not None:
+            target, reason = forced
+            if (target == self.engine.slots
+                    or self._resize_to(target, reason)):
+                self._requested_resize = None
+            return
+        if len(self.engine.ladder) <= 1 or self._trace_suppress:
+            return
+        active = sum(s is not None for s in self._slots)
+        # _pending_inserts counts admitted-but-not-resident requests
+        # (it decrements at insert), so queue depth + pending is the
+        # work a wider tick could be serving right now.
+        waiting = self._admit_q.qsize() + self._pending_inserts
+        target, self._quiet_ticks = self.resize_decision(
+            self.engine.ladder, self.engine.slots, active, waiting,
+            self._quiet_ticks, self._resize_quiet_ticks)
+        if target is not None:
+            self._resize_to(
+                target,
+                "grow" if target > self.engine.slots else "shrink")
+
+    def _resize_to(self, new_slots, reason):
+        """Moves the geometry to `new_slots` one ADJACENT rung at a
+        time. Only adjacent (old, new) pairs are pre-warmed by the
+        ladder walk — the policy never jumps rungs, so warming the
+        O(n^2) pair matrix for the sake of manual/forced jumps would
+        buy nothing but compile time. Decomposing keeps every forced
+        jump on warmed executables too. Returns False when the live
+        set does not fit `new_slots` (the caller retries after
+        drains); occupancy cannot change between steps because the
+        whole walk runs inside one tick boundary on the tick thread."""
+        ladder = self.engine.ladder
+        while self.engine.slots != new_slots:
+            idx = ladder.index(self.engine.slots)
+            step = (ladder[idx + 1] if new_slots > self.engine.slots
+                    else ladder[idx - 1])
+            if not self._resize_step(step, reason):
+                return False
+        return True
+
+    def _resize_step(self, new_slots, reason):
+        """Applies one resize at the current tick boundary: in-flight
+        slots migrate (grow keeps indices; shrink compacts the live
+        rows into the low indices), the engine gathers the geometry-
+        bound rows under the same perm (bit-identity: rng schedules,
+        eos latches, spec state ride along), and the pool is untouched
+        — pages never move. Returns False when the live set does not
+        fit `new_slots` (the caller retries after drains)."""
+        old = self.engine.slots
+        occupied = [i for i, s in enumerate(self._slots)
+                    if s is not None]
+        if len(occupied) > new_slots:
+            return False
+        if new_slots >= old:
+            perm = list(range(old)) + [-1] * (new_slots - old)
+        else:
+            perm = occupied + [-1] * (new_slots - len(occupied))
+        self.engine.resize(new_slots, perm)
+        states = self._slots
+        self._slots = [states[p] if p >= 0 else None for p in perm]
+        self._free_slots = [i for i, s in enumerate(self._slots)
+                            if s is None]
+        direction = "grow" if new_slots > old else "shrink"
+        self._resize_counts[direction] += 1
+        self._quiet_ticks = 0
+        # Decode gaps never straddle a geometry change — the next
+        # commit starts a fresh interval stamped with the new rung.
+        self._t_last_commit = None
+        event = {"from": old, "to": new_slots, "reason": reason,
+                 "tick": self._ticks}
+        self._resize_events.append(event)
+        trace = self._trace
+        if trace is not None and not self._trace_suppress:
+            trace.emit(None, "resize", **event)
+        reg = _registry()
+        if reg is not None:
+            from cloud_tpu.monitoring import telemetry
+            reg.counter(telemetry.SERVE_RESIZES_TOTAL % direction).inc()
+            reg.gauge(telemetry.SERVE_SLOT_COUNT).set(new_slots)
+        return True
 
     # -- submission ---------------------------------------------------
 
@@ -707,9 +956,32 @@ class Scheduler:
         the always-on host histogram) + expected page-reservation wait
         (reserve-wait p95) when the pool cannot satisfy it right now.
         All inputs are live histograms, so the estimate tracks the
-        current regime instead of a configured constant."""
+        current regime instead of a configured constant — unless a
+        graftflex admission model is loaded, in which case the offline
+        per-phase quantile regressions (fit on the reqtrace corpus's
+        exact ground truth) replace the histogram percentiles, with
+        the live histograms as fallback for any phase the model cannot
+        cover."""
         now = time.monotonic() if now is None else now
         accrued = max(now - t_submit, 0.0)
+        model = self._admission_model
+        if model is not None:
+            pool_short = False
+            if request.max_new_tokens > 1:
+                need = self.pool.pages_needed(
+                    len(request.prompt), request.max_new_tokens,
+                    slack=self._spec_slack())
+                pool_short = self.pool.available() < need
+            predicted = model.predict_ttft(
+                accrued=accrued, position=position,
+                bucket=self._bucket(request),
+                prompt_len=len(request.prompt),
+                n_chunks=(self._n_chunks(len(request.prompt))
+                          if self._prefill_chunk is not None else None),
+                pool_short=pool_short)
+            if predicted is not None:
+                self._admission_model_hits += 1
+                return predicted
         if self._prefill_chunk is not None:
             # Chunk granularity: the candidate costs n_chunks chunk
             # dispatches, interleaved one per tick, and each request
@@ -1098,6 +1370,9 @@ class Scheduler:
         if n_active <= 0:
             return
         self._decode_gap_hist.observe(gap, count=n_active)
+        # Geometry stamp: the same gap also lands in the current
+        # rung's histogram, so A/B reads never mix widths silently.
+        self._geom()["decode_gap_hist"].observe(gap, count=n_active)
         reg = _registry()
         if reg is not None:
             from cloud_tpu.monitoring import telemetry
@@ -1282,6 +1557,9 @@ class Scheduler:
                     watch.heartbeat()
                     watch.check()
                 self._chaos_pre_tick()
+                # Tick boundary: the only point the geometry may move —
+                # never mid-tick, never from another thread.
+                self._maybe_resize()
                 stepped = self._step_chunks()
                 self._insert_ready()
                 if not any(s is not None for s in self._slots):
@@ -1778,11 +2056,21 @@ class Scheduler:
         n_active = sum(s is not None for s in self._slots)
         if n_active:
             self._token_hist.observe(elapsed, count=n_active)
+            # Geometry stamp: tick latency and occupancy roll up under
+            # the rung this tick RAN at (kernel_costs() is likewise
+            # keyed per geometry), never a mixed aggregate.
+            g = self._geom()
+            g["ticks"] += 1
+            g["active_sum"] += n_active
+            g["tick_hist"].observe(elapsed)
             reg = _registry()
             if reg is not None:
                 from cloud_tpu.monitoring import telemetry
                 reg.histogram(telemetry.SERVE_TOKEN_HISTOGRAM).observe(
                     elapsed, count=n_active)
+                reg.histogram(
+                    telemetry.SERVE_TICK_SECONDS
+                    % self.engine.slots).observe(elapsed)
                 # Kernel cost rows: one tick's paged-attention flops /
                 # bytes over its measured wall time — pct_peak and
                 # bytes_moved track the fused-kernel A/B alongside the
@@ -1811,7 +2099,8 @@ class Scheduler:
                     trace.emit(state.rid, "tick_commit",
                                tokens_committed=len(state.emitted),
                                active_slots=n_active,
-                               ticks=self._ticks)
+                               ticks=self._ticks,
+                               slots=self.engine.slots)
 
     def _distribute_plain(self, fetched):
         tokens_row, finished_row = fetched[0], fetched[1]
@@ -1929,6 +2218,7 @@ class Scheduler:
         from cloud_tpu.monitoring import telemetry
         reg.gauge(telemetry.SERVE_ACTIVE_SLOTS).set(
             sum(s is not None for s in self._slots))
+        reg.gauge(telemetry.SERVE_SLOT_COUNT).set(self.engine.slots)
         reg.gauge(telemetry.SERVE_QUEUE_DEPTH).set(
             self._admit_q.qsize())
         pstats = self.pool.pool_stats()
@@ -2110,6 +2400,7 @@ class Scheduler:
                 self.host_tier.reset_stats()
             self.trie.clear()
             self.trie.reset_stats()
+        self._warm_ladder(configs[0], max_new)
         self.engine.mark_warm()
         self._trace_suppress = False
         # Warm-up TTFTs are compile times; restart the host-side stats
@@ -2134,7 +2425,49 @@ class Scheduler:
         self._prefix_tokens_served = 0
         self._accepted_draft_tokens = 0
         self._proposed_draft_tokens = 0
+        self._resize_counts = {"grow": 0, "shrink": 0}
+        self._resize_events = []
+        self._quiet_ticks = 0
+        self._geom_stats = {}
+        self._admission_model_hits = 0
         self._t_start = time.monotonic()
+
+    def _warm_ladder(self, cfg, max_new):
+        """graftflex ladder walk: visits every rung (start -> min ->
+        max -> start, one rung per step) so EACH adjacent resize pair
+        compiles in BOTH directions, and runs a small decode wave the
+        first time a rung is visited — tick/insert/evict trace per
+        slot count, so steady-state traffic on any rung, with policy
+        resizes in between, stays at zero new traces. The walk ends
+        back on the starting rung. Prefill executables are dense
+        [1, L] and geometry-free; the main waves already warmed them.
+        """
+        ladder = self.engine.ladder
+        if len(ladder) <= 1:
+            return
+        start = self.engine.slots
+        idx = ladder.index(start)
+        targets = (list(ladder[:idx][::-1])       # start -> min
+                   + list(ladder)                 # min -> max
+                   + list(ladder[idx:-1][::-1]))  # max -> start
+        vocab = self.engine.model.vocab_size
+        visited = {start}
+        combo = 0
+        for rung in targets:
+            if rung == self.engine.slots:
+                continue
+            self.request_resize(rung, reason="warmup", timeout=600)
+            if rung in visited:
+                continue
+            visited.add(rung)
+            futures = []
+            for _ in range(2):
+                first = 2 + combo % max(vocab - 2, 1)
+                combo += 1
+                futures.append(self.submit(ServeRequest(
+                    prompt=[first], max_new_tokens=max_new, **cfg)))
+            for future in futures:
+                future.result(timeout=600)
 
     def _warm_prefix_path(self, cfg):
         """Shared-prefix trio: a miss that registers a page, a mid-page
@@ -2220,6 +2553,33 @@ class Scheduler:
                                  if proposed else 0.0),
             "spec_accepted_tokens": self._accepted_draft_tokens,
             "spec_proposed_tokens": proposed,
+        }
+        # graftflex geometry rollup: the current rung, the ladder, the
+        # resize census, and every per-tick stat split by the geometry
+        # it ran under — the aggregate histograms above stay for
+        # back-compat, but cross-width comparisons must read this.
+        geoms = {}
+        for s, g in sorted(self._geom_stats.items()):
+            geoms[str(s)] = {
+                "ticks": g["ticks"],
+                "occupancy_mean": (g["active_sum"] / g["ticks"]
+                                   if g["ticks"] else 0.0),
+                "tick_latency": g["tick_hist"].snapshot(),
+                "decode_gap": g["decode_gap_hist"].snapshot(),
+                "kernel_costs": self.engine.kernel_costs(s),
+            }
+        out["geometry"] = {
+            "slots": self.engine.slots,
+            "ladder": list(self.engine.ladder),
+            "resizes": dict(self._resize_counts),
+            "resize_events": list(self._resize_events),
+            "per_geometry": geoms,
+        }
+        out["admission_predictor"] = {
+            "loaded": self._admission_model is not None,
+            "path": self._admission_model_path,
+            "error": self._admission_model_error,
+            "predictions": self._admission_model_hits,
         }
         # graftpack KV hierarchy rollup: dtype-aware byte accounting
         # plus the demote/promote census, mirrored from the host tier.

@@ -98,6 +98,25 @@ offload (ISSUE 17, graftpack) — multi-turn conversations served three
       mismatch) is refused as a typed `host_tier_corrupt` fault that
       falls back to re-prefill with the result still exact.
 
+autoscale (ISSUE 18, graftflex) — one diurnal (sinusoidal-ramp)
+  open-arrival run served twice under the same TTFT SLO: a
+  fixed-capacity replica pinned at the ladder's LOW rung, then an
+  elastic replica autoscaling across the pow2 ladder (the fixed leg
+  also feeds the reqtrace corpus an admission model is fit from;
+  the elastic leg loads it):
+  16. GOODPUT — the elastic leg's SLO goodput must be >=
+      MIN_AUTOSCALE_GOODPUT (1.5x) the fixed leg's at equal worst-case
+      TTFT p99 (worst per-segment p99 within AUTOSCALE_P99_FACTOR of
+      the fixed leg's): the narrow replica sheds at the crest where
+      the elastic one widens instead.
+  17. The elastic leg fires >= 1 grow AND >= 1 shrink resize (the ramp
+      actually drove the policy both directions), the fixed leg fires
+      none, every completed request on BOTH legs is bit-identical to
+      solo generate() (resizes migrate in-flight rng schedules/eos
+      latches exactly), and zero post-warmup traces/compiles on either
+      leg — the warmup ladder walk pre-warms every rung's tick/insert/
+      evict and every adjacent resize pair.
+
 Relative gating (ISSUE 16): every performance gate above is an A/B
 ratio of two legs run back-to-back in the same process on the same
 rig, so load noise hits both legs alike. Even so, CI containers
@@ -129,6 +148,10 @@ CHUNK_SIZE = 16
 MIN_KVQ_CAPACITY_RATIO = 2.0
 MAX_OFFLOAD_HIT_FACTOR = 1.5
 MIN_OFFLOAD_REPREFILL_RATIO = 3.0
+MIN_AUTOSCALE_GOODPUT = 1.5
+AUTOSCALE_P99_FACTOR = 1.5
+AUTOSCALE_RATE_HI = 28.0
+AUTOSCALE_SLO_MULT = 5.0
 # Below this fraction of an advertised floor a missed ratio is a hard
 # failure (the A/B direction itself is in doubt); between the two it
 # only warns. Override: CLOUD_TPU_SMOKE_HARD_FRACTION.
@@ -1314,13 +1337,220 @@ def run_offload(args):
     return _check(failures, "offload", warnings)
 
 
+def run_autoscale(args):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from cloud_tpu.parallel import runtime
+    from cloud_tpu.serving import Scheduler, admission, reqtrace
+    from cloud_tpu.serving.loadgen import (DiurnalSpec, build_diurnal,
+                                           run_diurnal)
+
+    slots_lo = args.autoscale_slots_min
+    slots_hi = args.autoscale_slots_max
+    model = build_model()
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    # Heavier decodes than the LoadSpec defaults: a request holds its
+    # slot ~16 ticks, so the fixed leg's capacity sits BELOW the crest
+    # rate on any rig speed — the contrast the A/B measures is the
+    # geometry, not rig luck. Prefix sharing keeps the resize+gather
+    # composition under live fire.
+    spec = DiurnalSpec(rate_lo=2.0, rate_hi=args.autoscale_rate_hi,
+                       segments=5, segment_s=1.5,
+                       max_new_lo=8, max_new_hi=24,
+                       shared_prefix_ratio=0.3, seed=7)
+    entries = build_diurnal(spec, model.vocab_size, model.max_seq_len)
+    requests = [e[2] for e in entries]
+    print("[smoke:autoscale] solo oracle ({} requests, {} segments)"
+          .format(len(requests), spec.segments))
+    oracle = solo_oracle(model, params, requests)
+
+    # The fixed leg's reqtrace feeds the admission fit; both legs' re-
+    # size events land in the same artifact for collect --serve.
+    os.environ.setdefault("CLOUD_TPU_REQTRACE", "1")
+    os.environ.setdefault("CLOUD_TPU_REQTRACE_DIR", args.out_dir)
+    pages_per_slot = model.max_seq_len // 16
+
+    def _leg(tag, slo, **kwargs):
+        """One A/B leg. `slo=None` calibrates the TTFT SLO on THIS
+        warmed, idle leg — the median of three unloaded probes times
+        AUTOSCALE_SLO_MULT — so the gate tracks the rig's actual speed
+        instead of a wall-clock constant (CI containers vary 10x). The
+        fixed leg calibrates; the elastic leg reuses its SLO, so both
+        legs are scored against the identical target."""
+        scheduler = Scheduler(model, params, page_size=16,
+                              admission_window=slots_hi,
+                              strict_no_retrace=True,
+                              **kwargs).start()
+        try:
+            print("[smoke:autoscale] {} leg warmup (ladder {})".format(
+                tag, list(scheduler.engine.ladder)))
+            scheduler.warmup(sorted({scheduler._bucket(r)
+                                     for r in requests}),
+                             sampling_configs=[(("temperature",
+                                                 0.0),)])
+            if slo is None:
+                probes = []
+                for j in range(3):
+                    probe = dataclasses.replace(requests[0],
+                                                rng_seed=9000 + j)
+                    res = scheduler.submit(
+                        probe, timeout=30).result(timeout=120)
+                    probes.append(res.ttft_s)
+                slo = args.autoscale_slo_mult * sorted(probes)[1]
+                print("[smoke:autoscale] calibrated slo_ttft "
+                      "{:.4f}s ({}x unloaded ttft {:.4f}s)".format(
+                          slo, args.autoscale_slo_mult,
+                          sorted(probes)[1]))
+            # Arm the shed-admission gate (and the learned predictor,
+            # when loaded) with the calibrated SLO.
+            scheduler._slo_ttft = slo
+            warm = runtime.compile_stats()
+            print("[smoke:autoscale] {} leg serve pass".format(tag))
+            run = run_diurnal(scheduler, spec, slo_ttft=slo,
+                              keep_tokens=True)
+            after = runtime.compile_stats()
+            stats = scheduler.stats()
+        finally:
+            scheduler.close()
+        mismatches = [r["i"] for r in run["per_request"]
+                      if r.get("tokens") is not None
+                      and r["tokens"] != [int(t)
+                                          for t in oracle[r["i"]]]]
+        return {
+            "slo_ttft_s": slo,
+            "goodput": run["goodput"],
+            "good": run["good"],
+            "offered": run["offered"],
+            "completed": run["completed"],
+            "shed": run["shed"],
+            "rejected": run["rejected"],
+            "worst_ttft_p99": run["worst_ttft_p99"],
+            "offered_curve": [
+                {k: v for k, v in seg.items()}
+                for seg in run["offered_curve"]],
+            "resizes": stats["geometry"]["resizes"],
+            "resize_events": stats["geometry"]["resize_events"],
+            "per_geometry": {
+                rung: {"ticks": g["ticks"],
+                       "occupancy_mean": g["occupancy_mean"]}
+                for rung, g in stats["geometry"]["per_geometry"]
+                .items()},
+            "admission_predictor": stats["admission_predictor"],
+            "mismatched_requests": mismatches,
+            "new_traces_post_warmup": (after["n_traces"]
+                                       - warm["n_traces"]),
+            "new_compiles_post_warmup": (after["n_compiles"]
+                                         - warm["n_compiles"]),
+        }
+
+    slo = args.autoscale_slo_ttft or None  # 0 = calibrate on the rig
+    fixed = _leg("fixed", slo, slots=slots_lo,
+                 num_pages=(slots_lo + 4) * pages_per_slot + 1)
+    slo = fixed["slo_ttft_s"]
+
+    # Fit the admission predictor from the corpus the fixed leg just
+    # wrote; the elastic leg loads it at start() — the full offline
+    # fit -> serve-time predict loop inside one smoke run.
+    model_path = None
+    tracer = reqtrace.get()
+    if tracer is not None:
+        tracer.flush()
+        try:
+            doc = admission.fit([tracer.path])
+            model_path = os.path.join(args.out_dir,
+                                      "admission_model.json")
+            with open(model_path, "w") as fh:
+                json.dump(doc, fh, indent=2, sort_keys=True)
+            print("[smoke:autoscale] fit admission model: phases {}"
+                  .format(sorted(doc["phases"])))
+        except ValueError as exc:
+            print("[smoke:autoscale] admission fit skipped: "
+                  "{}".format(exc))
+
+    auto = _leg("auto", slo, slots=slots_lo, slots_min=slots_lo,
+                slots_max=slots_hi,
+                num_pages=(slots_hi + 4) * pages_per_slot + 1,
+                resize_quiet_ticks=args.autoscale_quiet_ticks,
+                admission_model=model_path)
+
+    goodput_ratio = auto["goodput"] / max(fixed["goodput"], 1e-9)
+    p99_parity = None
+    if auto["worst_ttft_p99"] and fixed["worst_ttft_p99"]:
+        p99_parity = fixed["worst_ttft_p99"] / auto["worst_ttft_p99"]
+    summary = {
+        "spec": {"rate_lo": spec.rate_lo, "rate_hi": spec.rate_hi,
+                 "segments": spec.segments,
+                 "segment_s": spec.segment_s, "seed": spec.seed,
+                 "slo_ttft_s": slo},
+        "ladder": {"min": slots_lo, "max": slots_hi},
+        "fixed": fixed,
+        "auto": auto,
+        "goodput_ratio": goodput_ratio,
+        "min_goodput_ratio": args.min_autoscale_goodput,
+        "worst_p99_parity": p99_parity,
+        "p99_factor": args.autoscale_p99_factor,
+        "admission_model": model_path,
+    }
+    _write_summary(args.out_dir, "serving_smoke_autoscale.json",
+                   summary)
+
+    print("[smoke:autoscale] goodput fixed {:.3f} vs auto {:.3f} "
+          "({:.2f}x, floor {:.1f}x)".format(
+              fixed["goodput"], auto["goodput"], goodput_ratio,
+              args.min_autoscale_goodput))
+    print("[smoke:autoscale] worst seg ttft p99 fixed {} vs auto {} | "
+          "auto resizes {}".format(fixed["worst_ttft_p99"],
+                                   auto["worst_ttft_p99"],
+                                   auto["resizes"]))
+    failures, warnings = [], []
+    _gate_ratio(failures, warnings, "autoscale goodput",
+                goodput_ratio, args.min_autoscale_goodput)
+    if p99_parity is None:
+        failures.append("worst-case p99 missing on a leg (fixed {}, "
+                        "auto {})".format(fixed["worst_ttft_p99"],
+                                          auto["worst_ttft_p99"]))
+    else:
+        # "At equal worst-case p99": the elastic leg may not buy its
+        # goodput by letting the tail rot — its worst per-segment p99
+        # stays within AUTOSCALE_P99_FACTOR of the fixed leg's.
+        _gate_ratio(failures, warnings, "worst-case p99 parity",
+                    p99_parity, 1.0 / args.autoscale_p99_factor)
+    if auto["resizes"]["grow"] < 1 or auto["resizes"]["shrink"] < 1:
+        failures.append("elastic leg must fire >= 1 grow and >= 1 "
+                        "shrink; got {}".format(auto["resizes"]))
+    if fixed["resizes"]["grow"] or fixed["resizes"]["shrink"]:
+        failures.append("fixed leg resized: {}".format(
+            fixed["resizes"]))
+    for tag, leg in (("fixed", fixed), ("auto", auto)):
+        if leg["mismatched_requests"]:
+            failures.append("{} leg requests {} diverged from solo "
+                            "generate()".format(
+                                tag, leg["mismatched_requests"]))
+        if leg["new_traces_post_warmup"] or \
+                leg["new_compiles_post_warmup"]:
+            failures.append("{} leg retraced after warmup ({} traces,"
+                            " {} compiles)".format(
+                                tag, leg["new_traces_post_warmup"],
+                                leg["new_compiles_post_warmup"]))
+    if model_path is not None and \
+            not auto["admission_predictor"]["loaded"]:
+        failures.append("admission model written but not loaded: "
+                        "{}".format(auto["admission_predictor"]))
+    return _check(failures, "autoscale", warnings)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out-dir", default=os.environ.get(
         "CLOUD_TPU_TELEMETRY_DIR", "serving-smoke-out"))
     parser.add_argument("--scenario", default="base",
                         choices=["base", "prefix", "spec", "chaos",
-                                 "chunked", "kvq", "offload", "all"])
+                                 "chunked", "kvq", "offload",
+                                 "autoscale", "all"])
     parser.add_argument("--slots", type=int, default=8)
     parser.add_argument("--spec-k", type=int, default=3)
     parser.add_argument("--chunk-size", type=int, default=int(
@@ -1354,6 +1584,34 @@ def main(argv=None):
                         default=float(os.environ.get(
                             "CLOUD_TPU_SMOKE_MIN_OFFLOAD_REPREFILL",
                             MIN_OFFLOAD_REPREFILL_RATIO)))
+    parser.add_argument("--min-autoscale-goodput", type=float,
+                        default=float(os.environ.get(
+                            "CLOUD_TPU_SMOKE_MIN_AUTOSCALE_GOODPUT",
+                            MIN_AUTOSCALE_GOODPUT)))
+    parser.add_argument("--autoscale-p99-factor", type=float,
+                        default=float(os.environ.get(
+                            "CLOUD_TPU_SMOKE_AUTOSCALE_P99_FACTOR",
+                            AUTOSCALE_P99_FACTOR)))
+    parser.add_argument("--autoscale-rate-hi", type=float,
+                        default=float(os.environ.get(
+                            "CLOUD_TPU_SMOKE_AUTOSCALE_RATE_HI",
+                            AUTOSCALE_RATE_HI)))
+    parser.add_argument("--autoscale-slots-min", type=int, default=2)
+    parser.add_argument("--autoscale-slots-max", type=int, default=8)
+    parser.add_argument("--autoscale-slo-ttft", type=float,
+                        default=float(os.environ.get(
+                            "CLOUD_TPU_SMOKE_AUTOSCALE_SLO_TTFT",
+                            0.0)))  # 0 = calibrate from unloaded ttft
+    parser.add_argument("--autoscale-slo-mult", type=float,
+                        default=float(os.environ.get(
+                            "CLOUD_TPU_SMOKE_AUTOSCALE_SLO_MULT",
+                            AUTOSCALE_SLO_MULT)))
+    # Low enough that the post-crest ramp-down still shrinks inside
+    # the run, high enough that a one-tick lull at the crest does not
+    # shed a rung it immediately needs back (the re-grow straggler
+    # inflates the worst-segment p99).
+    parser.add_argument("--autoscale-quiet-ticks", type=int,
+                        default=12)
     args = parser.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -1361,9 +1619,10 @@ def main(argv=None):
                  "spec": [run_spec], "chaos": [run_chaos],
                  "chunked": [run_chunked], "kvq": [run_kvq],
                  "offload": [run_offload],
+                 "autoscale": [run_autoscale],
                  "all": [run_base, run_prefix, run_spec, run_chaos,
-                         run_chunked, run_kvq,
-                         run_offload]}[args.scenario]
+                         run_chunked, run_kvq, run_offload,
+                         run_autoscale]}[args.scenario]
     rc = 0
     for scenario in scenarios:
         rc = scenario(args) or rc

@@ -1,0 +1,293 @@
+"""graftflex admission predictor: offline quantile fit from reqtrace.
+
+Three contracts. Fit: the per-phase model recovers the corpus's exact
+per-bucket prefill cost (binned quantile + count-weighted line), skips
+chunked-prefill `prefill` events (their dur_s spans interleaved decode
+ticks — wrong cost basis for the dense path), and survives torn JSONL
+tails. Predict: the arithmetic mirrors the scheduler's histogram
+heuristic phase for phase, and returns None — never a guess — when a
+required phase is missing. Fallback: the scheduler treats an absent or
+malformed model file as "use the histogram heuristic", recording the
+error in stats() instead of raising.
+"""
+
+import json
+import os
+
+import pytest
+
+from cloud_tpu.serving import admission
+
+
+def _line(event, **fields):
+    payload = {"rid": "r000001", "event": event}
+    payload.update(fields)
+    return json.dumps({"time": 0.0, "monotonic": 0.0, "host": "h",
+                       "pid": 1, "process_index": 0,
+                       "kind": "reqtrace", "payload": payload},
+                      sort_keys=True)
+
+
+def _write(path, lines):
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return str(path)
+
+
+class TestFit:
+
+    def test_recovers_linear_bucket_cost(self, tmp_path):
+        # Two buckets, exact costs: the binned-quantile line must pass
+        # through both medians (0.1s @ bucket 8, 0.2s @ bucket 16).
+        path = _write(tmp_path / "t.jsonl", [
+            _line("prefill", bucket=8, prefix_len=0, dur_s=0.1),
+            _line("prefill", bucket=8, prefix_len=0, dur_s=0.1),
+            _line("prefill", bucket=16, prefix_len=0, dur_s=0.2),
+            _line("prefill", bucket=16, prefix_len=0, dur_s=0.2),
+        ])
+        doc = admission.fit([path])
+        model = admission.AdmissionModel(doc)
+        assert model._prefill_s(8) == pytest.approx(0.1)
+        assert model._prefill_s(16) == pytest.approx(0.2)
+        assert model._prefill_s(32) == pytest.approx(0.4)  # extrapolates
+
+    def test_single_bucket_pins_slope_flat(self, tmp_path):
+        path = _write(tmp_path / "t.jsonl", [
+            _line("prefill", bucket=8, dur_s=0.3),
+            _line("prefill", bucket=8, dur_s=0.1),
+            _line("prefill", bucket=8, dur_s=0.2),
+        ])
+        model = admission.AdmissionModel(admission.fit([path]))
+        phase = model.phases["prefill"]
+        assert phase["weights"][1] == 0.0
+        # Flat extrapolation at the single bucket's median.
+        assert model._prefill_s(64) == pytest.approx(0.2)
+
+    def test_chunked_prefill_events_excluded_from_dense_phase(
+            self, tmp_path):
+        path = _write(tmp_path / "t.jsonl", [
+            _line("prefill", bucket=8, dur_s=0.1),
+            # chunks key => dur_s spans interleaved ticks; must not
+            # contaminate the dense prefill fit.
+            _line("prefill", bucket=8, dur_s=9.9, chunks=4),
+            _line("prefill_chunk", i=0, n=4, tokens=8, dur_s=0.05),
+            _line("prefill_chunk", i=1, n=4, tokens=8, dur_s=0.07),
+        ])
+        model = admission.AdmissionModel(admission.fit([path]))
+        assert model._prefill_s(8) == pytest.approx(0.1)
+        assert model._scalar("prefill_chunk") == pytest.approx(0.06)
+
+    def test_token_phase_from_complete_events(self, tmp_path):
+        # (latency - ttft) / (tokens - 1): 0.9/9 and 0.45/9.
+        path = _write(tmp_path / "t.jsonl", [
+            _line("complete", ttft_s=0.1, latency_s=1.0, tokens=10,
+                  prefix_len=0),
+            _line("complete", ttft_s=0.05, latency_s=0.5, tokens=10,
+                  prefix_len=0),
+            _line("complete", ttft_s=0.1, latency_s=0.2, tokens=1,
+                  prefix_len=0),  # single token: no tpot sample
+        ])
+        model = admission.AdmissionModel(admission.fit([path]))
+        assert model.phases["token"]["n"] == 2
+        assert model._scalar("token") == pytest.approx((0.1 + 0.05) / 2)
+
+    def test_reserve_wait_is_pessimistic_quantile(self, tmp_path):
+        waits = [0.01 * i for i in range(100)]
+        path = _write(tmp_path / "t.jsonl",
+                      [_line("pages_reserved", pages=2, wait_s=w)
+                       for w in waits])
+        model = admission.AdmissionModel(admission.fit([path]))
+        assert model.phases["reserve_wait"]["q"] == 0.95
+        assert model._scalar("reserve_wait") > 0.9 * max(waits) * 0.95
+
+    def test_torn_lines_and_foreign_kinds_skipped(self, tmp_path):
+        path = _write(tmp_path / "t.jsonl", [
+            '{"kind": "job_event", "payload": {"event": "prefill"}}',
+            _line("prefill", bucket=8, dur_s=0.1),
+            '{"kind": "reqtrace", "payload": "not-a-dict"}',
+            '{"torn tail',  # crashed writer
+        ])
+        doc = admission.fit([path])
+        assert doc["phases"]["prefill"]["n"] == 1
+
+    def test_fit_raises_on_empty_corpus(self, tmp_path):
+        path = _write(tmp_path / "t.jsonl", ['{"kind": "other"}'])
+        with pytest.raises(ValueError):
+            admission.fit([path])
+
+    def test_directory_without_jsonl_rejected(self, tmp_path):
+        empty = tmp_path / "empty_dir"
+        empty.mkdir()
+        with pytest.raises(ValueError):
+            admission.fit([str(empty)])
+
+    def test_directory_input_collects_jsonl_files(self, tmp_path):
+        _write(tmp_path / "a.jsonl", [_line("prefill", bucket=8,
+                                            dur_s=0.1)])
+        _write(tmp_path / "b.jsonl", [_line("prefill", bucket=16,
+                                            dur_s=0.2)])
+        _write(tmp_path / "ignored.txt", ["junk"])
+        doc = admission.fit([str(tmp_path)])
+        assert doc["fit"]["files"] == ["a.jsonl", "b.jsonl"]
+        assert doc["phases"]["prefill"]["n"] == 2
+
+
+class TestPredict:
+
+    def _model(self, tmp_path, lines):
+        return admission.AdmissionModel(
+            admission.fit([_write(tmp_path / "t.jsonl", lines)]))
+
+    def test_dense_path_mirrors_heuristic_arithmetic(self, tmp_path):
+        model = self._model(tmp_path, [
+            _line("prefill", bucket=8, dur_s=0.1),
+            _line("prefill", bucket=16, dur_s=0.2),
+        ])
+        # accrued + (position + 1) * prefill(bucket)
+        assert model.predict_ttft(
+            accrued=0.5, position=2, bucket=16, prompt_len=13,
+            n_chunks=None, pool_short=False) == pytest.approx(
+                0.5 + 3 * 0.2)
+
+    def test_chunked_path_mirrors_heuristic_arithmetic(self, tmp_path):
+        model = self._model(tmp_path, [
+            _line("prefill_chunk", i=0, n=2, tokens=8, dur_s=0.05),
+            _line("complete", ttft_s=0.0, latency_s=0.09, tokens=10),
+        ])
+        # accrued + position*chunk + n*chunk + (n-1)*token
+        assert model.predict_ttft(
+            accrued=0.1, position=1, bucket=32, prompt_len=24,
+            n_chunks=3, pool_short=False) == pytest.approx(
+                0.1 + 1 * 0.05 + 3 * 0.05 + 2 * 0.01)
+
+    def test_pool_short_adds_reserve_wait(self, tmp_path):
+        model = self._model(tmp_path, [
+            _line("prefill", bucket=8, dur_s=0.1),
+            _line("pages_reserved", pages=1, wait_s=0.4),
+        ])
+        base = model.predict_ttft(accrued=0.0, position=0, bucket=8,
+                                  prompt_len=4, n_chunks=None,
+                                  pool_short=False)
+        short = model.predict_ttft(accrued=0.0, position=0, bucket=8,
+                                   prompt_len=4, n_chunks=None,
+                                   pool_short=True)
+        assert short == pytest.approx(base + 0.4)
+
+    def test_missing_phase_returns_none_never_guesses(self, tmp_path):
+        chunk_only = self._model(tmp_path, [
+            _line("prefill_chunk", i=0, n=1, tokens=8, dur_s=0.05)])
+        assert chunk_only.predict_ttft(
+            accrued=0.0, position=0, bucket=8, prompt_len=4,
+            n_chunks=None, pool_short=False) is None  # dense needs prefill
+        dense_only = self._model(tmp_path, [
+            _line("prefill", bucket=8, dur_s=0.1)])
+        assert dense_only.predict_ttft(
+            accrued=0.0, position=0, bucket=8, prompt_len=4,
+            n_chunks=2, pool_short=False) is None  # chunked needs chunk
+        # token phase missing on the chunked path defaults to 0, not
+        # None — the chunk cost alone is still a usable estimate.
+        assert chunk_only.predict_ttft(
+            accrued=0.0, position=0, bucket=8, prompt_len=4,
+            n_chunks=2, pool_short=False) == pytest.approx(0.1)
+
+
+class TestLoadAndValidate:
+
+    def test_round_trip_through_file(self, tmp_path):
+        doc = admission.fit([_write(tmp_path / "t.jsonl", [
+            _line("prefill", bucket=8, dur_s=0.1),
+            _line("prefill", bucket=16, dur_s=0.2),
+        ])])
+        out = tmp_path / "model.json"
+        with open(out, "w") as fh:
+            json.dump(doc, fh)
+        model = admission.load_model(str(out))
+        assert model.predict_ttft(
+            accrued=0.0, position=0, bucket=8, prompt_len=4,
+            n_chunks=None, pool_short=False) == pytest.approx(0.1)
+
+    def test_rejects_malformed_documents(self, tmp_path):
+        with pytest.raises(ValueError):
+            admission.AdmissionModel({"format": "something.else"})
+        with pytest.raises(ValueError):
+            admission.AdmissionModel(
+                {"format": admission.FORMAT, "phases": "nope"})
+        with pytest.raises(ValueError):
+            admission.AdmissionModel(
+                {"format": admission.FORMAT,
+                 "phases": {"prefill": {"kind": "mystery"}}})
+        missing = tmp_path / "absent.json"
+        with pytest.raises(OSError):
+            admission.load_model(str(missing))
+
+    def test_cli_fit_then_show(self, tmp_path, capsys):
+        trace = _write(tmp_path / "t.jsonl", [
+            _line("prefill", bucket=8, dur_s=0.1)])
+        out = str(tmp_path / "model.json")
+        assert admission.main(["fit", "--trace", trace, "--out", out,
+                               "--quiet"]) == 0
+        assert admission.main(["show", "--model", out]) == 0
+        shown = json.loads(capsys.readouterr().out)
+        assert shown["format"] == admission.FORMAT
+        assert "prefill" in shown["phases"]
+
+
+class TestSchedulerFallback:
+    """The predictor is an accuracy upgrade, never an availability
+    dependency: absent/bad model files leave the histogram heuristic in
+    charge and surface the error through stats()."""
+
+    @pytest.fixture(scope="class")
+    def model(self):
+        import jax.numpy as jnp
+
+        from cloud_tpu.models import TransformerLM
+        return TransformerLM(vocab_size=64, num_layers=2, num_heads=2,
+                             d_model=32, d_ff=64, max_seq_len=32,
+                             compute_dtype=jnp.float32)
+
+    @pytest.fixture(scope="class")
+    def params(self, model):
+        import jax
+        import jax.numpy as jnp
+        return model.init(jax.random.PRNGKey(1),
+                          jnp.zeros((1, 4), jnp.int32))["params"]
+
+    def test_missing_model_falls_back(self, model, params, tmp_path):
+        from cloud_tpu.serving import Scheduler
+        sched = Scheduler(model, params, slots=2,
+                          admission_model=str(tmp_path / "absent.json"))
+        sched._load_admission_model()  # start() seam, threads not needed
+        stats = sched.stats()["admission_predictor"]
+        assert not stats["loaded"]
+        assert "FileNotFoundError" in stats["error"]
+        assert stats["predictions"] == 0
+
+    def test_good_model_loads(self, model, params, tmp_path):
+        from cloud_tpu.serving import Scheduler
+        doc = admission.fit([_write(tmp_path / "t.jsonl", [
+            _line("prefill", bucket=8, dur_s=0.1)])])
+        path = tmp_path / "model.json"
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        sched = Scheduler(model, params, slots=2,
+                          admission_model=str(path))
+        sched._load_admission_model()
+        stats = sched.stats()["admission_predictor"]
+        assert stats["loaded"]
+        assert stats["error"] is None
+
+    def test_env_knob_supplies_the_path(self, model, params, tmp_path,
+                                        monkeypatch):
+        from cloud_tpu.serving import Scheduler
+        doc = admission.fit([_write(tmp_path / "t.jsonl", [
+            _line("prefill", bucket=8, dur_s=0.1)])])
+        path = str(tmp_path / "model.json")
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+        monkeypatch.setenv("CLOUD_TPU_SERVE_ADMISSION_MODEL", path)
+        sched = Scheduler(model, params, slots=2)
+        sched._load_admission_model()
+        stats = sched.stats()["admission_predictor"]
+        assert stats["loaded"]
+        assert stats["path"] == path
