@@ -36,6 +36,11 @@ Three ingredients:
      outlives the call (an attribute, a subscript, a declared global,
      or a `.append/.add/.insert` container call) — the escape facts
      GL009 needs to see a donated buffer leak through a helper.
+   - `unread_params`: parameters neither the function nor any
+     resolvable callee they are forwarded to ever reads — the
+     dead-leaf facts GL010 uses to see through helpers at a jit
+     boundary (decreasing fixpoint; unresolvable forwards count as
+     reads).
 """
 
 import ast
@@ -76,7 +81,8 @@ class FunctionSummary:
 
     __slots__ = ("name", "qualname", "module", "node", "ctx",
                  "params", "direct_sync", "calls", "key_params",
-                 "retained_params")
+                 "retained_params", "param_reads", "param_forwards",
+                 "unread_params")
 
     def __init__(self, name, module, node, ctx):
         self.name = name
@@ -98,6 +104,19 @@ class FunctionSummary:
         #: retained past the call; `how` is the human label, via fields
         #: follow the same convention as key_params.
         self.retained_params = {}
+        #: param names with at least one "real" read in the body — any
+        #: Load that is not a plain positional forward into a call
+        #: (attribute access, arithmetic, return, store target, ...).
+        self.param_reads = set()
+        #: param name -> [(call_node, positional_index), ...] for plain
+        #: positional forwards; the only way a param can be consumed
+        #: without a real read.
+        self.param_forwards = {}
+        #: params never read by this function nor (transitively) by any
+        #: resolvable callee they are forwarded to. Computed by a
+        #: decreasing fixpoint in `_fixpoint_unread`; forwards into
+        #: unresolvable callees conservatively count as reads.
+        self.unread_params = set()
 
     def __repr__(self):
         return "FunctionSummary({})".format(self.qualname)
@@ -148,6 +167,7 @@ class ProjectContext:
             self._collect_functions(view)
         self._summarize_direct_facts()
         self._fixpoint_key_and_retain()
+        self._fixpoint_unread()
 
     # -- construction --------------------------------------------------
 
@@ -252,12 +272,23 @@ class ProjectContext:
         for node in ast.walk(summary.node):
             if isinstance(node, ast.Global):
                 global_names.update(node.names)
+        forward_ids = set()  # id() of Name nodes that are plain forwards
         for node in ast.walk(summary.node):
             if isinstance(node, ast.Call):
                 label = rules.HostSyncInJit._host_sync_label(node)
                 if label is not None and summary.direct_sync is None:
                     summary.direct_sync = (label, node.lineno)
                 summary.calls.append(node)
+                # Plain positional forwards: f(p) where p is a param.
+                # A Starred earlier in the arg list breaks positional
+                # mapping, so the whole call is treated as real reads.
+                if not any(isinstance(a, ast.Starred) for a in node.args):
+                    for pos, arg in enumerate(node.args):
+                        if (isinstance(arg, ast.Name)
+                                and arg.id in params):
+                            forward_ids.add(id(arg))
+                            summary.param_forwards.setdefault(
+                                arg.id, []).append((node, pos))
                 # Direct key consumption: jax.random.<fn>(param, ...).
                 if (rules._is_random_call(node.func, view.ctx)
                         and node.args
@@ -296,6 +327,14 @@ class ProjectContext:
                         summary.retained_params.setdefault(
                             value.id,
                             (node.lineno, "global store", None, None))
+        # Second pass so forward_ids is complete: any param occurrence
+        # that is not a plain positional forward is a real read
+        # (Store/Del included — rebinding makes liveness murky, and a
+        # conservative "read" only suppresses a finding).
+        for node in ast.walk(summary.node):
+            if (isinstance(node, ast.Name) and node.id in params
+                    and id(node) not in forward_ids):
+                summary.param_reads.add(node.id)
 
     # -- fixpoint propagation ------------------------------------------
 
@@ -340,6 +379,69 @@ class ProjectContext:
                                     "via {}".format(callee.qualname),
                                     callee, callee_param)
                                 changed = True
+
+    def _fixpoint_unread(self):
+        """Decreasing fixpoint for `unread_params`.
+
+        Start optimistic: every param without a real read is
+        candidate-unread. Each pass flips a candidate to "read" when
+        any of its forwards lands somewhere we cannot prove dead — an
+        unresolvable callee (methods, builtins, other packages), an
+        arity mismatch, or a callee param that is itself read. Only
+        unread->read flips happen, so termination is by monotonicity;
+        self-recursive forwards correctly stay unread.
+        """
+        for view in self.modules.values():
+            for summary in view.functions.values():
+                summary.unread_params = (
+                    set(summary.params) - summary.param_reads)
+        changed = True
+        passes = 0
+        while changed and passes < 20:
+            changed = False
+            passes += 1
+            for view in self.modules.values():
+                for summary in view.functions.values():
+                    for param in list(summary.unread_params):
+                        forwards = summary.param_forwards.get(param, ())
+                        if self._forward_is_read(view, summary, forwards):
+                            summary.unread_params.discard(param)
+                            changed = True
+
+    def _forward_is_read(self, view, summary, forwards):
+        for call, pos in forwards:
+            callee = self.resolve_call(view.ctx, call.func)
+            if callee is None:
+                return True
+            if pos >= len(callee.params):
+                return True
+            if callee.params[pos] not in callee.unread_params:
+                return True
+        return False
+
+    def unread_chain(self, summary, param):
+        """[(qualname, param), ...] from `summary` down through the
+        forwards that keep `param` unread (depth-capped, cycle-safe).
+        Length 1 means the function simply never touches the param."""
+        chain = [(summary.qualname, param)]
+        seen = {(summary.qualname, param)}
+        for _ in range(MAX_CHAIN_DEPTH):
+            nxt = None
+            for call, pos in summary.param_forwards.get(param, ()):
+                callee = self.resolve_call(summary.ctx, call.func)
+                if (callee is not None and pos < len(callee.params)
+                        and callee.params[pos] in callee.unread_params):
+                    nxt = (callee, callee.params[pos])
+                    break
+            if nxt is None:
+                break
+            summary, param = nxt
+            key = (summary.qualname, param)
+            if key in seen:
+                break
+            seen.add(key)
+            chain.append(key)
+        return chain
 
     # -- chain reconstruction ------------------------------------------
 

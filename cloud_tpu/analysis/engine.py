@@ -167,7 +167,7 @@ def check_paths(paths, select=None):
     """Lints files/directories -> (sorted [Finding], files_checked).
 
     All parseable files share ONE `callgraph.ProjectContext`, so the
-    interprocedural rules (GL006-GL009) resolve imports and call
+    interprocedural rules (GL006-GL010) resolve imports and call
     chains across every file in the invocation — linting a package
     directory sees strictly more than linting its files one by one.
     """
@@ -251,5 +251,5 @@ class _LazyRegistry(dict):
         return super().__contains__(key)
 
 
-#: Rule registry: id -> rule instance, in GL001..GL009 order.
+#: Rule registry: id -> rule instance, in GL001..GL013 order.
 RULES = _LazyRegistry()

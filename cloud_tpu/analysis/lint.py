@@ -35,7 +35,7 @@ def _build_parser():
     parser = argparse.ArgumentParser(
         prog="python -m cloud_tpu.analysis.lint",
         description="graftlint: static analysis for JAX/TPU training "
-                    "code (rules GL001-GL009; see --list-rules).")
+                    "code (rules GL001-GL013; see --list-rules).")
     parser.add_argument("paths", nargs="*",
                         help=".py files and/or directories to lint")
     parser.add_argument("--format", choices=("text", "json", "sarif"),

@@ -1,4 +1,4 @@
-"""graftlint rules GL001-GL009.
+"""graftlint rules GL001-GL013.
 
 Every rule is keyed to the runtime counter it predicts (PERF.md has the
 table): the linter is the static half of the transfer/compile
@@ -23,6 +23,19 @@ lint invocation, attached by the engine before rules run — so facts
 propagate through calls: a host sync two helpers below a jit body
 (GL007), a key consumed inside a callee then reused at the call site
 (GL008), a donated buffer retained by an earlier callee (GL009).
+
+The graftseal family (this PR's jit-boundary/threading seal):
+GL010 flags signature leaves a jit boundary carries but never reads
+(the retrace shape the serving prefix-gather hit — dead per-slot
+leaves binding one executable per slot count), using the callgraph's
+`unread_params` to see through helper forwards; GL011 flags call
+sites feeding unhashable values into static_argnums/static_argnames;
+GL012 flags host-side branches and cache keys derived from an
+argument's `.shape`/`.ndim` on a jit call path; GL013 checks lock
+discipline per class — a field written under `with self._lock` in one
+method but touched lock-free in a method reachable from a different
+`threading.Thread` target, with `# graftlint: unlocked-ok` as the
+sanction comment for documented single-writer fields.
 """
 
 import ast
@@ -71,13 +84,17 @@ class JitInfo:
     """What we know about one jit-compiled callable."""
 
     __slots__ = ("static_argnums", "static_argnames", "donate_argnums",
-                 "node")
+                 "node", "bound")
 
     def __init__(self):
         self.static_argnums = set()
         self.static_argnames = set()
         self.donate_argnums = set()
         self.node = None  # the FunctionDef, when known
+        #: True when the wrapped callable was a bound method
+        #: (`jit(self._method)`): the def's `self` is already bound, so
+        #: argnum indices are offset by one against the param list.
+        self.bound = False
 
     @property
     def has_statics(self):
@@ -123,6 +140,15 @@ def _jit_call_info(node):
             info = JitInfo()
             info.absorb_kwargs(node)
             return info, None  # partial(jit, ...) decorates the def below
+    # Immediately-applied partial: `partial(jit, donate_argnums=...)(f)`
+    # wraps f right there (the serving engine's executable-binding
+    # idiom). The inner call must be the bare partial form (wrapped is
+    # None) — `jit(f)(x)` is a dispatch, not a wrap.
+    if isinstance(node.func, ast.Call):
+        inner_info, inner_wrapped = _jit_call_info(node.func)
+        if inner_info is not None and inner_wrapped is None:
+            inner_info.absorb_kwargs(node)
+            return inner_info, node.args[0] if node.args else None
     return None, None
 
 
@@ -143,6 +169,10 @@ class FileContext:
         #: local callable name -> JitInfo (call sites: `g = jax.jit(f)`
         #: assignments AND decorated defs, callable by their own name).
         self.jit_names = {}
+        #: instance attribute name -> JitInfo for the attribute form
+        #: `self.tick = jit(self._tick_impl, ...)`; call sites look
+        #: like `self.tick(...)`.
+        self.jit_attr_names = {}
         #: module-level names bound to mutable literals ({} [] set()).
         self.mutable_globals = set()
         #: axis-name string literals declared by Mesh(...) in this file.
@@ -207,11 +237,32 @@ class FileContext:
             elif isinstance(wrapped, ast.Lambda):
                 info.node = wrapped
                 self.jit_defs[wrapped] = info
+            elif (isinstance(wrapped, ast.Attribute)
+                  and isinstance(wrapped.value, ast.Name)
+                  and wrapped.value.id == "self"):
+                # Bound-method form: `jit(self._tick_impl, ...)` inside
+                # a class. The wrapped def lives on the enclosing
+                # ClassDef; `self` is pre-bound, so argnums shift.
+                method = self._enclosing_class_method(node, wrapped.attr)
+                if method is not None and method not in self.jit_defs:
+                    info.bound = True
+                    info.node = method
+                    self.jit_defs[method] = info
+            # Climb through single-argument wrapper calls
+            # (`best_effort_donation(jit(...))`) to the binding site.
             parent = self.parents.get(node)
+            while (isinstance(parent, ast.Call)
+                   and len(parent.args) == 1 and parent.args[0] is node):
+                node = parent
+                parent = self.parents.get(node)
             if isinstance(parent, ast.Assign):
                 for target in parent.targets:
                     if isinstance(target, ast.Name):
                         self.jit_names[target.id] = info
+                    elif (isinstance(target, ast.Attribute)
+                          and isinstance(target.value, ast.Name)
+                          and target.value.id == "self"):
+                        self.jit_attr_names[target.attr] = info
         # The plain defs that assignment-form jit calls wrapped: their
         # bodies are traced code too.
         if wrapped_names:
@@ -223,6 +274,21 @@ class FileContext:
                     if info.node is None:
                         info.node = node
                     self.jit_defs[node] = info
+
+    def _enclosing_class_method(self, node, name):
+        """The FunctionDef named `name` on the ClassDef lexically
+        containing `node`, or None."""
+        current = self.parents.get(node)
+        while current is not None:
+            if isinstance(current, ast.ClassDef):
+                for stmt in current.body:
+                    if (isinstance(stmt, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and stmt.name == name):
+                        return stmt
+                return None
+            current = self.parents.get(current)
+        return None
 
     def _decorator_jit_info(self, deco):
         name = _terminal_name(deco)
@@ -292,9 +358,13 @@ class FileContext:
         args = def_node.args
         ordered = [a.arg for a in args.posonlyargs + args.args]
         names = set(ordered + [a.arg for a in args.kwonlyargs])
+        # Bound-method wraps (`jit(self._m)`) number argnums against
+        # the bound callable, which excludes the receiver.
+        mapped = (ordered[1:] if info.bound and ordered
+                  and ordered[0] in ("self", "cls") else ordered)
         for index in info.static_argnums:
-            if 0 <= index < len(ordered):
-                names.discard(ordered[index])
+            if 0 <= index < len(mapped):
+                names.discard(mapped[index])
         names -= info.static_argnames
         names.discard("self")
         names.discard("cls")
@@ -875,7 +945,550 @@ class DonationEscape(Rule):
                                          _chain_label(chain)))
 
 
+# -- graftseal rules: jit-boundary signature + lock discipline --------
+
+
+def _ordered_params(def_node, info=None):
+    """Positional parameter names a call site's args map onto, with the
+    bound receiver stripped for `jit(self._method)` wraps."""
+    args = def_node.args
+    ordered = [a.arg for a in args.posonlyargs + args.args]
+    if (info is not None and info.bound and ordered
+            and ordered[0] in ("self", "cls")):
+        return ordered[1:]
+    return ordered
+
+
+class DeadJitSignatureLeaf(Rule):
+    id = "GL010"
+    title = "dead-leaf-in-jit-signature"
+    predicts = "compile_stats().n_traces"
+
+    _PARAM_MSG = ("traced argument `{}` of jit-compiled `{}` is never "
+                  "read by the traced body{}: the leaf still shapes "
+                  "the executable's signature, so every distinct aval "
+                  "it takes mints a fresh compile — drop the argument "
+                  "or mark it static [predicts {} growth]")
+    _LEAF_MSG = ("dict leaf {!r} passed into jit-compiled `{}` is "
+                 "never subscripted by the traced body (it only reads "
+                 "{}): the dead leaf widens the signature and every "
+                 "distinct aval mints a fresh compile — drop it from "
+                 "the call [predicts {} growth]")
+
+    def check(self, ctx):
+        yield from self._dead_params(ctx)
+        yield from self._dead_dict_leaves(ctx)
+
+    # -- whole-argument leaves ----------------------------------------
+
+    def _dead_params(self, ctx):
+        for def_node in ctx.jit_defs:
+            if isinstance(def_node, ast.Lambda):
+                continue
+            traced = ctx.traced_params(def_node)
+            if not traced:
+                continue
+            reads, forwards = self._classify(def_node)
+            for param in sorted(traced):
+                if param.startswith("_") or param in reads:
+                    continue  # `_unused` is the rename-sanction
+                fwd = forwards.get(param)
+                if not fwd:
+                    yield ctx.finding(
+                        def_node, self.id,
+                        self._PARAM_MSG.format(param, def_node.name, "",
+                                               self.predicts))
+                    continue
+                chain = self._dead_forward_chain(ctx, fwd)
+                if chain is not None:
+                    yield ctx.finding(
+                        def_node, self.id,
+                        self._PARAM_MSG.format(
+                            param, def_node.name,
+                            " (forwarded to {}, which never reads "
+                            "it)".format(chain), self.predicts))
+
+    @staticmethod
+    def _classify(def_node):
+        """(reads, forwards) over the def body: params with a real read
+        vs params only forwarded as plain positional call arguments —
+        the same split callgraph.FunctionSummary makes, but usable on
+        methods and nested defs the project call graph skips."""
+        args = def_node.args
+        params = {a.arg for a in (args.posonlyargs + args.args
+                                  + args.kwonlyargs)}
+        forwards = {}
+        forward_ids = set()
+        for node in ast.walk(def_node):
+            if not isinstance(node, ast.Call):
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue  # splats break positional mapping: real reads
+            for pos, arg in enumerate(node.args):
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    forward_ids.add(id(arg))
+                    forwards.setdefault(arg.id, []).append((node, pos))
+        reads = set()
+        for node in ast.walk(def_node):
+            if (isinstance(node, ast.Name) and node.id in params
+                    and id(node) not in forward_ids):
+                reads.add(node.id)
+        return reads, forwards
+
+    @staticmethod
+    def _dead_forward_chain(ctx, forwards):
+        """Qualname label when EVERY forward lands on a callee param
+        the project fixpoint proved unread; None otherwise (method
+        calls and other unresolvable callees count as reads)."""
+        project = ctx.project
+        if project is None:
+            return None
+        labels = []
+        for call, pos in forwards:
+            callee = project.resolve_call(ctx, call.func)
+            if (callee is None or pos >= len(callee.params)
+                    or callee.params[pos] not in callee.unread_params):
+                return None
+            labels.append("{}`{}`".format(
+                "" if not labels else " and ", callee.qualname))
+        return "".join(labels)
+
+    # -- container leaves (the serving prefix-gather shape) ------------
+
+    def _dead_dict_leaves(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            info, label = _jit_callee_info(ctx, node)
+            if info is None or not isinstance(info.node,
+                                              (ast.FunctionDef,
+                                               ast.AsyncFunctionDef)):
+                continue
+            params = _ordered_params(info.node, info)
+            for pos, arg in enumerate(node.args):
+                if not isinstance(arg, ast.Dict) or pos >= len(params):
+                    continue
+                keys = [k.value for k in arg.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+                if len(keys) != len(arg.keys):
+                    continue  # **spread or non-literal keys: opaque
+                param = params[pos]
+                if param not in ctx.traced_params(info.node):
+                    continue
+                used = self._subscripted_keys(ctx, info.node, param)
+                if used is None:
+                    continue  # whole-dict uses: every leaf may be live
+                for key, key_node in zip(keys, arg.keys):
+                    if key not in used:
+                        yield ctx.finding(
+                            key_node, self.id,
+                            self._LEAF_MSG.format(
+                                key, label,
+                                ", ".join(sorted(used)) or "nothing",
+                                self.predicts))
+
+    @staticmethod
+    def _subscripted_keys(ctx, def_node, param):
+        """The set of literal keys `param` is subscripted with inside
+        the def, or None when any use is not a literal subscript (the
+        dict then escapes whole and no leaf is provably dead)."""
+        used = set()
+        for node in ast.walk(def_node):
+            if not (isinstance(node, ast.Name) and node.id == param
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            parent = ctx.parents.get(node)
+            if (isinstance(parent, ast.Subscript)
+                    and parent.value is node
+                    and isinstance(parent.slice, ast.Constant)
+                    and isinstance(parent.slice.value, str)):
+                used.add(parent.slice.value)
+            else:
+                return None
+        return used
+
+
+def _jit_callee_info(ctx, call):
+    """(JitInfo, human label) when `call` dispatches into a known jit
+    callable — `tick(...)` via jit_names or `self.tick(...)` via the
+    attribute form — else (None, None)."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in ctx.jit_names:
+        return ctx.jit_names[func.id], func.id
+    if (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+            and func.attr in ctx.jit_attr_names):
+        return ctx.jit_attr_names[func.attr], "self." + func.attr
+    return None, None
+
+
+class UnhashableStaticArg(Rule):
+    id = "GL011"
+    title = "unhashable-static-arg"
+    predicts = "ValueError at dispatch (static args are cache keys)"
+
+    _MSG = ("static argument {} of jit-compiled `{}` receives {}: "
+            "static args are hashed into the compile-cache key, so "
+            "unhashable values raise at the first call (and mutable "
+            "ones would silently alias cache entries) — pass a tuple "
+            "or a frozen config instead")
+
+    _BUILDERS = {"list", "dict", "set", "bytearray", "sorted"}
+    _ARRAY_FUNCS = {"array", "asarray", "ones", "zeros", "arange",
+                    "empty", "full"}
+    _ARRAY_BASES = _NUMPY_ALIASES | {"jnp"}
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            info, label = _jit_callee_info(ctx, node)
+            if info is None or not info.has_statics:
+                continue
+            for pos in info.static_argnums:
+                if 0 <= pos < len(node.args):
+                    bad = self._unhashable_label(node.args[pos])
+                    if bad is not None:
+                        yield ctx.finding(
+                            node.args[pos], self.id,
+                            self._MSG.format(pos, label, bad))
+            for kw in node.keywords:
+                if kw.arg in info.static_argnames:
+                    bad = self._unhashable_label(kw.value)
+                    if bad is not None:
+                        yield ctx.finding(
+                            kw.value, self.id,
+                            self._MSG.format(repr(kw.arg), label, bad))
+
+    @classmethod
+    def _unhashable_label(cls, node):
+        if isinstance(node, ast.List):
+            return "a list literal"
+        if isinstance(node, ast.Dict):
+            return "a dict literal"
+        if isinstance(node, ast.Set):
+            return "a set literal"
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp)):
+            return "a comprehension"
+        if isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            if (isinstance(node.func, ast.Name)
+                    and name in cls._BUILDERS):
+                return "a `{}(...)` value".format(name)
+            if (name in cls._ARRAY_FUNCS
+                    and _base_name(node.func) in cls._ARRAY_BASES):
+                return "an ndarray (`{}.{}`)".format(
+                    _base_name(node.func), name)
+        return None
+
+
+class RetraceProneCacheKey(Rule):
+    id = "GL012"
+    title = "retrace-prone-cache-key"
+    predicts = "compile_stats().n_traces"
+
+    _MSG = ("host-side {} on `{}.{}` in `{}`, which dispatches into "
+            "jit: shape-keyed host control flow selects or mints one "
+            "executable per distinct shape — bucket shapes explicitly "
+            "(pow2 ladder) or fold the value into the traced "
+            "signature [predicts {} growth]")
+
+    def check(self, ctx):
+        for def_node in ast.walk(ctx.tree):
+            if not isinstance(def_node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                continue
+            if (def_node in ctx.jit_defs
+                    or ctx.enclosing_jit(def_node) is not None):
+                continue  # traced code is GL005's jurisdiction
+            if not self._dispatches_jit(ctx, def_node):
+                continue
+            args = def_node.args
+            params = {a.arg for a in (args.posonlyargs + args.args
+                                      + args.kwonlyargs)}
+            params -= {"self", "cls"}
+            if not params:
+                continue
+            seen = set()
+            for node in ast.walk(def_node):
+                if self._nearest_def(ctx, node) is not def_node:
+                    continue
+                if isinstance(node, (ast.If, ast.While)):
+                    if (isinstance(node, ast.If) and not node.orelse
+                            and all(isinstance(s, (ast.Raise, ast.Assert))
+                                    for s in node.body)):
+                        continue  # shape-validation guard: raising on a
+                        # bad shape is the fix, not the hazard
+                    kind, expr = "branch", node.test
+                elif (isinstance(node, ast.Subscript)
+                      and not self._subscripts_param(node, params)):
+                    kind, expr = "cache key", node.slice
+                else:
+                    continue
+                hit = self._shape_ref(expr, params)
+                if hit is None or (node.lineno, hit) in seen:
+                    continue
+                seen.add((node.lineno, hit))
+                param, attr = hit
+                yield ctx.finding(
+                    node, self.id,
+                    self._MSG.format(kind, param, attr, def_node.name,
+                                     self.predicts))
+
+    @staticmethod
+    def _dispatches_jit(ctx, def_node):
+        for node in ast.walk(def_node):
+            if not isinstance(node, ast.Call):
+                continue
+            info, _ = _jit_callee_info(ctx, node)
+            if info is not None:
+                return True
+            if _terminal_name(node.func) in _JIT_NAMES:
+                return True  # minting executables right here
+        return False
+
+    @staticmethod
+    def _nearest_def(ctx, node):
+        current = ctx.parents.get(node)
+        while current is not None:
+            if isinstance(current, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                return current
+            current = ctx.parents.get(current)
+        return None
+
+    @staticmethod
+    def _subscripts_param(node, params):
+        """True for `x[...]` / `x.pages[...]` where x is a param: array
+        indexing with shape arithmetic is normal host code — the
+        hazard is shape-keyed lookup into *other* containers."""
+        value = node.value
+        while isinstance(value, ast.Attribute):
+            value = value.value
+        return isinstance(value, ast.Name) and value.id in params
+
+    @staticmethod
+    def _shape_ref(expr, params):
+        """(param, 'shape'|'ndim') when the expression reads a shape
+        fact off a parameter; None otherwise."""
+        for node in ast.walk(expr):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in ("shape", "ndim")
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in params):
+                return node.value.id, node.attr
+        return None
+
+
+class LockDiscipline(Rule):
+    id = "GL013"
+    title = "lock-discipline"
+    predicts = "data race (no counter; torn state under interleaving)"
+
+    _MSG = ("`self.{field}` is written under `self.{lock}` "
+            "({writer} line {wline}) but {verb} here without it; "
+            "`{method}` is reachable from thread root `{root}` while "
+            "the locked writer runs from `{wroot}` — acquire "
+            "`self.{lock}`, or sanction a documented single-writer "
+            "field with `# graftlint: unlocked-ok` on this line")
+
+    _LOCK_TYPES = {"Lock", "RLock", "Condition"}
+    _MUTATORS = {"append", "appendleft", "extend", "insert", "add",
+                 "remove", "discard", "pop", "popleft", "clear",
+                 "update", "setdefault", "put"}
+    _SANCTION = "graftlint: unlocked-ok"
+
+    def check(self, ctx):
+        sanctioned = {i + 1 for i, line
+                      in enumerate(ctx.source.splitlines())
+                      if self._SANCTION in line}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node, sanctioned)
+
+    def _check_class(self, ctx, cls, sanctioned):
+        methods = {d.name: d for d in cls.body
+                   if isinstance(d, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        locks = self._lock_fields(methods)
+        targets = self._thread_targets(cls, methods)
+        if not locks or not targets:
+            return  # no lock or provably single-threaded: no discipline
+        roots = self._roots(methods, targets)
+        accesses, locked_writes = self._collect_accesses(
+            ctx, methods, locks)
+        flagged = set()
+        for (lock, field), writers in sorted(locked_writes.items()):
+            writer_roots = set()
+            for method, _ in writers:
+                writer_roots |= roots.get(method, set())
+            wname, wnode = writers[0]
+            for method, node, held, is_write in accesses.get(field, ()):
+                if lock in held or node.lineno in sanctioned:
+                    continue
+                acc_roots = roots.get(method, set())
+                pair = self._differing_roots(writer_roots, acc_roots)
+                if pair is None or (field, node.lineno) in flagged:
+                    continue
+                flagged.add((field, node.lineno))
+                yield ctx.finding(
+                    node, self.id,
+                    self._MSG.format(
+                        field=field, lock=lock, writer=wname,
+                        wline=wnode.lineno,
+                        verb="written" if is_write else "read",
+                        method=method, root=pair[1], wroot=pair[0]))
+
+    # -- per-class facts -----------------------------------------------
+
+    @classmethod
+    def _lock_fields(cls, methods):
+        locks = set()
+        for method in methods.values():
+            for node in ast.walk(method):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and _terminal_name(node.value.func)
+                        in cls._LOCK_TYPES):
+                    continue
+                for target in node.targets:
+                    if (isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"):
+                        locks.add(target.attr)
+        return locks
+
+    @staticmethod
+    def _thread_targets(cls_node, methods):
+        targets = set()
+        for node in ast.walk(cls_node):
+            if not (isinstance(node, ast.Call)
+                    and _terminal_name(node.func) == "Thread"):
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "target"
+                        and isinstance(kw.value, ast.Attribute)
+                        and isinstance(kw.value.value, ast.Name)
+                        and kw.value.value.id == "self"
+                        and kw.value.attr in methods):
+                    targets.add(kw.value.attr)
+        return targets
+
+    @staticmethod
+    def _roots(methods, targets):
+        """method name -> set of thread roots that can reach it: each
+        Thread target's name, plus 'caller' for the public API surface
+        (any non-underscore method runs on whatever thread calls it).
+        __init__ runs before the threads exist and is excluded."""
+        edges = {}
+        for name, method in methods.items():
+            callees = set()
+            for node in ast.walk(method):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in methods):
+                    callees.add(node.func.attr)
+            edges[name] = callees
+        roots = {}
+        seeds = [(t, t) for t in sorted(targets)]
+        seeds += [("caller", name) for name in methods
+                  if not name.startswith("_")]
+        for root, seed in seeds:
+            stack = [seed]
+            while stack:
+                name = stack.pop()
+                if name in ("__init__", "__del__"):
+                    continue
+                reached = roots.setdefault(name, set())
+                if root in reached:
+                    continue
+                reached.add(root)
+                stack.extend(edges.get(name, ()))
+        return roots
+
+    @classmethod
+    def _collect_accesses(cls, ctx, methods, locks):
+        """(accesses, locked_writes): every `self.<field>` touch per
+        method with the lock set lexically held at that node, and the
+        (lock, field) -> [(method, node)] map of guarded writes."""
+        accesses = {}
+        locked_writes = {}
+        for name, method in methods.items():
+            if name in ("__init__", "__del__"):
+                continue  # construction precedes the threads
+            for node in ast.walk(method):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    continue
+                field = node.attr
+                if field in locks:
+                    continue  # touching the lock object itself
+                is_write = cls._is_write(ctx, node)
+                held = cls._held_locks(ctx, node, method, locks)
+                accesses.setdefault(field, []).append(
+                    (name, node, held, is_write))
+                if is_write:
+                    for lock in held:
+                        locked_writes.setdefault(
+                            (lock, field), []).append((name, node))
+        return accesses, locked_writes
+
+    @classmethod
+    def _is_write(cls, ctx, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.AugAssign) and parent.target is node:
+            return True
+        # Mutating container call: self.field.append(...) and friends.
+        if (isinstance(parent, ast.Attribute)
+                and parent.attr in cls._MUTATORS):
+            grand = ctx.parents.get(parent)
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                return True
+        return False
+
+    @staticmethod
+    def _held_locks(ctx, node, method, locks):
+        held = set()
+        current = ctx.parents.get(node)
+        while current is not None and current is not method:
+            if isinstance(current, (ast.With, ast.AsyncWith)):
+                for item in current.items:
+                    expr = item.context_expr
+                    if (isinstance(expr, ast.Attribute)
+                            and isinstance(expr.value, ast.Name)
+                            and expr.value.id == "self"
+                            and expr.attr in locks):
+                        held.add(expr.attr)
+            current = ctx.parents.get(current)
+        return held
+
+    @staticmethod
+    def _differing_roots(writer_roots, acc_roots):
+        """(writer_root, access_root) with writer != access, preferring
+        real thread names over the 'caller' pseudo-root; None when the
+        two sides cannot run concurrently."""
+        best = None
+        for w in sorted(writer_roots):
+            for a in sorted(acc_roots):
+                if w == a:
+                    continue
+                pair = (w, a)
+                if "caller" not in pair:
+                    return pair
+                best = best or pair
+        return best
+
+
 ALL_RULES = [HostSyncInJit(), RetraceHazard(), DonationAfterUse(),
              RngKeyReuse(), TracerControlFlow(),
              ShardingAxisMismatch(), TransitiveHostSync(),
-             RngKeyReuseAcrossCalls(), DonationEscape()]
+             RngKeyReuseAcrossCalls(), DonationEscape(),
+             DeadJitSignatureLeaf(), UnhashableStaticArg(),
+             RetraceProneCacheKey(), LockDiscipline()]

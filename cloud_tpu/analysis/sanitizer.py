@@ -27,6 +27,14 @@ Violations (ids mirror the GL numbering, GS-prefixed):
   has been deleted" with no hint of WHERE the donation happened (and
   on backends that ignore donation there is no failure at all, just a
   silent portability bug); the finding carries the donation site.
+- GS005 retrace-attribution — the runtime dual of GL010. When a trace
+  fires after warmup (after `runtime.notify_warm_mark()` — the serving
+  engine's `mark_warm()` — or after epoch 1), the InstrumentedJit
+  diffs the offending call's aval signature against the warm table and
+  its trace history and the finding names the exact leaf whose avals
+  moved: "args[1]['page_table'] widened int32[4,16] -> int32[8,16]",
+  attributed to the dispatching call site. Warmup traces are expected
+  and record nothing.
 
 Enablement is scoped, never ambient: `with sanitize(mode="warn"):`
 installs the runtime observer and the `jax.random` watchers and tears
@@ -71,6 +79,11 @@ VIOLATIONS = {
               "invalidated that buffer; keep the jitted result (or "
               "drop the argument from donate_argnums) instead of "
               "re-reading the donated input"),
+    "GS005": ("retrace-attribution",
+              "post-warmup retrace of `{}` at {}: {} — the signature "
+              "leaf(s) named moved between calls; pin the leaf's "
+              "shape/dtype, pre-warm the new geometry, or drop a dead "
+              "leaf from the signature (graftlint GL010)"),
 }
 
 #: jax.random functions whose first argument is a key they consume.
@@ -167,6 +180,7 @@ class Sanitizer:
         self._findings = []
         self._finding_index = {}   # (rule, site-string) -> finding
         self._epochs_done = 0
+        self._warm_marked = False  # notify_warm_mark() arms GS005
         self._seen_keys = {}       # fingerprint -> first-use site str
         self._donated = {}         # id(array) -> (weakref, site str)
 
@@ -209,6 +223,35 @@ class Sanitizer:
     def on_epoch(self, epoch):
         with self._lock:
             self._epochs_done = max(self._epochs_done, epoch + 1)
+
+    def on_warm_mark(self):
+        """Arms GS005: every executable the workload needs is compiled
+        (the serving engine's `mark_warm()`), so any later trace is a
+        bug with a name."""
+        with self._lock:
+            self._warm_marked = True
+
+    def on_retrace(self, label, diffs):
+        """One attributed retrace from an InstrumentedJit. `diffs` is
+        a tuple of (leaf path, old aval, new aval) naming what moved,
+        or None when no prior signature shared the call's tree shape.
+        Silent until armed — warmup traces are the expected cost of
+        building the warm table, not findings."""
+        site = _attribution_site()
+        with self._lock:
+            if not (self._warm_marked or self._epochs_done >= 1):
+                return
+            if diffs:
+                detail = "; ".join(
+                    "{} widened {} -> {}".format(path, old, new)
+                    for path, old, new in diffs)
+            else:
+                detail = ("new call structure (no prior signature "
+                          "with this tree shape to diff)")
+            self._violation(
+                "GS005", site,
+                VIOLATIONS["GS005"][1].format(
+                    label, _format_site(site), detail))
 
     def on_donation(self, args):
         import jax

@@ -520,6 +520,12 @@ class _FanoutObserver:
     def on_donation(self, args):
         self._fan("on_donation", args)
 
+    def on_warm_mark(self):
+        self._fan("on_warm_mark")
+
+    def on_retrace(self, label, diffs):
+        self._fan("on_retrace", label, diffs)
+
 
 def _rebuild_dispatch():
     """Recomputes the fast dispatch target `_observer` from the
@@ -538,9 +544,10 @@ def add_observer(observer):
     """Adds `observer` to the installed set (idempotent). Observers
     see `on_h2d(transfers, nbytes)`, `on_d2h(nbytes, tree)`,
     `on_compile(n_traces, n_compiles, cache_hits)`, `on_cache_miss()`,
-    `on_epoch(epoch)`, `on_donation(args)` — all best-effort, called
-    inline at record time on whatever thread recorded; any subset of
-    those methods may be implemented when stacked. Returns `observer`."""
+    `on_epoch(epoch)`, `on_donation(args)`, `on_warm_mark()`,
+    `on_retrace(label, diffs)` — all best-effort, called inline at
+    record time on whatever thread recorded; any subset of those
+    methods may be implemented when stacked. Returns `observer`."""
     global _observers
     if observer is not None and observer not in _observers:
         _observers = _observers + (observer,)
@@ -596,6 +603,25 @@ def notify_epoch(epoch):
     """Tells the observer (if any) that epoch `epoch` just finished."""
     if _observer is not None:
         _observer.on_epoch(epoch)
+
+
+def notify_warm_mark():
+    """Tells the observer (if any) that warmup just finished — every
+    executable the workload needs is compiled, so from here on a trace
+    is a bug and `on_retrace` events carry blame (GS005). getattr-
+    guarded: observers that predate the event simply never see it."""
+    if _observer is not None:
+        fn = getattr(_observer, "on_warm_mark", None)
+        if fn is not None:
+            fn()
+
+
+def _notify_retrace(label, diffs):
+    """Forwards one attributed retrace to the observer (if any)."""
+    if _observer is not None:
+        fn = getattr(_observer, "on_retrace", None)
+        if fn is not None:
+            fn(label, diffs)
 
 
 def record_h2d(batch):
@@ -808,8 +834,14 @@ class InstrumentedJit:
         import jax
 
         self._fun = fun
+        self._label = getattr(fun, "__name__", None) or repr(fun)
         self._trace_count = 0
         self._warm = {}
+        # treedef -> leaf-aval tuple of the LAST traced call with that
+        # structure. Written only when a trace actually fired (rare by
+        # construction), read only to attribute the NEXT trace: the
+        # diff against it names the exact leaf whose avals moved.
+        self._sig_history = {}
         # Donated positions, kept for the graftsan observer: donation
         # invalidates the caller's buffer, so the sanitizer tracks the
         # donated arrays (by weakref) to catch later reads of them.
@@ -863,7 +895,61 @@ class InstrumentedJit:
         if self._trace_count != before:
             record_compile(n_compiles=1,
                            compile_seconds=time.perf_counter() - t0)
+            if not kwargs:
+                self._attribute_trace(args)
         return out
+
+    def _attribute_trace(self, args):
+        """Names the leaves that forced the trace that just fired.
+
+        Diffs the call's aval signature against the closest previously
+        seen signature of the same tree structure (warm table first,
+        then the per-structure trace history) and forwards the diff to
+        the observer as an `on_retrace` event — the GS005 runtime dual
+        of graftlint GL010. Runs only on traced calls, so steady-state
+        dispatch cost is untouched."""
+        sig = _aval_signature(args)
+        if sig is None:
+            _notify_retrace(self._label, None)
+            return
+        treedef, leaves = sig
+        diffs = None
+        if _observer is not None:
+            candidates = [s[1] for s in self._warm if s[0] == treedef]
+            prior = self._sig_history.get(treedef)
+            if prior is not None:
+                candidates.append(prior)
+            best = None
+            for old in candidates:
+                if len(old) != len(leaves):
+                    continue
+                changed = [i for i, (a, b) in enumerate(zip(old, leaves))
+                           if a != b]
+                if changed and (best is None or len(changed) < len(best[0])):
+                    best = (changed, old)
+            if best is not None:
+                diffs = self._leaf_diffs(args, best[1], leaves, best[0])
+            _notify_retrace(self._label, diffs)
+        self._sig_history[treedef] = leaves
+
+    @staticmethod
+    def _leaf_diffs(args, old, new, changed):
+        """[(leaf path, old aval, new aval), ...] with human names:
+        `args[1]['page_table']` widened `int32[4,16]` -> `int32[8,16]`."""
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(args)
+
+        def aval(entry):
+            shape, dtype = entry
+            return "{}[{}]".format(dtype, ",".join(map(str, shape)))
+
+        out = []
+        for i in changed:
+            path = ("args" + jax.tree_util.keystr(flat[i][0])
+                    if i < len(flat) else "leaf {}".format(i))
+            out.append((path, aval(old[i]), aval(new[i])))
+        return tuple(out)
 
     def lower(self, *args, **kwargs):
         return _InstrumentedLowered(self._jitted.lower(*args, **kwargs))

@@ -795,8 +795,12 @@ class DecodeEngine:
 
     def mark_warm(self):
         """Snapshots the compile counters; `check_no_retrace()` raises
-        on any growth after this point."""
+        on any growth after this point. Also arms graftsan's GS005
+        retrace-attribution: under a `sanitize()` scope, any trace
+        after this mark is reported with the exact signature leaf
+        whose avals moved, not just a count."""
         self._warm_stats = runtime.compile_stats()
+        runtime.notify_warm_mark()
 
     def check_no_retrace(self):
         if self._warm_stats is None:

@@ -1573,7 +1573,9 @@ class Scheduler:
                     self._wake.clear()
                     continue
                 if (self._free_slots
-                        and self._pending_inserts > self._chunk_accounted
+                        # A stale read only mis-times one 5 ms pacing
+                        # nap; correctness never depends on it.
+                        and self._pending_inserts > self._chunk_accounted  # graftlint: unlocked-ok
                         and skips < 40):
                     # Admissions are in flight on OTHER threads and
                     # slots are open: yield briefly so the insert lands

@@ -312,9 +312,13 @@ class TestSarifFormat:
         (run,) = doc["runs"]
         driver = run["tool"]["driver"]
         assert driver["name"] == "graftlint"
-        # GL000 + every registered rule, stable order.
-        assert [r["id"] for r in driver["rules"]] == (
-            ["GL000"] + list(engine.RULES.keys()))
+        # GL000 + every registered rule, stable order — pinned as a
+        # literal so a rule added to the registry without a SARIF
+        # entry (or vice versa) fails here, not in a consumer.
+        assert [r["id"] for r in driver["rules"]] == [
+            "GL000", "GL001", "GL002", "GL003", "GL004", "GL005",
+            "GL006", "GL007", "GL008", "GL009", "GL010", "GL011",
+            "GL012", "GL013"]
         (result,) = run["results"]
         assert result["ruleId"] == "GL001"
         assert driver["rules"][result["ruleIndex"]]["id"] == "GL001"

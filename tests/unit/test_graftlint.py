@@ -287,6 +287,324 @@ class TestGL006ShardingAxisMismatch:
         assert rules_of(src) == []
 
 
+class TestGL010DeadJitSignatureLeaf:
+
+    def test_unused_traced_param_fires(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def gather(pages, page_table, pos_count):\n"
+            "    return pages[page_table]\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL010"]
+        assert "`pos_count`" in findings[0].message
+
+    def test_all_params_read_silent(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, y):\n"
+            "    return x + y\n")
+        assert rules_of(src) == []
+
+    def test_underscore_rename_is_the_sanction(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, _sig_pad):\n"
+            "    return x * 2\n")
+        assert rules_of(src) == []
+
+    def test_static_param_not_a_leaf(self):
+        # Static args are hashed, not traced: an unused static arg is
+        # odd but does not widen the aval signature.
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=1)\n"
+            "def f(x, mode):\n"
+            "    return x * 2\n")
+        assert rules_of(src) == []
+
+    def test_forward_to_ignoring_helper_fires(self):
+        # Interprocedural: the helper provably never reads its second
+        # param, so forwarding is not a read.
+        src = (
+            "import jax\n"
+            "def helper(x, unused):\n"
+            "    return x * 2\n"
+            "@jax.jit\n"
+            "def f(x, extra):\n"
+            "    return helper(x, extra)\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL010"]
+        assert "`extra`" in findings[0].message
+        assert "helper" in findings[0].message
+
+    def test_forward_to_reading_helper_silent(self):
+        src = (
+            "import jax\n"
+            "def helper(x, scale):\n"
+            "    return x * scale\n"
+            "@jax.jit\n"
+            "def f(x, extra):\n"
+            "    return helper(x, extra)\n")
+        assert rules_of(src) == []
+
+    def test_forward_to_method_is_conservative(self):
+        # `self._scatter(x, extra)` is unresolvable — treated as a
+        # read, the engine's own executables forward like this.
+        src = (
+            "import jax\n"
+            "class E:\n"
+            "    def __init__(self):\n"
+            "        self._f = jax.jit(self._impl)\n"
+            "    def _impl(self, x, extra):\n"
+            "        return self._mix(x, extra)\n")
+        assert rules_of(src) == []
+
+    def test_prefix_gather_dead_dict_leaves_fire(self):
+        # Regression: the serving prefix-cache gather shipped per-slot
+        # leaves (page_table/slot_steps/slot_valid/pos_count) the
+        # traced gather never read, silently binding one executable
+        # per slot count. GL010 must flag each dead leaf at the call.
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def gather(dense, pool, page_vec):\n"
+            "    return dense + pool['key_pages'] + pool['value_pages']"
+            " + page_vec\n"
+            "def prefill_gather(dense, cache, page_vec):\n"
+            "    return gather(dense, {\n"
+            "        'key_pages': cache['key_pages'],\n"
+            "        'value_pages': cache['value_pages'],\n"
+            "        'page_table': cache['page_table'],\n"
+            "        'slot_steps': cache['slot_steps'],\n"
+            "        'slot_valid': cache['slot_valid'],\n"
+            "        'pos_count': cache['pos_count'],\n"
+            "    }, page_vec)\n")
+        findings = engine.check_source(src)
+        dead = [f for f in findings if f.rule == "GL010"]
+        named = {leaf for f in dead
+                 for leaf in ("page_table", "slot_steps", "slot_valid",
+                              "pos_count") if repr(leaf) in f.message}
+        assert len(dead) == 4
+        assert named == {"page_table", "slot_steps", "slot_valid",
+                         "pos_count"}
+
+    def test_whole_dict_use_silences_leaves(self):
+        # The dict escapes whole (tree_map): no leaf is provably dead.
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(tree):\n"
+            "    return jax.tree_util.tree_map(lambda a: a + 1, tree)\n"
+            "def call(x):\n"
+            "    return f({'a': x, 'b': x})\n")
+        assert [r for r in rules_of(src) if r == "GL010"] == []
+
+    def test_bound_method_attribute_form_fires(self):
+        # The serving engine's binding idiom:
+        # `self._tick = partial(jit, ...)(self._tick_impl)`.
+        src = (
+            "import functools\n"
+            "from cloud_tpu.parallel.runtime import instrumented_jit\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self._tick = functools.partial(\n"
+            "            instrumented_jit, donate_argnums=(1,))("
+            "self._tick_impl)\n"
+            "    def _tick_impl(self, params, cache, slot_pad):\n"
+            "        return params, cache + 1\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL010"]
+        assert "`slot_pad`" in findings[0].message
+
+
+class TestGL011UnhashableStaticArg:
+
+    def test_list_literal_into_static_argnums_fires(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=1)\n"
+            "def resize(x, widths):\n"
+            "    return x\n"
+            "def call(x):\n"
+            "    return resize(x, [1, 2, 3])\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL011"]
+        assert "list literal" in findings[0].message
+
+    def test_dict_into_static_argname_fires(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('cfg',))\n"
+            "def step(x, cfg=None):\n"
+            "    return x\n"
+            "def call(x):\n"
+            "    return step(x, cfg={'k': 1})\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL011"]
+        assert "dict literal" in findings[0].message
+
+    def test_ndarray_builder_fires(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=1)\n"
+            "def step(x, table):\n"
+            "    return x\n"
+            "def call(x):\n"
+            "    return step(x, np.zeros(4))\n")
+        assert rules_of(src) == ["GL011"]
+
+    def test_tuple_and_scalar_silent(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=(1, 2))\n"
+            "def step(x, widths, mode):\n"
+            "    return x\n"
+            "def call(x):\n"
+            "    return step(x, (1, 2, 3), 'greedy')\n")
+        assert rules_of(src) == []
+
+
+class TestGL012RetraceProneCacheKey:
+
+    def test_shape_keyed_dict_lookup_fires(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def tick(x):\n"
+            "    return x + 1\n"
+            "_warm = {}\n"
+            "def dispatch(batch):\n"
+            "    fn = _warm[batch.shape[0]]\n"
+            "    return tick(batch)\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL012"]
+        assert "batch.shape" in findings[0].message
+
+    def test_shape_branch_on_jit_path_fires(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def tick(x):\n"
+            "    return x + 1\n"
+            "def dispatch(batch):\n"
+            "    if batch.shape[0] > 8:\n"
+            "        return tick(batch)\n"
+            "    return tick(batch[:8])\n")
+        assert rules_of(src) == ["GL012"]
+
+    def test_validation_guard_silent(self):
+        # `if bad shape: raise` is the fix, not the hazard.
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def tick(x):\n"
+            "    return x + 1\n"
+            "def dispatch(batch, n):\n"
+            "    if batch.shape[0] != n:\n"
+            "        raise ValueError('bad batch')\n"
+            "    return tick(batch)\n")
+        assert rules_of(src) == []
+
+    def test_no_jit_call_no_opinion(self):
+        src = (
+            "def pad(a, n):\n"
+            "    if a.shape[0] == n:\n"
+            "        return a\n"
+            "    return a + n\n")
+        assert rules_of(src) == []
+
+    def test_indexing_the_param_itself_silent(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def tick(x):\n"
+            "    return x + 1\n"
+            "def dispatch(batch):\n"
+            "    half = batch[batch.shape[0] // 2]\n"
+            "    return tick(half)\n")
+        assert rules_of(src) == []
+
+
+class TestGL013LockDiscipline:
+    """Fixture pair modeled on the Scheduler's `_ready_lock` fields:
+    the prefill thread appends ready work under the lock, the tick
+    thread consumes it."""
+
+    _LOCKED = (
+        "import threading\n"
+        "class Sched:\n"
+        "    def __init__(self):\n"
+        "        self._ready_lock = threading.Lock()\n"
+        "        self._ready = []\n"
+        "        self._t1 = threading.Thread(target=self._prefill_loop)\n"
+        "        self._t2 = threading.Thread(target=self._tick_loop)\n"
+        "    def _prefill_loop(self):\n"
+        "        with self._ready_lock:\n"
+        "            self._ready.append(1)\n"
+        "    def _tick_loop(self):\n"
+        "        with self._ready_lock:\n"
+        "            ready, self._ready = self._ready, []\n")
+
+    def test_locked_pair_silent(self):
+        assert rules_of(self._LOCKED) == []
+
+    def test_unlocked_read_from_other_thread_fires(self):
+        src = self._LOCKED.replace(
+            "    def _tick_loop(self):\n"
+            "        with self._ready_lock:\n"
+            "            ready, self._ready = self._ready, []\n",
+            "    def _tick_loop(self):\n"
+            "        ready, self._ready = self._ready, []\n")
+        findings = engine.check_source(src)
+        assert {f.rule for f in findings} == {"GL013"}
+        assert any("`self._ready`" in f.message
+                   and "_ready_lock" in f.message for f in findings)
+
+    def test_unlocked_public_reader_fires(self):
+        src = self._LOCKED + (
+            "    def stats(self):\n"
+            "        return len(self._ready)\n")
+        findings = engine.check_source(src)
+        assert [f.rule for f in findings] == ["GL013"]
+        assert "caller" in findings[0].message
+
+    def test_sanction_comment_silences(self):
+        src = self._LOCKED + (
+            "    def stats(self):\n"
+            "        return len(self._ready)"
+            "  # graftlint: unlocked-ok\n")
+        assert rules_of(src) == []
+
+    def test_single_threaded_class_silent(self):
+        # No Thread targets: nothing can interleave, lock or not.
+        src = (
+            "import threading\n"
+            "class Pool:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._pages = []\n"
+            "    def alloc(self):\n"
+            "        with self._lock:\n"
+            "            self._pages.append(1)\n"
+            "    def stats(self):\n"
+            "        return len(self._pages)\n")
+        assert rules_of(src) == []
+
+    def test_init_writes_exempt(self):
+        # Construction precedes the threads; __init__ never flags.
+        assert "__init__" not in "".join(
+            f.message for f in engine.check_source(self._LOCKED))
+
+
 class TestSuppression:
 
     def test_same_line_disable(self):
@@ -531,6 +849,7 @@ class TestSelfRun:
     def test_every_rule_has_id_title_and_counter(self):
         assert list(engine.RULES) == [
             "GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-            "GL007", "GL008", "GL009"]
+            "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
+            "GL013"]
         for rule in engine.RULES.values():
             assert rule.title and rule.predicts

@@ -226,6 +226,88 @@ class TestGS004DonatedBufferAccess:
         assert san.findings() == []
 
 
+class TestGS005RetraceAttribution:
+    """The runtime dual of GL010: a post-warmup trace is attributed to
+    the exact signature leaf whose avals moved, at the dispatching
+    call site — replaying the serving prefix-gather shape where a
+    per-slot `page_table` leaf silently bound one executable per slot
+    count."""
+
+    @staticmethod
+    def _gather():
+        return runtime.instrumented_jit(
+            lambda dense, pool: dense + pool["kv"])
+
+    def test_warmup_traces_silent(self):
+        gather = self._gather()
+        with sanitize_quiet() as san:
+            dense = jnp.zeros((2, 3))
+            gather(dense, {"kv": dense,
+                           "page_table": jnp.zeros((4,), jnp.int32)})
+            gather(dense, {"kv": dense,
+                           "page_table": jnp.zeros((8,), jnp.int32)})
+        assert [f for f in san.findings() if f["rule"] == "GS005"] == []
+
+    def test_post_warm_retrace_names_the_leaf(self):
+        gather = self._gather()
+        with sanitize_quiet() as san:
+            dense = jnp.zeros((2, 3))
+            gather(dense, {"kv": dense,
+                           "page_table": jnp.zeros((4,), jnp.int32)})
+            runtime.notify_warm_mark()
+            # Same signature: warm, no trace, no finding.
+            gather(dense, {"kv": dense,
+                           "page_table": jnp.zeros((4,), jnp.int32)})
+            assert san.findings() == []
+            # The dead leaf widens 4 -> 8: trace, attributed finding.
+            gather(dense, {"kv": dense,
+                           "page_table": jnp.zeros((8,), jnp.int32)})
+        (finding,) = [f for f in san.findings()
+                      if f["rule"] == "GS005"]
+        assert "page_table" in finding["message"]
+        assert "int32[4]" in finding["message"]
+        assert "int32[8]" in finding["message"]
+        # Attributed to the dispatching call site in THIS file, not
+        # to runtime internals.
+        assert finding["path"] == THIS_FILE
+
+    def test_epoch_boundary_arms_like_warm_mark(self):
+        step = runtime.instrumented_jit(lambda s: s * 2)
+        with sanitize_quiet() as san:
+            step(jnp.ones((2,)))
+            san.on_epoch(0)
+            step(jnp.ones((5,)))
+        (finding,) = [f for f in san.findings()
+                      if f["rule"] == "GS005"]
+        assert "float32[2]" in finding["message"]
+        assert "float32[5]" in finding["message"]
+
+    def test_new_structure_reported_without_diff(self):
+        step = runtime.instrumented_jit(
+            lambda tree: jax.tree_util.tree_map(lambda a: a + 1, tree))
+        with sanitize_quiet() as san:
+            step({"a": jnp.ones((2,))})  # graftlint: disable=GL002
+            runtime.notify_warm_mark()
+            step({"a": jnp.ones((2,)),  # graftlint: disable=GL002
+                  "b": jnp.ones((2,))})
+        (finding,) = [f for f in san.findings()
+                      if f["rule"] == "GS005"]
+        assert "new call structure" in finding["message"]
+
+    def test_aot_warm_table_is_a_diff_candidate(self):
+        # A geometry warmed via `.warm()` (never dispatched) still
+        # anchors the diff — the serving ladder pre-warms exactly so.
+        step = runtime.instrumented_jit(lambda s: s + 1)
+        with sanitize_quiet() as san:
+            step.warm(jax.ShapeDtypeStruct((4,), jnp.float32))
+            runtime.notify_warm_mark()
+            step(jnp.ones((6,)))
+        (finding,) = [f for f in san.findings()
+                      if f["rule"] == "GS005"]
+        assert "float32[4]" in finding["message"]
+        assert "float32[6]" in finding["message"]
+
+
 class TestEscalation:
 
     def test_strict_raises_at_scope_exit(self):
