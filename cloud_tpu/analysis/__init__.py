@@ -9,7 +9,7 @@ job is containerized instead of as a wall-clock pathology on the slice.
 Three entry points share one engine:
 
 - CLI:       python -m cloud_tpu.analysis.lint <paths> [--strict]
-             [--format json|sarif]
+             [--format json|sarif] [--axes]
 - Preflight: `run(entry_point=..., lint="warn"|"strict"|"off")` lints the
              entry point AND its first-level local imports before
              containerize (analysis/preflight.py).
@@ -17,8 +17,11 @@ Three entry points share one engine:
              stays graftlint-clean.
 
 Pure `ast` + `tokenize` — the target is parsed, never imported. Rules
-GL006-GL009 are interprocedural: every file in one invocation shares a
-`callgraph.ProjectContext`, so facts flow through imports and calls.
+GL006-GL010 and GL014-GL018 are interprocedural: every file in one
+invocation shares a `callgraph.ProjectContext`, so facts flow through
+imports and calls — the graftmesh family (GL014-GL018) additionally
+reads the whole-program mesh-axis registry (analysis/meshmap.py,
+dumped by `lint --axes`).
 
 The dynamic complement is graftsan (analysis/sanitizer.py): `with
 sanitize():` — or `CLOUD_TPU_SANITIZE=1` around `Trainer.fit` — hooks

@@ -156,6 +156,8 @@ class ProjectContext:
         self.by_name = {}
         #: axis name -> sorted list of declaring module paths
         self.mesh_axes = {}
+        #: lazily built graftmesh AxisRegistry (see `graftmesh()`)
+        self._graftmesh = None
         for ctx in contexts:
             view = ModuleView(ctx.path, module_name_for(ctx.path), ctx)
             self.modules[ctx.path] = view
@@ -545,3 +547,15 @@ class ProjectContext:
             parts.append("{!r} ({})".format(
                 axis, os.path.basename(paths[0])))
         return ", ".join(parts) if parts else "none"
+
+    def graftmesh(self):
+        """The graftmesh `AxisRegistry` over this project, built on
+        first use and shared by every rule that reads it (GL014-GL018)
+        and by `lint --axes`. Lazy import: meshmap imports rules,
+        which already imports nothing from here at module scope, but
+        keeping the edge out of import time makes the layering obvious
+        and cycle-proof."""
+        if self._graftmesh is None:
+            from cloud_tpu.analysis import meshmap
+            self._graftmesh = meshmap.build_registry(self)
+        return self._graftmesh

@@ -163,18 +163,18 @@ def iter_python_files(paths):
     return out
 
 
-def check_paths(paths, select=None):
-    """Lints files/directories -> (sorted [Finding], files_checked).
+def build_project(paths):
+    """Parses files/directories into one shared project.
 
-    All parseable files share ONE `callgraph.ProjectContext`, so the
-    interprocedural rules (GL006-GL010) resolve imports and call
-    chains across every file in the invocation — linting a package
-    directory sees strictly more than linting its files one by one.
+    -> (callgraph.ProjectContext, [GL000 Findings], files_listed).
+    Every parseable file's FileContext has `.project` attached. Both
+    `check_paths` and the graftmesh `lint --axes` registry dump build
+    their world through here, so the two always see the same modules.
     """
     from cloud_tpu.analysis import callgraph
 
     files = iter_python_files(paths)
-    findings, contexts = [], []
+    errors, contexts = [], []
     for filename in files:
         try:
             with open(filename, "r", encoding="utf-8") as handle:
@@ -183,20 +183,34 @@ def check_paths(paths, select=None):
             # A file that vanished or lost read permission between
             # listing and reading (preflight races the user's editor)
             # degrades to a finding, not a crashed lint run.
-            findings.append(Finding(
+            errors.append(Finding(
                 filename, 0, 0, PARSE_ERROR,
                 "unreadable: {}".format(exc)))
             continue
         ctx, error = _parse_context(source, filename)
         if error is not None:
-            findings.append(error)
+            errors.append(error)
         else:
             contexts.append(ctx)
     project = callgraph.ProjectContext(contexts)
     for ctx in contexts:
         ctx.project = project
-        findings.extend(_check_context(ctx, select))
-    return sorted(findings, key=Finding.sort_key), len(files)
+    return project, errors, len(files)
+
+
+def check_paths(paths, select=None):
+    """Lints files/directories -> (sorted [Finding], files_checked).
+
+    All parseable files share ONE `callgraph.ProjectContext`, so the
+    interprocedural rules (GL006-GL010, GL014-GL018) resolve imports
+    and call chains across every file in the invocation — linting a
+    package directory sees strictly more than linting its files one by
+    one.
+    """
+    project, findings, files_checked = build_project(paths)
+    for view in project.modules.values():
+        findings.extend(_check_context(view.ctx, select))
+    return sorted(findings, key=Finding.sort_key), files_checked
 
 
 def _build_registry():
@@ -251,5 +265,5 @@ class _LazyRegistry(dict):
         return super().__contains__(key)
 
 
-#: Rule registry: id -> rule instance, in GL001..GL013 order.
+#: Rule registry: id -> rule instance, in GL001..GL018 order.
 RULES = _LazyRegistry()

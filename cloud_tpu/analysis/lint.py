@@ -19,6 +19,14 @@ every registered rule in the rule table, findings as level "warning"
 results with 1-based line/column physical locations) — the interchange
 format code-scanning UIs (GitHub, VS Code SARIF viewer) ingest
 directly; CI uploads it as the analysis artifact.
+
+`--axes` skips the rules entirely and dumps the graftmesh axis
+registry (analysis/meshmap.py) as JSON: every Mesh construction with
+its axis names and statically-known sizes, every PartitionSpec /
+NamedSharding, every shard_map in/out spec, and every collective with
+its axis_name — each attributed to file:line and enclosing scope. CI
+uploads it next to the SARIF artifact; with --strict an EMPTY registry
+exits 1 (a silent meshmap walker breakage, not a clean tree).
 """
 
 import argparse
@@ -35,7 +43,7 @@ def _build_parser():
     parser = argparse.ArgumentParser(
         prog="python -m cloud_tpu.analysis.lint",
         description="graftlint: static analysis for JAX/TPU training "
-                    "code (rules GL001-GL013; see --list-rules).")
+                    "code (rules GL001-GL018; see --list-rules).")
     parser.add_argument("paths", nargs="*",
                         help=".py files and/or directories to lint")
     parser.add_argument("--format", choices=("text", "json", "sarif"),
@@ -49,6 +57,11 @@ def _build_parser():
                              "GL001,GL004 (default: all)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
+    parser.add_argument("--axes", action="store_true",
+                        help="dump the graftmesh axis registry (every "
+                             "Mesh/PartitionSpec/shard_map/collective "
+                             "site) as JSON instead of linting; with "
+                             "--strict an empty registry exits 1")
     return parser
 
 
@@ -128,6 +141,26 @@ def main(argv=None, out=None):
     if not args.paths:
         _build_parser().print_usage(sys.stderr)
         return 2
+
+    if args.axes:
+        from cloud_tpu.analysis import meshmap
+
+        try:
+            registry, errors = meshmap.registry_for_paths(args.paths)
+        except ValueError as exc:
+            sys.stderr.write("graftlint: {}\n".format(exc))
+            return 2
+        doc = registry.to_json()
+        doc["parse_errors"] = [f.to_dict() for f in errors]
+        out.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+        if args.strict and registry.is_empty():
+            sys.stderr.write(
+                "graftlint --axes --strict: EMPTY axis registry — no "
+                "Mesh/PartitionSpec/shard_map/collective site found; "
+                "either the paths hold no sharded code or the meshmap "
+                "walker broke\n")
+            return 1
+        return 0
 
     select = None
     if args.select:

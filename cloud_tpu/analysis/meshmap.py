@@ -1,0 +1,483 @@
+"""graftmesh: the whole-program mesh-axis registry.
+
+ROADMAP item 1's missing fact base, built statically: one walk over a
+lint invocation (the same `FileContext`s the rules see, shared through
+`callgraph.ProjectContext`) inventories every site where mesh-axis
+semantics enter the program —
+
+- `Mesh(...)` / `jax.make_mesh(...)` constructions, with their axis
+  names and, where the shape is a literal (`make_mesh((2, 4), ...)`,
+  `devices.reshape(2, 4)`, `create_device_mesh((2, 4))`), the axis
+  *sizes*;
+- every `PartitionSpec` (under whatever alias the file imports it) and
+  `NamedSharding` construction, with per-dimension entries;
+- every `shard_map(...)` call with its `in_specs` / `out_specs`
+  (matched by name, or by shape — any call carrying both spec
+  keywords, which catches shard_map travelling as a parameter);
+- every `jax.lax` collective (`psum`, `pmean`, `all_gather`,
+  `ppermute`, `all_to_all`, `axis_index`, ...) with its `axis_name`,
+  including whether the axis is a literal or flows in dynamically
+  (a parameter — ring/ulysses/pipeline style); a dynamic axis whose
+  parameter has a literal default (`axis="sp"`, `axis=DATA_AXIS`) is
+  additionally surfaced as `default_axes`, a registry-only hint the
+  rules never treat as a fact since callers can override defaults;
+
+each attributed to file:line:col and to the enclosing function scope,
+with a `[jit]` tag when the site sits inside a jit-compiled body.
+
+The registry is the shared substrate of rules GL014-GL018 (read it via
+`ctx.project.graftmesh()`) and of `python -m cloud_tpu.analysis.lint
+--axes`, which dumps it as JSON — the starting `SpecLayout` the Plan
+refactor (ROADMAP item 1) will consume. Like everything in graftlint
+it is pure `ast`: the target is parsed, never imported, so dynamically
+registered axes (a Mesh built from a variable axis tuple, e.g.
+`runtime.initialize()`) appear as `"dynamic": true` mesh sites with no
+axis names — the documented GL006 blind spot, now at least *visible*
+in the inventory instead of silently absent.
+"""
+
+import ast
+
+from cloud_tpu.analysis import rules as _rules
+
+#: Schema version of the JSON document `lint --axes` emits.
+REGISTRY_VERSION = 1
+
+#: jax.lax collectives that take an axis_name (canonical names).
+COLLECTIVES = frozenset((
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle", "axis_index"))
+
+#: The subset whose OUTPUT no longer varies over the axis: after one of
+#: these, every device along the axis holds the same (reduced or fully
+#: gathered) value, so replicating it in `out_specs` is sound. ppermute
+#: / all_to_all / axis_index keep per-device variance and do NOT
+#: discharge GL016.
+REDUCING_COLLECTIVES = frozenset((
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather"))
+
+#: Position of axis_name when passed positionally (default slot 1:
+#: `psum(x, axis_name)`; `axis_index(axis_name)` takes it first).
+_AXIS_ARG_INDEX = {"axis_index": 0}
+
+#: Sentinel for a spec entry the AST cannot resolve to a literal.
+UNKNOWN = "?"
+
+
+def _unparse(node):
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - very old ast nodes only
+        return "<expr>"
+
+
+def lax_aliases(ctx):
+    """local name -> canonical collective, for `from jax.lax import
+    psum [as p]` style imports (cached on the FileContext)."""
+    cached = getattr(ctx, "_graftmesh_lax_aliases", None)
+    if cached is None:
+        cached = {}
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.ImportFrom)
+                    and node.module == "jax.lax"):
+                for alias in node.names:
+                    if alias.name in COLLECTIVES:
+                        cached[alias.asname or alias.name] = alias.name
+        ctx._graftmesh_lax_aliases = cached
+    return cached
+
+
+def collective_op(ctx, node):
+    """Canonical collective name for a Call node, or None.
+
+    `jax.lax.psum(...)` / `lax.psum(...)` match on the attribute chain;
+    a bare `psum(...)` matches only when the file imported it from
+    `jax.lax` — an unrelated local `all_gather` helper stays invisible.
+    """
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if (func.attr in COLLECTIVES
+                and _rules._terminal_name(func.value) == "lax"):
+            return func.attr
+        return None
+    if isinstance(func, ast.Name):
+        return lax_aliases(ctx).get(func.id)
+    return None
+
+
+def collective_axis_expr(node, op):
+    """The axis_name expression of a collective Call, or None."""
+    index = _AXIS_ARG_INDEX.get(op, 1)
+    cand = None
+    for kw in node.keywords:
+        if kw.arg == "axis_name":
+            cand = kw.value
+    if cand is None and len(node.args) > index:
+        cand = node.args[index]
+    return cand
+
+
+def collective_axes(node, op):
+    """(axes, dynamic) for a collective Call: the literal axis names it
+    runs over, or ((), True) when the axis flows in as a non-literal
+    (a parameter — the ring/ulysses/pipeline idiom). ((), False) means
+    the call has no axis argument at all (malformed; jax would reject
+    it, not our department)."""
+    cand = collective_axis_expr(node, op)
+    if cand is None:
+        return (), False
+    value = _rules._literal(cand)
+    if isinstance(value, str):
+        return (value,), False
+    if (isinstance(value, (tuple, list)) and value
+            and all(isinstance(v, str) for v in value)):
+        return tuple(value), False
+    return (), True
+
+
+def is_shard_map_call(node):
+    """A `shard_map(...)` call — by name, or by shape: any call
+    carrying BOTH `in_specs` and `out_specs` keywords (catches the
+    indirected form where shard_map itself travels as a parameter,
+    e.g. ring_attention's `shard_map_fn(fn, mesh=..., in_specs=...,
+    out_specs=...)`)."""
+    if not isinstance(node, ast.Call):
+        return False
+    if _rules._terminal_name(node.func) == "shard_map":
+        return True
+    kws = {kw.arg for kw in node.keywords}
+    return "in_specs" in kws and "out_specs" in kws
+
+
+def _module_constants(ctx):
+    """module-level `NAME = "literal"` string bindings (cached)."""
+    cached = getattr(ctx, "_graftmesh_consts", None)
+    if cached is None:
+        cached = {}
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                value = _rules._literal(node.value)
+                if isinstance(value, str):
+                    cached[node.targets[0].id] = value
+        ctx._graftmesh_consts = cached
+    return cached
+
+
+def resolve_default_axis(ctx, site_node, expr):
+    """Best-effort resolution of a Name used as an axis to its
+    *default* string: an enclosing def's parameter default (`axis=
+    "sp"`, `axis=DATA_AXIS` through a module constant) or a
+    module-level constant. Registry-only information: a caller can
+    override a default, so rules never treat these as facts — the
+    rollup reports them as `default_refs`."""
+    if not isinstance(expr, ast.Name):
+        return None
+    name = expr.id
+    consts = _module_constants(ctx)
+    current = ctx.parents.get(site_node)
+    while current is not None:
+        if isinstance(current, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = current.args
+            # A local rebinding makes the name's value untrackable.
+            for node in ast.walk(current):
+                if (isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets)):
+                    return None
+            params = args.posonlyargs + args.args
+            offset = len(params) - len(args.defaults)
+            for i, param in enumerate(params):
+                if param.arg != name:
+                    continue
+                if i < offset:
+                    return None  # required param: truly dynamic
+                return self_or_const(args.defaults[i - offset], consts)
+            for param, default in zip(args.kwonlyargs, args.kw_defaults):
+                if param.arg == name:
+                    if default is None:
+                        return None
+                    return self_or_const(default, consts)
+        current = ctx.parents.get(current)
+    return consts.get(name)
+
+
+def self_or_const(default, consts):
+    """A default expression's string value: a literal, or one hop
+    through a module constant Name."""
+    value = _rules._literal(default)
+    if isinstance(value, str):
+        return value
+    if isinstance(default, ast.Name):
+        return consts.get(default.id)
+    return None
+
+
+def mesh_axis_names(node):
+    """Literal axis-name tuple of a Mesh/make_mesh Call, or ()."""
+    candidates = list(node.args[1:2])
+    candidates += [kw.value for kw in node.keywords
+                   if kw.arg == "axis_names"]
+    for cand in candidates:
+        value = _rules._literal(cand)
+        if isinstance(value, str):
+            value = (value,)
+        if isinstance(value, (tuple, list)):
+            axes = tuple(v for v in value if isinstance(v, str))
+            if axes and len(axes) == len(value):
+                return axes
+    return ()
+
+
+def _int_shape(value):
+    if isinstance(value, int):
+        return (value,)
+    if isinstance(value, (tuple, list)):
+        return tuple(v if isinstance(v, int) else None for v in value)
+    return None
+
+
+def mesh_axis_sizes(node, axes):
+    """{axis -> size or None}: per-axis sizes when the mesh shape is a
+    literal. Handles `make_mesh((2, 4), ...)`, `Mesh(x.reshape(2, 4),
+    ...)` / `.reshape((2, 4))`, and `Mesh(create_device_mesh((2, 4)),
+    ...)`; anything else (a device array variable — the dynamic mesh)
+    maps every axis to None."""
+    sizes = None
+    name = _rules._terminal_name(node.func)
+    if name == "make_mesh":
+        cand = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "axis_shapes":
+                cand = kw.value
+        if cand is not None:
+            sizes = _int_shape(_rules._literal(cand))
+    elif name == "Mesh" and node.args:
+        first = node.args[0]
+        if isinstance(first, ast.Call):
+            fname = _rules._terminal_name(first.func)
+            if fname == "reshape":
+                parts = [_rules._literal(a) for a in first.args]
+                if len(parts) == 1 and isinstance(parts[0], (tuple, list)):
+                    sizes = _int_shape(parts[0])
+                else:
+                    sizes = _int_shape(parts)
+            elif fname == "create_device_mesh" and first.args:
+                sizes = _int_shape(_rules._literal(first.args[0]))
+    out = {}
+    for i, axis in enumerate(axes):
+        size = sizes[i] if sizes is not None and i < len(sizes) else None
+        out[axis] = size if isinstance(size, int) and size > 0 else None
+    return out
+
+
+def spec_entry(arg):
+    """One PartitionSpec argument -> its registry entry: an axis name
+    string, a tuple of axis names, None (replicated dim), or UNKNOWN
+    for a non-literal expression."""
+    if isinstance(arg, ast.Constant) and arg.value is None:
+        return None
+    value = _rules._literal(arg)
+    if isinstance(value, str):
+        return value
+    if isinstance(value, (tuple, list)):
+        if value and all(isinstance(v, str) for v in value):
+            return tuple(value)
+        return UNKNOWN
+    return UNKNOWN
+
+
+def spec_entries(node):
+    """Entries of a P(...)/PartitionSpec(...) Call node."""
+    return tuple(spec_entry(arg) for arg in node.args)
+
+
+def entry_axes(entries):
+    """The axis-name strings an entry tuple mentions (UNKNOWN/None
+    skipped)."""
+    axes = []
+    for entry in entries:
+        if isinstance(entry, str) and entry != UNKNOWN:
+            axes.append(entry)
+        elif isinstance(entry, tuple):
+            axes.extend(entry)
+    return axes
+
+
+def scope_label(ctx, node):
+    """'outer.inner' chain of enclosing defs ('<module>' at top level),
+    with ' [jit]' appended when the site is inside a jit-compiled
+    body."""
+    parts = []
+    current = ctx.parents.get(node)
+    while current is not None:
+        if isinstance(current, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            parts.append(current.name)
+        current = ctx.parents.get(current)
+    label = ".".join(reversed(parts)) if parts else "<module>"
+    if ctx.enclosing_jit(node) is not None:
+        label += " [jit]"
+    return label
+
+
+def _json_entry(entry):
+    return list(entry) if isinstance(entry, tuple) else entry
+
+
+def file_sites(ctx):
+    """Every mesh/spec/shard_map/collective site in one file, as
+    JSON-ready dicts (cached on the FileContext — rules and the --axes
+    dump share one walk)."""
+    cached = getattr(ctx, "_graftmesh_sites", None)
+    if cached is not None:
+        return cached
+    sites = {"meshes": [], "partition_specs": [], "named_shardings": [],
+             "shard_maps": [], "collectives": []}
+
+    def at(node, **extra):
+        entry = {"path": ctx.path, "line": node.lineno,
+                 "col": node.col_offset, "scope": scope_label(ctx, node)}
+        entry.update(extra)
+        return entry
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _rules._terminal_name(node.func)
+        if name in ("Mesh", "make_mesh"):
+            axes = mesh_axis_names(node)
+            sites["meshes"].append(at(
+                node, axes=list(axes),
+                sizes=mesh_axis_sizes(node, axes),
+                dynamic=not axes))
+        elif name in ctx.pspec_aliases:
+            entries = spec_entries(node)
+            defaults = sorted({
+                axis for axis in (
+                    resolve_default_axis(ctx, node, arg)
+                    for arg in node.args if isinstance(arg, ast.Name))
+                if axis is not None})
+            sites["partition_specs"].append(at(
+                node, entries=[_json_entry(e) for e in entries],
+                axes=sorted(set(entry_axes(entries))),
+                default_axes=defaults))
+        elif name == "NamedSharding":
+            cand = node.args[1] if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg == "spec":
+                    cand = kw.value
+            sites["named_shardings"].append(at(
+                node, spec=_unparse(cand) if cand is not None else None))
+        elif is_shard_map_call(node):
+            kwargs = {kw.arg: kw.value for kw in node.keywords}
+            fn = node.args[0] if node.args else None
+            sites["shard_maps"].append(at(
+                node,
+                fn=_unparse(fn) if fn is not None else None,
+                in_specs=(_unparse(kwargs["in_specs"])
+                          if "in_specs" in kwargs else None),
+                out_specs=(_unparse(kwargs["out_specs"])
+                           if "out_specs" in kwargs else None)))
+        else:
+            op = collective_op(ctx, node)
+            if op is not None:
+                axes, dynamic = collective_axes(node, op)
+                default = None
+                if dynamic:
+                    default = resolve_default_axis(
+                        ctx, node, collective_axis_expr(node, op))
+                sites["collectives"].append(at(
+                    node, op=op, axes=list(axes), dynamic=dynamic,
+                    default_axes=[default] if default else []))
+    ctx._graftmesh_sites = sites
+    return sites
+
+
+class AxisRegistry:
+    """The aggregated whole-invocation inventory (one per
+    ProjectContext; build via `project.graftmesh()`)."""
+
+    _KINDS = ("meshes", "partition_specs", "named_shardings",
+              "shard_maps", "collectives")
+
+    def __init__(self, project):
+        for kind in self._KINDS:
+            setattr(self, kind, [])
+        for path in sorted(project.modules):
+            sites = file_sites(project.modules[path].ctx)
+            for kind in self._KINDS:
+                getattr(self, kind).extend(sites[kind])
+
+    def declared_axes(self):
+        """Axis names any mesh literal declares."""
+        axes = set()
+        for mesh in self.meshes:
+            axes.update(mesh["axes"])
+        return axes
+
+    def axis_sizes(self):
+        """axis -> size, only where every size-known mesh declaring the
+        axis agrees (conflicting literals make the size unusable for
+        divisibility reasoning, not a coin flip)."""
+        sizes = {}
+        for mesh in self.meshes:
+            for axis, size in mesh["sizes"].items():
+                if size is not None:
+                    sizes.setdefault(axis, set()).add(size)
+        return {axis: values.pop() for axis, values in sizes.items()
+                if len(values) == 1}
+
+    def axis_summary(self):
+        """Per-axis rollup: declarations, agreed size, reference
+        counts from specs and collectives."""
+        summary = {}
+
+        def row(axis):
+            return summary.setdefault(axis, {
+                "declared_at": [], "size": None,
+                "partition_spec_refs": 0, "collective_refs": 0,
+                "default_refs": 0})
+
+        sizes = self.axis_sizes()
+        for mesh in self.meshes:
+            for axis in mesh["axes"]:
+                row(axis)["declared_at"].append(
+                    "{}:{}".format(mesh["path"], mesh["line"]))
+        for spec in self.partition_specs:
+            for axis in spec["axes"]:
+                row(axis)["partition_spec_refs"] += 1
+            for axis in spec["default_axes"]:
+                row(axis)["default_refs"] += 1
+        for coll in self.collectives:
+            for axis in coll["axes"]:
+                row(axis)["collective_refs"] += 1
+            for axis in coll["default_axes"]:
+                row(axis)["default_refs"] += 1
+        for axis, size in sizes.items():
+            row(axis)["size"] = size
+        return {axis: summary[axis] for axis in sorted(summary)}
+
+    def is_empty(self):
+        return not any(getattr(self, kind) for kind in self._KINDS)
+
+    def to_json(self):
+        doc = {"version": REGISTRY_VERSION,
+               "axes": self.axis_summary()}
+        for kind in self._KINDS:
+            doc[kind] = getattr(self, kind)
+        return doc
+
+
+def build_registry(project):
+    return AxisRegistry(project)
+
+
+def registry_for_paths(paths):
+    """(AxisRegistry, [GL000 Findings]) over files/dirs — the
+    `lint --axes` entry point."""
+    from cloud_tpu.analysis import engine
+
+    project, errors, _ = engine.build_project(paths)
+    return build_registry(project), errors

@@ -8,7 +8,8 @@ job's wall-clock metrics.
 
 The lint covers the entry point AND its first-level local imports
 (`local_imports`): one level deep, bounded at MAX_IMPORT_FOLLOW files,
-cycle-safe — enough for the interprocedural rules (GL006-GL010) to see
+cycle-safe — enough for the interprocedural rules (GL006-GL010,
+GL014-GL018) to see
 the helper modules a real training script factors its step functions
 into, without turning a launch into a whole-tree crawl.
 
@@ -130,8 +131,9 @@ def preflight_lint(entry_point, mode="warn"):
 
     The imports ride along because they ship in the same container: a
     GL001 host sync in `helpers.py` costs the same idle slice minutes
-    as one in `train.py`, and the interprocedural rules (GL006-GL010)
-    only see cross-module facts when the modules are linted together.
+    as one in `train.py`, and the interprocedural rules (GL006-GL010,
+    GL014-GL018) only see cross-module facts when the modules are
+    linted together.
 
     Raises GraftlintError in strict mode when anything fires, and
     ValueError on an unknown mode (validate.py rejects that earlier on

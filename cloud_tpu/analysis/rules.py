@@ -1,4 +1,4 @@
-"""graftlint rules GL001-GL013.
+"""graftlint rules GL001-GL018.
 
 Every rule is keyed to the runtime counter it predicts (PERF.md has the
 table): the linter is the static half of the transfer/compile
@@ -36,9 +36,20 @@ discipline per class — a field written under `with self._lock` in one
 method but touched lock-free in a method reachable from a different
 `threading.Thread` target, with `# graftlint: unlocked-ok` as the
 sanction comment for documented single-writer fields.
+
+The graftmesh family (GL014-GL018) cross-checks mesh-axis semantics
+against the whole-program axis registry (`analysis/meshmap.py`, read
+through `ctx.project.graftmesh()`): GL014 collectives over axes no
+mesh literal declares, GL015 malformed PartitionSpecs (duplicate axis,
+or longer than the annotated array's rank), GL016 shard_map bodies
+that replicate an axis they shard without reducing over it, GL017
+nested scopes re-pinning a value to a conflicting layout, GL018
+statically-known dims not divisible by the mesh axis size sharding
+them. All five honor the `# graftlint: axis-ok` sanction comment.
 """
 
 import ast
+import os
 
 from cloud_tpu.analysis.engine import Finding
 
@@ -1486,9 +1497,524 @@ class LockDiscipline(Rule):
         return best
 
 
+# -- graftmesh rules (GL014-GL018: read the project axis registry) ----
+#
+# All five share the `# graftlint: axis-ok` sanction comment (the GL013
+# `unlocked-ok` discipline): append it, with a reason, to a flagged
+# line whose axis handling is deliberate — e.g. an axis registered
+# dynamically at runtime that the AST cannot see.
+
+_AXIS_SANCTION = "graftlint: axis-ok"
+
+
+def _axis_sanctioned_lines(ctx):
+    cached = getattr(ctx, "_axis_sanctioned_lines", None)
+    if cached is None:
+        cached = {i + 1 for i, line in enumerate(ctx.source.splitlines())
+                  if _AXIS_SANCTION in line}
+        ctx._axis_sanctioned_lines = cached
+    return cached
+
+
+def _known_axes(ctx):
+    """(known axis set, declared-label, scope-label) like GL006's
+    resolution order: whole-project mesh literals first, file-local
+    second, no opinion (None) when no mesh is in sight anywhere."""
+    project = ctx.project
+    if project is not None and project.mesh_axes:
+        return (set(project.mesh_axes), project.declared_axes_label(),
+                "this file" if ctx.mesh_axes else "any linted module")
+    if ctx.mesh_axes:
+        return (set(ctx.mesh_axes), ", ".join(sorted(ctx.mesh_axes)),
+                "this file")
+    return None, None, None
+
+
+def _static_shape(node):
+    """Literal shape tuple of an array-constructor Call
+    (`jnp.zeros((2, 4))`, `jnp.full((8,), 0.0)`,
+    `jax.ShapeDtypeStruct((2, 4), ...)`), or None. Unknown dims inside
+    an otherwise-literal tuple come back as None entries."""
+    if not isinstance(node, ast.Call):
+        return None
+    fname = _terminal_name(node.func)
+    if fname not in ("zeros", "ones", "empty", "full",
+                     "ShapeDtypeStruct"):
+        return None
+    cand = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "shape":
+            cand = kw.value
+    if cand is None:
+        return None
+    value = _literal(cand)
+    if isinstance(value, int):
+        return (value,)
+    if isinstance(value, (tuple, list)):
+        return tuple(v if isinstance(v, int) else None for v in value)
+    return None
+
+
+def _spec_call(node, ctx):
+    """The P(...)/PartitionSpec(...) Call inside a sharding expression:
+    the call itself, or the `spec` argument of a NamedSharding(...)
+    wrapper. None for anything else (a variable, a Sharding object)."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = _terminal_name(node.func)
+    if name in ctx.pspec_aliases:
+        return node
+    if name == "NamedSharding":
+        cand = node.args[1] if len(node.args) > 1 else None
+        for kw in node.keywords:
+            if kw.arg == "spec":
+                cand = kw.value
+        if cand is not None:
+            return _spec_call(cand, ctx)
+    return None
+
+
+def _paired_spec_shapes(ctx):
+    """Yields (p_call, entries, shape) wherever a literal PartitionSpec
+    is paired with a statically-known array shape:
+
+    - `device_put(jnp.zeros((4, 8)), NamedSharding(mesh, P("dp")))`
+    - `with_sharding_constraint(jnp.ones((4,)), P("dp"))`
+    - `ShapeDtypeStruct((4, 8), dt, sharding=NamedSharding(m, P(...)))`
+    - `shard_map(f, mesh=m, in_specs=(P("dp"),), ...)(jnp.zeros((6,)))`
+      (specs mapped positionally onto the immediate call's arguments)
+    """
+    from cloud_tpu.analysis import meshmap
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal_name(node.func)
+        if (name in ("device_put", "with_sharding_constraint")
+                and len(node.args) >= 2):
+            p_call = _spec_call(node.args[1], ctx)
+            if p_call is not None:
+                yield (p_call, meshmap.spec_entries(p_call),
+                       _static_shape(node.args[0]))
+        elif name == "ShapeDtypeStruct":
+            for kw in node.keywords:
+                if kw.arg == "sharding":
+                    p_call = _spec_call(kw.value, ctx)
+                    if p_call is not None:
+                        yield (p_call, meshmap.spec_entries(p_call),
+                               _static_shape(node))
+        elif (isinstance(node.func, ast.Call)
+              and meshmap.is_shard_map_call(node.func)):
+            in_specs = None
+            for kw in node.func.keywords:
+                if kw.arg == "in_specs":
+                    in_specs = kw.value
+            if in_specs is None:
+                continue
+            spec_nodes = (list(in_specs.elts)
+                          if isinstance(in_specs, ast.Tuple)
+                          else [in_specs] * len(node.args))
+            for spec_node, arg in zip(spec_nodes, node.args):
+                p_call = _spec_call(spec_node, ctx)
+                if p_call is not None:
+                    yield (p_call, meshmap.spec_entries(p_call),
+                           _static_shape(arg))
+
+
+class UndeclaredCollectiveAxis(Rule):
+    id = "GL014"
+    title = "undeclared-collective-axis"
+    predicts = "unbound axis-name error from deep inside the trace"
+
+    _MSG = ("collective `{}` runs over mesh axis {!r}, which no mesh "
+            "literal in {} declares (declared: {}) — the dispatch "
+            "fails with an unbound-name error from deep inside the "
+            "trace; fix the axis name, add it to the mesh's "
+            "axis_names, or sanction a dynamically registered axis "
+            "with `# graftlint: axis-ok`")
+
+    def check(self, ctx):
+        from cloud_tpu.analysis import meshmap
+
+        known, declared, scope = _known_axes(ctx)
+        if known is None:
+            return  # no mesh in sight anywhere: the mesh may live
+            # in code we were not asked to lint (GL006's contract)
+        sanctioned = _axis_sanctioned_lines(ctx)
+        for site in meshmap.file_sites(ctx)["collectives"]:
+            if site["dynamic"] or site["line"] in sanctioned:
+                continue  # parameter-passed axis names resolve at the
+                # call site, not here (ring/ulysses/pipeline idiom)
+            for axis in site["axes"]:
+                if axis not in known:
+                    yield Finding(
+                        ctx.path, site["line"], site["col"], self.id,
+                        self._MSG.format(site["op"], axis, scope,
+                                         declared))
+
+
+class MalformedPartitionSpec(Rule):
+    id = "GL015"
+    title = "malformed-partition-spec"
+    predicts = "sharding-spec validation error at dispatch"
+
+    _DUP_MSG = ("PartitionSpec mentions mesh axis {!r} twice — one "
+                "array dimension set cannot be sharded over the same "
+                "axis in two places; jax rejects the spec at dispatch, "
+                "after the compile was already paid")
+    _RANK_MSG = ("PartitionSpec has {} entries but the annotated array "
+                 "has rank {} — the spec cannot be longer than the "
+                 "array's rank; drop the extra entries (trailing "
+                 "dimensions are replicated by default)")
+
+    def check(self, ctx):
+        from cloud_tpu.analysis import meshmap
+
+        sanctioned = _axis_sanctioned_lines(ctx)
+        # (a) one axis twice in one spec: purely local, always checked.
+        for node in ast.walk(ctx.tree):
+            if (not isinstance(node, ast.Call)
+                    or _terminal_name(node.func) not in ctx.pspec_aliases
+                    or node.lineno in sanctioned):
+                continue
+            entries = meshmap.spec_entries(node)
+            seen = set()
+            for axis in meshmap.entry_axes(entries):
+                if axis in seen:
+                    yield ctx.finding(node, self.id,
+                                      self._DUP_MSG.format(axis))
+                    break
+                seen.add(axis)
+        # (b) spec longer than the annotated array's rank.
+        for p_call, entries, shape in _paired_spec_shapes(ctx):
+            if shape is None or p_call.lineno in sanctioned:
+                continue
+            if len(entries) > len(shape):
+                yield ctx.finding(
+                    p_call, self.id,
+                    self._RANK_MSG.format(len(entries), len(shape)))
+
+
+class UnreducedShardMapLeak(Rule):
+    id = "GL016"
+    title = "unreduced-shard-leak"
+    predicts = ("silent wrong numerics: the replicated output holds "
+                "only one shard's partial value")
+
+    _MSG = ("shard_map shards axis {!r} in `in_specs` but `out_specs` "
+            "replicates it, and the mapped function `{}` applies no "
+            "reducing collective (psum/pmean/pmax/pmin/psum_scatter/"
+            "all_gather) over that axis — each device returns its own "
+            "partial value and the \"replicated\" output is silently "
+            "wrong; reduce over the axis before returning, keep it in "
+            "out_specs, or sanction with `# graftlint: axis-ok`")
+
+    #: Local-callee resolution depth when scanning the mapped function
+    #: for reducing collectives (mirrors callgraph.MAX_CHAIN_DEPTH in
+    #: spirit; shard_map bodies are shallow by construction).
+    _MAX_DEPTH = 4
+
+    def check(self, ctx):
+        from cloud_tpu.analysis import meshmap
+
+        sanctioned = _axis_sanctioned_lines(ctx)
+        for node in ast.walk(ctx.tree):
+            if (not isinstance(node, ast.Call)
+                    or not meshmap.is_shard_map_call(node)
+                    or node.lineno in sanctioned):
+                continue
+            kwargs = {kw.arg: kw.value for kw in node.keywords}
+            if "in_specs" not in kwargs or "out_specs" not in kwargs:
+                continue
+            in_axes = self._spec_axes(ctx, node, kwargs["in_specs"],
+                                      need_all=False)
+            out_axes = self._spec_axes(ctx, node, kwargs["out_specs"],
+                                       need_all=True)
+            if in_axes is None or out_axes is None:
+                continue  # unresolvable specs: no opinion
+            leaked = in_axes - out_axes
+            if not leaked:
+                continue
+            fn_node, fn_label = self._mapped_fn(ctx, node)
+            if fn_node is None:
+                continue  # body not visible: no opinion
+            reduced = self._reduced_axes(ctx, fn_node, set(), 0)
+            if reduced is None:
+                continue  # a dynamic-axis reducing collective may
+                # cover any axis: conservative silence
+            for axis in sorted(leaked - reduced):
+                yield ctx.finding(
+                    node, self.id, self._MSG.format(axis, fn_label))
+
+    def _spec_axes(self, ctx, call, spec_node, need_all):
+        """Axis names a specs expression mentions, resolving direct
+        P(...) calls, tuples of them, and single-assignment local
+        names. Returns None when resolution is incomplete and
+        `need_all` (out_specs: claiming an axis is ABSENT needs the
+        whole expression) — for in_specs the known subset suffices."""
+        from cloud_tpu.analysis import meshmap
+
+        nodes = (list(spec_node.elts)
+                 if isinstance(spec_node, (ast.Tuple, ast.List))
+                 else [spec_node])
+        axes, complete = set(), True
+        for item in nodes:
+            if isinstance(item, ast.Name):
+                item = self._local_spec_binding(ctx, call, item.id)
+            p_call = _spec_call(item, ctx) if item is not None else None
+            if p_call is None:
+                complete = False
+                continue
+            entries = meshmap.spec_entries(p_call)
+            if meshmap.UNKNOWN in entries or any(
+                    isinstance(e, tuple) and meshmap.UNKNOWN in e
+                    for e in entries):
+                complete = False
+            axes.update(meshmap.entry_axes(entries))
+        if need_all and not complete:
+            return None
+        return axes
+
+    @staticmethod
+    def _local_spec_binding(ctx, call, name):
+        """The single P(...) Call a local name is bound to in the
+        function enclosing `call` (or at module level); None when the
+        name is rebound, a parameter, or not a spec call."""
+        scope = ctx.parents.get(call)
+        while scope is not None and not isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = ctx.parents.get(scope)
+        body_root = scope if scope is not None else ctx.tree
+        bindings = []
+        for node in ast.walk(body_root):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in node.targets):
+                bindings.append(node.value)
+        if len(bindings) == 1:
+            return bindings[0]
+        return None
+
+    def _mapped_fn(self, ctx, call):
+        """(AST node to scan for collectives, label) for the mapped
+        function: a Lambda inline, a local def by name, or a
+        functools.partial over one (ring/ulysses bind axis_name this
+        way — scanning the underlying def keeps the rule's view of the
+        body, with the partial's literal kwargs folded in)."""
+        fn = call.args[0] if call.args else None
+        if isinstance(fn, ast.Call) and _terminal_name(fn.func) == "partial":
+            fn = fn.args[0] if fn.args else None
+        if isinstance(fn, ast.Lambda):
+            return fn, "<lambda>"
+        if isinstance(fn, ast.Name):
+            target = self._local_def(ctx, fn.id)
+            if target is not None:
+                return target, fn.id
+        return None, None
+
+    @staticmethod
+    def _local_def(ctx, name):
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == name):
+                return node
+        return None
+
+    def _reduced_axes(self, ctx, fn_node, visiting, depth):
+        """Literal axis names the body (or a reachable local callee)
+        reduces over. None means a reducing collective with a DYNAMIC
+        axis was seen — it may cover any axis, so the caller must stay
+        silent."""
+        from cloud_tpu.analysis import meshmap
+
+        if fn_node in visiting or depth > self._MAX_DEPTH:
+            return set()
+        visiting = visiting | {fn_node}
+        reduced = set()
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            op = meshmap.collective_op(ctx, node)
+            if op in meshmap.REDUCING_COLLECTIVES:
+                axes, dynamic = meshmap.collective_axes(node, op)
+                if dynamic:
+                    return None
+                reduced.update(axes)
+            elif op is None and isinstance(node.func, ast.Name):
+                callee = self._local_def(ctx, node.func.id)
+                if callee is not None:
+                    sub = self._reduced_axes(ctx, callee, visiting,
+                                             depth + 1)
+                    if sub is None:
+                        return None
+                    reduced |= sub
+        return reduced
+
+
+class ConflictingNestedSharding(Rule):
+    id = "GL017"
+    title = "conflicting-nested-sharding"
+    predicts = ("resharding churn at scope boundaries (h2d/d2d "
+                "transfers per entry, or a GSPMD conflict error)")
+
+    _MSG = ("`{name}` is pinned to PartitionSpec({inner}) inside a "
+            "nested {what} scope, but the enclosing scope already "
+            "pinned it to PartitionSpec({outer}) (line {oline}) — "
+            "nested scopes re-pinning the same value to a different "
+            "layout force a reshard (or a GSPMD conflict) every time "
+            "the inner scope runs; pick one layout, or sanction an "
+            "intentional boundary reshard with `# graftlint: axis-ok`")
+
+    _PIN_CALLS = ("with_sharding_constraint", "device_put")
+
+    def check(self, ctx):
+        from cloud_tpu.analysis import meshmap
+
+        sanctioned = _axis_sanctioned_lines(ctx)
+        pins = []
+        for node in ast.walk(ctx.tree):
+            if (not isinstance(node, ast.Call)
+                    or _terminal_name(node.func) not in self._PIN_CALLS
+                    or len(node.args) < 2
+                    or not isinstance(node.args[0], ast.Name)):
+                continue
+            p_call = _spec_call(node.args[1], ctx)
+            if p_call is None:
+                continue
+            entries = meshmap.spec_entries(p_call)
+            if meshmap.UNKNOWN in entries:
+                continue
+            pins.append((node.args[0].id, entries, node,
+                         self._scope_chain(ctx, node)))
+        for name, entries, node, chain in pins:
+            if node.lineno in sanctioned:
+                continue
+            for oname, oentries, onode, ochain in pins:
+                if (oname != name or onode is node
+                        or oentries == entries):
+                    continue
+                if (len(ochain) < len(chain)
+                        and chain[:len(ochain)] == ochain):
+                    what = self._inner_scope_kind(
+                        ctx, chain[len(ochain):])
+                    if what is None:
+                        continue  # plain nested def: a different
+                        # dynamic extent, not an enclosed scope
+                    yield ctx.finding(node, self.id, self._MSG.format(
+                        name=name,
+                        inner=self._fmt(entries),
+                        outer=self._fmt(oentries),
+                        oline=onode.lineno, what=what))
+                    break
+
+    @staticmethod
+    def _fmt(entries):
+        return ", ".join(repr(e) if not isinstance(e, tuple)
+                         else repr(tuple(e)) for e in entries)
+
+    @classmethod
+    def _scope_chain(cls, ctx, node):
+        """Outermost-first tuple of enclosing scope nodes: function
+        defs and `with <mesh>:` blocks."""
+        chain = []
+        current = ctx.parents.get(node)
+        while current is not None:
+            if isinstance(current, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                chain.append(current)
+            elif (isinstance(current, (ast.With, ast.AsyncWith))
+                  and cls._is_mesh_with(current)):
+                chain.append(current)
+            current = ctx.parents.get(current)
+        return tuple(reversed(chain))
+
+    @staticmethod
+    def _is_mesh_with(node):
+        """`with Mesh(...):` / `with make_mesh(...):` / `with mesh:` —
+        the name heuristic ('mesh' / '*_mesh') covers the dominant
+        idiom of entering a pre-built mesh context."""
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                if _terminal_name(expr.func) in ("Mesh", "make_mesh"):
+                    return True
+            name = _terminal_name(expr)
+            if isinstance(name, str):
+                lowered = name.lower()
+                if lowered == "mesh" or lowered.endswith("_mesh"):
+                    return True
+        return False
+
+    def _inner_scope_kind(self, ctx, extra):
+        """What makes the inner pin a *different sharding scope*: a
+        jit-compiled def or a with-mesh block among the scopes below
+        the outer pin. A plain nested def is neither."""
+        for scope in extra:
+            if isinstance(scope, (ast.With, ast.AsyncWith)):
+                return "with-mesh"
+            if scope in ctx.jit_defs:
+                return "jit"
+        return None
+
+
+class AxisDivisibility(Rule):
+    id = "GL018"
+    title = "axis-divisibility"
+    predicts = "an opaque XLA sharding error at compile time"
+
+    _MSG = ("dimension {dim} of shape {shape} has size {size}, which "
+            "is not divisible by mesh axis {axes} (size {asize}, "
+            "declared at {where}) — XLA rejects the uneven shard with "
+            "an opaque partitioning error; pad the dimension, resize "
+            "the mesh axis, or sanction with `# graftlint: axis-ok`")
+
+    def check(self, ctx):
+        project = ctx.project
+        if project is None:
+            return
+        registry = project.graftmesh()
+        sizes = registry.axis_sizes()
+        if not sizes:
+            return  # no statically sized mesh anywhere: no opinion
+        where = {}
+        for mesh in registry.meshes:
+            for axis in mesh["axes"]:
+                where.setdefault(axis, "{}:{}".format(
+                    os.path.basename(mesh["path"]), mesh["line"]))
+        sanctioned = _axis_sanctioned_lines(ctx)
+        for p_call, entries, shape in _paired_spec_shapes(ctx):
+            if shape is None or p_call.lineno in sanctioned:
+                continue
+            for i, entry in enumerate(entries):
+                if i >= len(shape) or shape[i] is None:
+                    continue
+                axes = ((entry,) if isinstance(entry, str) else entry
+                        if isinstance(entry, tuple) else ())
+                total, names = 1, []
+                for axis in axes:
+                    if axis not in sizes:
+                        total = None
+                        break
+                    total *= sizes[axis]
+                    names.append(axis)
+                if not names or total in (None, 0):
+                    continue
+                if shape[i] % total:
+                    label = (repr(names[0]) if len(names) == 1
+                             else repr(tuple(names)))
+                    yield ctx.finding(p_call, self.id, self._MSG.format(
+                        dim=i, shape=tuple(shape), size=shape[i],
+                        axes=label, asize=total,
+                        where=", ".join(where.get(a, "?")
+                                        for a in names)))
+
+
 ALL_RULES = [HostSyncInJit(), RetraceHazard(), DonationAfterUse(),
              RngKeyReuse(), TracerControlFlow(),
              ShardingAxisMismatch(), TransitiveHostSync(),
              RngKeyReuseAcrossCalls(), DonationEscape(),
              DeadJitSignatureLeaf(), UnhashableStaticArg(),
-             RetraceProneCacheKey(), LockDiscipline()]
+             RetraceProneCacheKey(), LockDiscipline(),
+             UndeclaredCollectiveAxis(), MalformedPartitionSpec(),
+             UnreducedShardMapLeak(), ConflictingNestedSharding(),
+             AxisDivisibility()]

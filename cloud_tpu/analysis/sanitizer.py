@@ -35,6 +35,16 @@ Violations (ids mirror the GL numbering, GS-prefixed):
   moved: "args[1]['page_table'] widened int32[4,16] -> int32[8,16]",
   attributed to the dispatching call site. Warmup traces are expected
   and record nothing.
+- GS006 mesh-drift — the runtime dual of the graftmesh rules
+  (GL014-GL018). Every InstrumentedJit records the concrete mesh +
+  input shardings at the first observed dispatch per executable (aval
+  signature); a later dispatch whose shardings differ means the jit
+  boundary is silently resharding — a device transfer per call that no
+  counter otherwise names — and the finding carries the exact leaf and
+  BOTH layouts: "args[0] moved NamedSharding(..., PartitionSpec()) ->
+  NamedSharding(..., PartitionSpec('dp',))". Unlike GS005 this arms
+  immediately (the baseline IS the first dispatch), so it fires during
+  warmup too — drift there costs the same transfer.
 
 Enablement is scoped, never ambient: `with sanitize(mode="warn"):`
 installs the runtime observer and the `jax.random` watchers and tears
@@ -84,6 +94,13 @@ VIOLATIONS = {
               "leaf(s) named moved between calls; pin the leaf's "
               "shape/dtype, pre-warm the new geometry, or drop a dead "
               "leaf from the signature (graftlint GL010)"),
+    "GS006": ("mesh-drift",
+              "input sharding of `{}` drifted at {}: {} — the jit "
+              "boundary is silently resharding that leaf (a device "
+              "transfer per dispatch); device_put the input into the "
+              "first-dispatch layout once upstream, or make the new "
+              "layout the one the executable is compiled for "
+              "(graftmesh GL014-GL018)"),
 }
 
 #: jax.random functions whose first argument is a key they consume.
@@ -251,6 +268,22 @@ class Sanitizer:
             self._violation(
                 "GS005", site,
                 VIOLATIONS["GS005"][1].format(
+                    label, _format_site(site), detail))
+
+    def on_mesh_drift(self, label, drifts):
+        """One attributed jit-boundary resharding from an
+        InstrumentedJit (GS006). `drifts` is a tuple of (leaf path,
+        sharding at first dispatch, sharding now). No warm gate:
+        unlike a retrace, the baseline is by definition the first
+        dispatch, so every drift is a real extra transfer."""
+        site = _attribution_site()
+        with self._lock:
+            detail = "; ".join(
+                "{} moved {} -> {}".format(path, old, new)
+                for path, old, new in drifts)
+            self._violation(
+                "GS006", site,
+                VIOLATIONS["GS006"][1].format(
                     label, _format_site(site), detail))
 
     def on_donation(self, args):

@@ -526,6 +526,9 @@ class _FanoutObserver:
     def on_retrace(self, label, diffs):
         self._fan("on_retrace", label, diffs)
 
+    def on_mesh_drift(self, label, drifts):
+        self._fan("on_mesh_drift", label, drifts)
+
 
 def _rebuild_dispatch():
     """Recomputes the fast dispatch target `_observer` from the
@@ -545,9 +548,10 @@ def add_observer(observer):
     see `on_h2d(transfers, nbytes)`, `on_d2h(nbytes, tree)`,
     `on_compile(n_traces, n_compiles, cache_hits)`, `on_cache_miss()`,
     `on_epoch(epoch)`, `on_donation(args)`, `on_warm_mark()`,
-    `on_retrace(label, diffs)` — all best-effort, called inline at
-    record time on whatever thread recorded; any subset of those
-    methods may be implemented when stacked. Returns `observer`."""
+    `on_retrace(label, diffs)`, `on_mesh_drift(label, drifts)` — all
+    best-effort, called inline at record time on whatever thread
+    recorded; any subset of those methods may be implemented when
+    stacked. Returns `observer`."""
     global _observers
     if observer is not None and observer not in _observers:
         _observers = _observers + (observer,)
@@ -622,6 +626,18 @@ def _notify_retrace(label, diffs):
         fn = getattr(_observer, "on_retrace", None)
         if fn is not None:
             fn(label, diffs)
+
+
+def _notify_mesh_drift(label, drifts):
+    """Forwards one attributed jit-boundary resharding to the observer
+    (if any): `drifts` is a tuple of (leaf path, sharding at first
+    dispatch, sharding now) — the GS006 mesh-drift event. getattr-
+    guarded like on_warm_mark: observers that predate the event never
+    see it."""
+    if _observer is not None:
+        fn = getattr(_observer, "on_mesh_drift", None)
+        if fn is not None:
+            fn(label, drifts)
 
 
 def record_h2d(batch):
@@ -842,6 +858,12 @@ class InstrumentedJit:
         # construction), read only to attribute the NEXT trace: the
         # diff against it names the exact leaf whose avals moved.
         self._sig_history = {}
+        # aval signature -> per-leaf (path, sharding str) tuple of the
+        # FIRST observed dispatch with that signature. Only populated
+        # while an observer is installed (graftsan): a later dispatch
+        # whose shardings differ is an implicit reshard at the jit
+        # boundary, forwarded as the GS006 mesh-drift event.
+        self._shard_baseline = {}
         # Donated positions, kept for the graftsan observer: donation
         # invalidates the caller's buffer, so the sanitizer tracks the
         # donated arrays (by weakref) to catch later reads of them.
@@ -879,9 +901,13 @@ class InstrumentedJit:
             _observer.on_donation(
                 [args[i] for i in self._donate_argnums
                  if 0 <= i < len(args)])
-        if self._warm and not kwargs:
+        sig = None
+        if (self._warm or _observer is not None) and not kwargs:
             sig = _aval_signature(args)
-            compiled = self._warm.get(sig) if sig is not None else None
+        if _observer is not None and sig is not None:
+            self._check_mesh_drift(sig, args)
+        if self._warm and sig is not None:
+            compiled = self._warm.get(sig)
             if compiled is not None:
                 try:
                     return compiled(*args)
@@ -898,6 +924,39 @@ class InstrumentedJit:
             if not kwargs:
                 self._attribute_trace(args)
         return out
+
+    def _check_mesh_drift(self, sig, args):
+        """GS006: the first observed dispatch per aval signature
+        records every jax.Array input leaf's concrete sharding (the
+        mesh AND the spec, via its str form); a later dispatch with
+        the same signature but different leaf shardings means the jit
+        boundary is silently resharding — a device transfer per call —
+        and the observer gets the exact leaves with both layouts.
+        Runs only while an observer is installed, so the unobserved
+        hot path never flattens shardings."""
+        import jax
+
+        try:
+            flat, _ = jax.tree_util.tree_flatten_with_path(args)
+            current = tuple(
+                ("args" + jax.tree_util.keystr(path), str(leaf.sharding))
+                for path, leaf in flat
+                if isinstance(leaf, jax.Array))
+        except Exception:
+            return  # exotic leaves: attribution is best-effort
+        baseline = self._shard_baseline.get(sig)
+        if baseline is None:
+            self._shard_baseline[sig] = current
+            return
+        if baseline == current:
+            return
+        base = dict(baseline)
+        drifts = tuple(
+            (path, base[path], sharding)
+            for path, sharding in current
+            if path in base and base[path] != sharding)
+        if drifts:
+            _notify_mesh_drift(self._label, drifts)
 
     def _attribute_trace(self, args):
         """Names the leaves that forced the trace that just fired.

@@ -308,6 +308,119 @@ class TestGS005RetraceAttribution:
         assert "float32[6]" in finding["message"]
 
 
+class TestGS006MeshDrift:
+    """The runtime dual of the graftmesh rules (GL014-GL018): the jit
+    boundary silently resharding an input leaf. The baseline is the
+    first OBSERVED dispatch per aval signature; any later dispatch
+    whose leaf shardings differ is a device transfer per call, named
+    with the exact leaf and both layouts."""
+
+    @staticmethod
+    def _mesh():
+        # Axis names deliberately routed through a variable: this is
+        # REAL code in a self-linted tree, and a literal axis tuple
+        # here would register 'dp' with the project-wide GL006/GL014
+        # axis set and change their verdicts elsewhere.
+        names = ("dp",)
+        devices = np.array(jax.devices()[:1])
+        return jax.sharding.Mesh(devices, names)
+
+    @staticmethod
+    def _sharding(mesh, *spec):
+        return jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(*spec))
+
+    def test_drift_names_leaf_and_both_layouts(self):
+        mesh = self._mesh()
+        step = runtime.instrumented_jit(lambda s: s + 1)
+        with sanitize_quiet() as san:
+            x = jnp.ones((4,))
+            step(jax.device_put(x, self._sharding(mesh)))
+            assert san.findings() == []  # the baseline dispatch
+            moved = jax.device_put(x, self._sharding(mesh, "dp"))
+            line = inspect.currentframe().f_lineno + 1
+            step(moved)
+        (finding,) = [f for f in san.findings()
+                      if f["rule"] == "GS006"]
+        assert "args[0]" in finding["message"]
+        # BOTH layouts are in the message: where it was pinned at
+        # first dispatch and where it drifted to.
+        assert "PartitionSpec()" in finding["message"]
+        assert "PartitionSpec('dp'" in finding["message"]
+        assert os.path.abspath(finding["path"]) == THIS_FILE
+        assert finding["line"] == line
+
+    def test_every_drifted_leaf_named(self):
+        mesh = self._mesh()
+        step = runtime.instrumented_jit(
+            lambda tree: jax.tree_util.tree_map(lambda a: a * 2, tree))
+        with sanitize_quiet() as san:
+            x = jnp.ones((4,))
+            first = {"kv": jax.device_put(x, self._sharding(mesh)),
+                     "q": jax.device_put(x, self._sharding(mesh))}
+            step(first)
+            moved = {"kv": jax.device_put(x, self._sharding(mesh, "dp")),
+                     "q": jax.device_put(x, self._sharding(mesh, "dp"))}
+            step(moved)
+        (finding,) = [f for f in san.findings()
+                      if f["rule"] == "GS006"]
+        assert "args[0]['kv']" in finding["message"]
+        assert "args[0]['q']" in finding["message"]
+
+    def test_repeat_drift_dedupes_with_count(self):
+        # The baseline stays pinned to the FIRST dispatch, so a
+        # steady-state resharding fires per call and aggregates at
+        # one site — the count is the transfer count.
+        mesh = self._mesh()
+        step = runtime.instrumented_jit(lambda s: s + 1)
+        with sanitize_quiet() as san:
+            x = jnp.ones((4,))
+            step(jax.device_put(x, self._sharding(mesh)))
+            moved = jax.device_put(x, self._sharding(mesh, "dp"))
+            for _ in range(3):
+                step(moved)
+        (finding,) = [f for f in san.findings()
+                      if f["rule"] == "GS006"]
+        assert finding["count"] == 3
+
+    def test_same_sharding_silent(self):
+        mesh = self._mesh()
+        step = runtime.instrumented_jit(lambda s: s * 2)
+        with sanitize_quiet() as san:
+            x = jnp.ones((4,))
+            for _ in range(3):
+                step(jax.device_put(x, self._sharding(mesh, "dp")))
+        assert [f for f in san.findings()
+                if f["rule"] == "GS006"] == []
+
+    def test_new_signature_is_not_drift(self):
+        # A different aval signature anchors its own baseline — shape
+        # movement is GS005's beat (and only after warm), not GS006's.
+        mesh = self._mesh()
+        step = runtime.instrumented_jit(lambda s: s + 1)
+        with sanitize_quiet() as san:
+            step(jax.device_put(jnp.ones((4,)), self._sharding(mesh)))
+            step(jax.device_put(jnp.ones((8,)),
+                                self._sharding(mesh, "dp")))
+        assert [f for f in san.findings()
+                if f["rule"] == "GS006"] == []
+
+    def test_baseline_starts_at_first_observed_dispatch(self):
+        # Unobserved dispatches record nothing (the hot path never
+        # flattens shardings), so a layout that differs from pre-scope
+        # history is the scope's OWN baseline, not a drift.
+        mesh = self._mesh()
+        step = runtime.instrumented_jit(lambda s: s + 1)
+        x = jnp.ones((4,))
+        step(jax.device_put(x, self._sharding(mesh)))
+        with sanitize_quiet() as san:
+            moved = jax.device_put(x, self._sharding(mesh, "dp"))
+            step(moved)
+            step(moved)
+        assert [f for f in san.findings()
+                if f["rule"] == "GS006"] == []
+
+
 class TestEscalation:
 
     def test_strict_raises_at_scope_exit(self):
